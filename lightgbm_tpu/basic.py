@@ -1,0 +1,462 @@
+"""Dataset (and shared helpers) — the user-facing data container.
+
+TPU-native re-design of the reference's Dataset stack
+(ref: python-package/lightgbm/basic.py `Dataset`; src/io/dataset.cpp
+`Dataset::Construct`; src/io/dataset_loader.cpp
+`DatasetLoader::ConstructFromSampleData`; src/io/metadata.cpp `Metadata`).
+
+Design: instead of per-feature-group Bin objects, the constructed dataset is ONE
+dense ``[n_rows, n_features] uint8/uint16`` bin matrix (+ metadata arrays) that
+lives in TPU HBM, optionally sharded over the data axis of a mesh.  Binning
+happens host-side in numpy (see utils/binning.py) on a row sample, exactly like
+the reference's sample-then-bin two-pass flow.
+"""
+from __future__ import annotations
+
+import copy
+import json
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .utils import log
+from .utils.binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
+                            MISSING_TYPE_NAN, MISSING_TYPE_NONE, MISSING_TYPE_ZERO)
+from .utils.config import Config
+from .utils.log import LightGBMError
+
+__all__ = ["Dataset", "LightGBMError"]
+
+
+def _to_2d_float(data: Any) -> np.ndarray:
+    """Coerce input matrix to 2D float64 numpy, handling pandas."""
+    if hasattr(data, "values") and hasattr(data, "dtypes"):  # pandas DataFrame
+        arr = data.to_numpy(dtype=np.float64, na_value=np.nan)
+    else:
+        arr = np.asarray(data)
+        if arr.dtype.kind not in "fiu b".replace(" ", ""):
+            arr = arr.astype(np.float64)
+        else:
+            arr = arr.astype(np.float64, copy=False)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    if arr.ndim != 2:
+        raise LightGBMError(f"Data must be 2-dimensional, got shape {arr.shape}")
+    return arr
+
+
+def _to_1d_float(arr: Any, name: str, dtype=np.float64) -> np.ndarray:
+    if hasattr(arr, "values") and not isinstance(arr, np.ndarray):
+        arr = arr.values
+    out = np.asarray(arr, dtype=dtype).reshape(-1)
+    return out
+
+
+def _feature_names_from(data: Any, n_features: int,
+                        given: Optional[Sequence[str]]) -> List[str]:
+    if given is not None and given != "auto":
+        names = list(given)
+        if len(names) != n_features:
+            raise LightGBMError(
+                f"Length of feature_names ({len(names)}) does not match "
+                f"number of features ({n_features})")
+        return [str(n) for n in names]
+    if hasattr(data, "columns"):
+        return [str(c) for c in data.columns]
+    return [f"Column_{i}" for i in range(n_features)]
+
+
+class Dataset:
+    """Dataset container (API parity: python-package/lightgbm/basic.py `Dataset`).
+
+    Lazily constructed: raw data is kept until `construct()` bins it (matching
+    `Dataset._lazy_init`).  A `reference` dataset shares its BinMappers so that
+    validation data is binned identically (ref: `LGBM_DatasetCreateByReference`).
+    """
+
+    def __init__(self, data: Any, label: Any = None, reference: "Dataset" = None,
+                 weight: Any = None, group: Any = None, init_score: Any = None,
+                 feature_name: Union[str, Sequence[str]] = "auto",
+                 categorical_feature: Union[str, Sequence] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = True, position: Any = None):
+        self.data = data
+        self.params = copy.deepcopy(params) if params else {}
+        self.reference = reference
+        self.free_raw_data = free_raw_data
+        self.used_indices: Optional[np.ndarray] = None
+        self._predictor = None
+
+        self.label = label
+        self.weight = weight
+        self.group = group
+        self.position = position
+        self.init_score = init_score
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+
+        # constructed state
+        self._handle_constructed = False
+        self.bin_data: Optional[np.ndarray] = None  # [N, F] uint8/16, device or host
+        self.bin_mappers: Optional[List[BinMapper]] = None
+        self.num_total_bin: int = 0
+        self._feature_names: Optional[List[str]] = None
+        self._num_data: Optional[int] = None
+        self._num_feature: Optional[int] = None
+        self._label_arr: Optional[np.ndarray] = None
+        self._weight_arr: Optional[np.ndarray] = None
+        self._init_score_arr: Optional[np.ndarray] = None
+        self._query_boundaries: Optional[np.ndarray] = None
+        self._categorical_indices: List[int] = []
+        self.pandas_categorical: Optional[list] = None
+        self.version = 0
+
+    # ----------------------------------------------------------------- info
+    def num_data(self) -> int:
+        if self._num_data is not None:
+            return self._num_data
+        if self.data is not None:
+            return len(self.data)
+        raise LightGBMError("Cannot get num_data before construct")
+
+    def num_feature(self) -> int:
+        if self._num_feature is not None:
+            return self._num_feature
+        if self.data is not None:
+            arr = self.data
+            return 1 if np.ndim(arr) == 1 else np.shape(arr)[1]
+        raise LightGBMError("Cannot get num_feature before construct")
+
+    @property
+    def handle(self):
+        return self if self._handle_constructed else None
+
+    # ------------------------------------------------------------ construct
+    def _resolve_categoricals(self, feature_names: List[str],
+                              n_features: int) -> List[int]:
+        cf = self.categorical_feature
+        if cf == "auto" or cf is None:
+            return []
+        indices: List[int] = []
+        for c in cf:
+            if isinstance(c, str):
+                if c not in feature_names:
+                    raise LightGBMError(f"Unknown categorical feature name: {c}")
+                indices.append(feature_names.index(c))
+            else:
+                if not 0 <= int(c) < n_features:
+                    raise LightGBMError(f"categorical_feature index {c} out of range")
+                indices.append(int(c))
+        return sorted(set(indices))
+
+    def construct(self) -> "Dataset":
+        if self._handle_constructed:
+            return self
+        if self.reference is not None:
+            self.reference.construct()
+        if self.used_indices is not None and self.reference is not None:
+            self._construct_subset()
+            return self
+
+        if self.data is None:
+            raise LightGBMError("Cannot construct Dataset: no raw data "
+                                "(was it freed by free_raw_data?)")
+        cfg = Config(self.params)
+        raw = _to_2d_float(self.data)
+        n, f = raw.shape
+        self._num_data, self._num_feature = n, f
+        self._feature_names = _feature_names_from(self.data, f,
+                                                  None if self.feature_name == "auto"
+                                                  else self.feature_name)
+        self._categorical_indices = self._resolve_categoricals(self._feature_names, f)
+
+        if self.reference is not None:
+            # share bin mappers (ref: dataset construction by reference)
+            if f != len(self.reference.bin_mappers):
+                raise LightGBMError(
+                    f"The number of features in data ({f}) is not the same as "
+                    f"it was in training data ({len(self.reference.bin_mappers)})")
+            self.bin_mappers = self.reference.bin_mappers
+            self._categorical_indices = self.reference._categorical_indices
+        else:
+            self.bin_mappers = self._fit_bin_mappers(raw, cfg)
+
+        self.bin_data = self._apply_bins(raw, self.bin_mappers)
+        self.num_total_bin = sum(m.num_bin for m in self.bin_mappers)
+        self._set_all_fields()
+        self._handle_constructed = True
+        if self.free_raw_data:
+            self.data = None
+        return self
+
+    def _fit_bin_mappers(self, raw: np.ndarray, cfg: Config) -> List[BinMapper]:
+        n, f = raw.shape
+        sample_cnt = min(cfg.bin_construct_sample_cnt, n)
+        if sample_cnt < n:
+            rng = np.random.RandomState(cfg.data_random_seed)
+            sample_idx = rng.choice(n, sample_cnt, replace=False)
+            sample = raw[np.sort(sample_idx)]
+        else:
+            sample = raw
+        max_bin_by_feature = cfg.max_bin_by_feature
+        mappers: List[BinMapper] = []
+        for j in range(f):
+            m = BinMapper()
+            mb = (max_bin_by_feature[j] if j < len(max_bin_by_feature)
+                  else cfg.max_bin)
+            bt = (BIN_TYPE_CATEGORICAL if j in self._categorical_indices
+                  else BIN_TYPE_NUMERICAL)
+            m.find_bin(sample[:, j], len(sample), mb,
+                       min_data_in_bin=cfg.min_data_in_bin,
+                       bin_type=bt, use_missing=cfg.use_missing,
+                       zero_as_missing=cfg.zero_as_missing)
+            mappers.append(m)
+        n_trivial = sum(m.is_trivial for m in mappers)
+        if n_trivial:
+            log.info(f"{n_trivial} trivial (constant) features found and ignored "
+                     f"for splitting")
+        return mappers
+
+    @staticmethod
+    def _apply_bins(raw: np.ndarray, mappers: List[BinMapper]) -> np.ndarray:
+        n, f = raw.shape
+        max_nb = max((m.num_bin for m in mappers), default=1)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        out = np.empty((n, f), dtype=dtype)
+        for j, m in enumerate(mappers):
+            out[:, j] = m.values_to_bins(raw[:, j]).astype(dtype)
+        return out
+
+    def _construct_subset(self) -> None:
+        ref = self.reference
+        assert ref is not None and ref._handle_constructed
+        idx = np.asarray(self.used_indices, dtype=np.int64)
+        self.bin_mappers = ref.bin_mappers
+        self.bin_data = np.asarray(ref.bin_data)[idx]
+        self._categorical_indices = ref._categorical_indices
+        self._feature_names = ref._feature_names
+        self._num_data = len(idx)
+        self._num_feature = ref._num_feature
+        self.num_total_bin = ref.num_total_bin
+        # subset metadata from reference when not explicitly set
+        if self.label is None and ref._label_arr is not None:
+            self._label_arr = ref._label_arr[idx]
+        if self.weight is None and ref._weight_arr is not None:
+            self._weight_arr = ref._weight_arr[idx]
+        if self.group is None and ref._query_boundaries is not None:
+            # subset must respect group boundaries; reference semantics require
+            # used_indices to align with whole groups
+            qb = ref._query_boundaries
+            sizes = []
+            pos = 0
+            for g in range(len(qb) - 1):
+                glen = qb[g + 1] - qb[g]
+                members = ((idx >= qb[g]) & (idx < qb[g + 1])).sum()
+                if members:
+                    sizes.append(members)
+                pos += glen
+            self._query_boundaries = np.concatenate([[0], np.cumsum(sizes)])
+        self._set_all_fields()
+        self._handle_constructed = True
+
+    def _set_all_fields(self) -> None:
+        if self.label is not None:
+            self._label_arr = _to_1d_float(self.label, "label", np.float32)
+        if self.weight is not None:
+            self._weight_arr = _to_1d_float(self.weight, "weight", np.float32)
+        if self.init_score is not None:
+            self._init_score_arr = np.asarray(self.init_score, dtype=np.float64)
+        if self.group is not None:
+            g = _to_1d_float(self.group, "group", np.int64).astype(np.int64)
+            # Reference semantics: `group` is group SIZES (sum == num_data,
+            # Metadata::CheckOrPartition). Per-row query ids are accepted as a
+            # convenience when sizes don't fit; an all-ones vector of length
+            # num_data is ambiguous and resolves to sizes, like the reference.
+            if len(g) and g.sum() == self._num_data:
+                # group sizes
+                self._query_boundaries = np.concatenate([[0], np.cumsum(g)])
+            elif len(g) == self._num_data:
+                # per-row query ids
+                change = np.nonzero(np.diff(g))[0] + 1
+                self._query_boundaries = np.concatenate([[0], change, [len(g)]])
+            else:
+                raise LightGBMError("Length of group does not match data")
+        if self._label_arr is not None and len(self._label_arr) != self._num_data:
+            raise LightGBMError(
+                f"Length of label ({len(self._label_arr)}) != num_data "
+                f"({self._num_data})")
+        if self._weight_arr is not None and len(self._weight_arr) != self._num_data:
+            raise LightGBMError("Length of weight does not match data")
+
+    # ---------------------------------------------------------- field access
+    def set_label(self, label: Any) -> "Dataset":
+        self.label = label
+        if self._handle_constructed:
+            self._label_arr = _to_1d_float(label, "label", np.float32) \
+                if label is not None else None
+        self.version += 1
+        return self
+
+    def set_weight(self, weight: Any) -> "Dataset":
+        self.weight = weight
+        if self._handle_constructed:
+            self._weight_arr = _to_1d_float(weight, "weight", np.float32) \
+                if weight is not None else None
+        self.version += 1
+        return self
+
+    def set_group(self, group: Any) -> "Dataset":
+        self.group = group
+        if self._handle_constructed and group is not None:
+            self._set_all_fields()
+        self.version += 1
+        return self
+
+    def set_init_score(self, init_score: Any) -> "Dataset":
+        self.init_score = init_score
+        if self._handle_constructed:
+            self._init_score_arr = np.asarray(init_score, dtype=np.float64) \
+                if init_score is not None else None
+        self.version += 1
+        return self
+
+    def get_label(self) -> Optional[np.ndarray]:
+        return self._label_arr if self._handle_constructed else (
+            _to_1d_float(self.label, "label", np.float32)
+            if self.label is not None else None)
+
+    def get_weight(self) -> Optional[np.ndarray]:
+        return self._weight_arr
+
+    def get_group(self) -> Optional[np.ndarray]:
+        if self._query_boundaries is None:
+            return None
+        return np.diff(self._query_boundaries)
+
+    def get_init_score(self) -> Optional[np.ndarray]:
+        return self._init_score_arr
+
+    def get_field(self, field_name: str):
+        return {"label": self.get_label(), "weight": self.get_weight(),
+                "group": self.get_group(), "init_score": self.get_init_score(),
+                }.get(field_name)
+
+    def set_field(self, field_name: str, data: Any) -> "Dataset":
+        return {"label": self.set_label, "weight": self.set_weight,
+                "group": self.set_group, "init_score": self.set_init_score,
+                }[field_name](data)
+
+    def get_feature_name(self) -> List[str]:
+        if self._feature_names is not None:
+            return list(self._feature_names)
+        return _feature_names_from(self.data, self.num_feature(),
+                                   None if self.feature_name == "auto"
+                                   else self.feature_name)
+
+    def set_feature_name(self, feature_name: Sequence[str]) -> "Dataset":
+        self.feature_name = list(feature_name)
+        if self._handle_constructed:
+            if len(feature_name) != self._num_feature:
+                raise LightGBMError("Length of feature_name doesn't match")
+            self._feature_names = [str(s) for s in feature_name]
+        return self
+
+    def set_categorical_feature(self, categorical_feature) -> "Dataset":
+        if self._handle_constructed and \
+                categorical_feature != self.categorical_feature:
+            raise LightGBMError("Cannot set categorical feature after constructed; "
+                                "set free_raw_data=False to allow re-construction")
+        self.categorical_feature = categorical_feature
+        return self
+
+    # --------------------------------------------------------------- subset
+    def subset(self, used_indices: Sequence[int],
+               params: Optional[dict] = None) -> "Dataset":
+        """Row subset sharing this dataset's bins
+        (ref: basic.py `Dataset.subset` → `LGBM_DatasetGetSubset`)."""
+        ret = Dataset(None, reference=self,
+                      feature_name=self.feature_name,
+                      categorical_feature=self.categorical_feature,
+                      params=params if params is not None else self.params,
+                      free_raw_data=self.free_raw_data)
+        ret.used_indices = np.sort(np.asarray(used_indices, dtype=np.int64))
+        return ret
+
+    def create_valid(self, data: Any, label: Any = None, weight: Any = None,
+                     group: Any = None, init_score: Any = None,
+                     params: Optional[dict] = None, position: Any = None) -> "Dataset":
+        """Validation set binned with this dataset's mappers
+        (ref: basic.py `Dataset.create_valid`)."""
+        return Dataset(data, label=label, reference=self, weight=weight,
+                       group=group, init_score=init_score,
+                       feature_name=self.feature_name,
+                       categorical_feature=self.categorical_feature,
+                       params=params if params is not None else self.params,
+                       free_raw_data=self.free_raw_data, position=position)
+
+    # -------------------------------------------------------------- persist
+    def save_binary(self, filename: str) -> "Dataset":
+        """Binary dataset cache (ref: Dataset::SaveBinaryFile; .bin files).
+
+        We use numpy's npz container rather than the reference's custom binary
+        layout — the function contract (fast reload skipping binning) is the same.
+        """
+        self.construct()
+        # write via an open handle so numpy cannot silently append ".npz"
+        with open(filename, "wb") as fh:
+            self._savez(fh)
+        return self
+
+    def _savez(self, fh) -> None:
+        np.savez_compressed(
+            fh,
+            bin_data=np.asarray(self.bin_data),
+            mappers=json.dumps([m.to_dict() for m in self.bin_mappers]),
+            label=self._label_arr if self._label_arr is not None else np.array([]),
+            weight=self._weight_arr if self._weight_arr is not None else np.array([]),
+            query=self._query_boundaries if self._query_boundaries is not None
+            else np.array([]),
+            feature_names=json.dumps(self._feature_names),
+            categorical=np.asarray(self._categorical_indices, dtype=np.int64),
+        )
+
+    @classmethod
+    def load_binary(cls, filename: str) -> "Dataset":
+        z = np.load(filename, allow_pickle=False)
+        ds = cls(None, free_raw_data=False)
+        ds.bin_data = z["bin_data"]
+        ds.bin_mappers = [BinMapper.from_dict(d) for d in json.loads(str(z["mappers"]))]
+        ds._num_data, ds._num_feature = ds.bin_data.shape
+        ds.num_total_bin = sum(m.num_bin for m in ds.bin_mappers)
+        ds._feature_names = json.loads(str(z["feature_names"]))
+        ds._categorical_indices = z["categorical"].tolist()
+        if len(z["label"]):
+            ds._label_arr = z["label"]
+        if len(z["weight"]):
+            ds._weight_arr = z["weight"]
+        if len(z["query"]):
+            ds._query_boundaries = z["query"]
+        ds._handle_constructed = True
+        return ds
+
+    def get_data(self):
+        if self.data is None and self.free_raw_data:
+            raise LightGBMError("Raw data was freed (free_raw_data=True)")
+        return self.data
+
+    def num_total_data(self) -> int:
+        return self.num_data()
+
+    def add_features_from(self, other: "Dataset") -> "Dataset":
+        self.construct()
+        other.construct()
+        self.bin_data = np.concatenate(
+            [np.asarray(self.bin_data), np.asarray(other.bin_data)], axis=1)
+        self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
+        self._feature_names = list(self._feature_names) + list(other._feature_names)
+        self._categorical_indices = (
+            list(self._categorical_indices) +
+            [i + self._num_feature for i in other._categorical_indices])
+        self._num_feature += other._num_feature
+        self.num_total_bin += other.num_total_bin
+        return self

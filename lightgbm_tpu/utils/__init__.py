@@ -1,0 +1,1 @@
+from . import binning, config, log  # noqa: F401

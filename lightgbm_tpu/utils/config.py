@@ -1,0 +1,371 @@
+"""Parameter/config system.
+
+TPU-native re-design of the reference's config layer
+(ref: include/LightGBM/config.h `Config`; src/io/config.cpp `Config::Set`,
+`Config::CheckParamConflict`; src/io/config_auto.cpp alias table generated from
+docs/Parameters.rst by helpers/parameter_generator.py).
+
+Instead of codegen'd C++ we keep a single declarative ``_PARAMS`` spec (the
+"docs as source of truth" idea) from which the alias map and the typed Config
+object are derived at import time.  Every LightGBM parameter name is accepted;
+parameters that have no meaning on TPU (thread counts, gpu ids, ...) are
+accepted and ignored with a debug note so user configs are drop-in.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from . import log
+
+# name -> (default, type, aliases)
+# Types: bool/int/float/str, or list variants ("vec_double", "vec_int", "vec_str").
+_PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
+    # ---- core ----
+    "config": ("", "str", ("config_file",)),
+    "task": ("train", "str", ("task_type",)),
+    "objective": ("regression", "str", ("objective_type", "app", "application", "loss")),
+    "boosting": ("gbdt", "str", ("boosting_type", "boost")),
+    "data_sample_strategy": ("bagging", "str", ()),
+    "data": ("", "str", ("train", "train_data", "train_data_file", "data_filename")),
+    "valid": ([], "vec_str", ("test", "valid_data", "valid_data_file", "test_data",
+                              "test_data_file", "valid_filenames")),
+    "num_iterations": (100, "int", ("num_iteration", "n_iter", "num_tree", "num_trees",
+                                    "num_round", "num_rounds", "nrounds",
+                                    "num_boost_round", "n_estimators", "max_iter")),
+    "learning_rate": (0.1, "float", ("shrinkage_rate", "eta")),
+    "num_leaves": (31, "int", ("num_leaf", "max_leaves", "max_leaf", "max_leaf_nodes")),
+    "tree_learner": ("serial", "str", ("tree", "tree_type", "tree_learner_type")),
+    "num_threads": (0, "int", ("num_thread", "nthread", "nthreads", "n_jobs")),
+    "device_type": ("tpu", "str", ("device",)),
+    "seed": (None, "int_or_none", ("random_seed", "random_state")),
+    "deterministic": (False, "bool", ()),
+    # ---- learning control ----
+    "force_col_wise": (False, "bool", ()),
+    "force_row_wise": (False, "bool", ()),
+    "histogram_pool_size": (-1.0, "float", ("hist_pool_size",)),
+    "max_depth": (-1, "int", ()),
+    "min_data_in_leaf": (20, "int", ("min_data_per_leaf", "min_data", "min_child_samples",
+                                     "min_samples_leaf")),
+    "min_sum_hessian_in_leaf": (1e-3, "float", ("min_sum_hessian_per_leaf", "min_sum_hessian",
+                                                "min_hessian", "min_child_weight")),
+    "bagging_fraction": (1.0, "float", ("sub_row", "subsample", "bagging")),
+    "pos_bagging_fraction": (1.0, "float", ("pos_sub_row", "pos_subsample", "pos_bagging")),
+    "neg_bagging_fraction": (1.0, "float", ("neg_sub_row", "neg_subsample", "neg_bagging")),
+    "bagging_freq": (0, "int", ("subsample_freq",)),
+    "bagging_seed": (3, "int", ("bagging_fraction_seed",)),
+    "feature_fraction": (1.0, "float", ("sub_feature", "colsample_bytree")),
+    "feature_fraction_bynode": (1.0, "float", ("sub_feature_bynode", "colsample_bynode")),
+    "feature_fraction_seed": (2, "int", ()),
+    "extra_trees": (False, "bool", ("extra_tree",)),
+    "extra_seed": (6, "int", ()),
+    "early_stopping_round": (0, "int", ("early_stopping_rounds", "early_stopping",
+                                        "n_iter_no_change")),
+    "first_metric_only": (False, "bool", ()),
+    "max_delta_step": (0.0, "float", ("max_tree_output", "max_leaf_output")),
+    "lambda_l1": (0.0, "float", ("reg_alpha", "l1_regularization")),
+    "lambda_l2": (0.0, "float", ("reg_lambda", "lambda", "l2_regularization")),
+    "linear_lambda": (0.0, "float", ()),
+    "min_gain_to_split": (0.0, "float", ("min_split_gain",)),
+    "drop_rate": (0.1, "float", ("rate_drop",)),
+    "max_drop": (50, "int", ()),
+    "skip_drop": (0.5, "float", ()),
+    "xgboost_dart_mode": (False, "bool", ()),
+    "uniform_drop": (False, "bool", ()),
+    "drop_seed": (4, "int", ()),
+    "top_rate": (0.2, "float", ()),
+    "other_rate": (0.1, "float", ()),
+    "min_data_per_group": (100, "int", ()),
+    "max_cat_threshold": (32, "int", ()),
+    "cat_l2": (10.0, "float", ()),
+    "cat_smooth": (10.0, "float", ()),
+    "max_cat_to_onehot": (4, "int", ()),
+    "top_k": (20, "int", ("topk",)),
+    "monotone_constraints": ([], "vec_int", ("mc", "monotone_constraint", "monotonic_cst")),
+    "monotone_constraints_method": ("basic", "str", ("monotone_constraining_method", "mc_method")),
+    "monotone_penalty": (0.0, "float", ("monotone_splits_penalty", "ms_penalty", "mc_penalty")),
+    "feature_contri": ([], "vec_double", ("feature_contrib", "fc", "fp", "feature_penalty")),
+    "forcedsplits_filename": ("", "str", ("fs", "forced_splits_filename", "forced_splits_file",
+                                          "forced_splits")),
+    "refit_decay_rate": (0.9, "float", ()),
+    "cegb_tradeoff": (1.0, "float", ()),
+    "cegb_penalty_split": (0.0, "float", ()),
+    "cegb_penalty_feature_lazy": ([], "vec_double", ()),
+    "cegb_penalty_feature_coupled": ([], "vec_double", ()),
+    "path_smooth": (0.0, "float", ()),
+    "interaction_constraints": ("", "str", ()),
+    "verbosity": (1, "int", ("verbose",)),
+    # ---- dataset ----
+    "linear_tree": (False, "bool", ("linear_trees",)),
+    "max_bin": (255, "int", ("max_bins",)),
+    "max_bin_by_feature": ([], "vec_int", ()),
+    "min_data_in_bin": (3, "int", ()),
+    "bin_construct_sample_cnt": (200000, "int", ("subsample_for_bin",)),
+    "data_random_seed": (1, "int", ("data_seed",)),
+    "is_enable_sparse": (True, "bool", ("is_sparse", "enable_sparse", "sparse")),
+    "enable_bundle": (True, "bool", ("is_enable_bundle", "bundle")),
+    "use_missing": (True, "bool", ()),
+    "zero_as_missing": (False, "bool", ()),
+    "feature_pre_filter": (True, "bool", ()),
+    "pre_partition": (False, "bool", ("is_pre_partition",)),
+    "two_round": (False, "bool", ("two_round_loading", "use_two_round_loading")),
+    "header": (False, "bool", ("has_header",)),
+    "label_column": ("", "str", ("label",)),
+    "weight_column": ("", "str", ("weight",)),
+    "group_column": ("", "str", ("group", "group_id", "query_column", "query", "query_id")),
+    "ignore_column": ("", "str", ("ignore_feature", "blacklist")),
+    "categorical_feature": ("", "str", ("cat_feature", "categorical_column", "cat_column",
+                                        "categorical_features")),
+    "forcedbins_filename": ("", "str", ()),
+    "save_binary": (False, "bool", ("is_save_binary", "is_save_binary_file")),
+    "precise_float_parser": (False, "bool", ()),
+    "parser_config_file": ("", "str", ()),
+    # ---- predict ----
+    "start_iteration_predict": (0, "int", ()),
+    "num_iteration_predict": (-1, "int", ()),
+    "predict_raw_score": (False, "bool", ("is_predict_raw_score", "predict_rawscore",
+                                          "raw_score")),
+    "predict_leaf_index": (False, "bool", ("is_predict_leaf_index", "leaf_index")),
+    "predict_contrib": (False, "bool", ("is_predict_contrib", "contrib")),
+    "predict_disable_shape_check": (False, "bool", ()),
+    "pred_early_stop": (False, "bool", ()),
+    "pred_early_stop_freq": (10, "int", ()),
+    "pred_early_stop_margin": (10.0, "float", ()),
+    "output_result": ("LightGBM_predict_result.txt", "str",
+                      ("predict_result", "prediction_result", "predict_name",
+                       "prediction_name", "pred_name", "name_pred")),
+    # ---- convert ----
+    "convert_model_language": ("", "str", ()),
+    "convert_model": ("gbdt_prediction.cpp", "str", ("convert_model_file",)),
+    # ---- objective params ----
+    "objective_seed": (5, "int", ()),
+    "num_class": (1, "int", ("num_classes",)),
+    "is_unbalance": (False, "bool", ("unbalance", "unbalanced_sets")),
+    "scale_pos_weight": (1.0, "float", ()),
+    "sigmoid": (1.0, "float", ()),
+    "boost_from_average": (True, "bool", ()),
+    "reg_sqrt": (False, "bool", ()),
+    "alpha": (0.9, "float", ()),
+    "fair_c": (1.0, "float", ()),
+    "poisson_max_delta_step": (0.7, "float", ()),
+    "tweedie_variance_power": (1.5, "float", ()),
+    "lambdarank_truncation_level": (30, "int", ()),
+    "lambdarank_norm": (True, "bool", ()),
+    "label_gain": ([], "vec_double", ()),
+    "lambdarank_position_bias_regularization": (0.0, "float", ()),
+    # ---- metric ----
+    "metric": ([], "vec_str", ("metrics", "metric_types")),
+    "metric_freq": (1, "int", ("output_freq",)),
+    "is_provide_training_metric": (False, "bool", ("training_metric", "is_training_metric",
+                                                   "train_metric")),
+    "eval_at": ([1, 2, 3, 4, 5], "vec_int", ("ndcg_eval_at", "ndcg_at", "map_eval_at", "at")),
+    "multi_error_top_k": (1, "int", ()),
+    "auc_mu_weights": ([], "vec_double", ()),
+    # ---- network ----
+    "num_machines": (1, "int", ("num_machine",)),
+    "local_listen_port": (12400, "int", ("local_port", "port")),
+    "time_out": (120, "int", ()),
+    "machine_list_filename": ("", "str", ("machine_list_file", "machine_list", "mlist")),
+    "machines": ("", "str", ("workers", "nodes")),
+    # ---- GPU (accepted, ignored on TPU) ----
+    "gpu_platform_id": (-1, "int", ()),
+    "gpu_device_id": (-1, "int", ()),
+    "gpu_use_dp": (False, "bool", ()),
+    "num_gpu": (1, "int", ()),
+    # ---- quantized training (v4) ----
+    "use_quantized_grad": (False, "bool", ()),
+    "num_grad_quant_bins": (4, "int", ()),
+    "quant_train_renew_leaf": (False, "bool", ()),
+    "stochastic_rounding": (True, "bool", ()),
+    # ---- TPU-specific (new; no reference counterpart) ----
+    "tpu_row_tile": (0, "int", ()),          # 0 = auto
+    "tpu_use_pallas": (True, "bool", ()),    # use pallas histogram kernel when available
+    "tpu_num_shards": (0, "int", ()),        # 0 = all visible devices
+    "saved_feature_importance_type": (0, "int", ()),
+    "snapshot_freq": (-1, "int", ("save_period",)),
+    "output_model": ("LightGBM_model.txt", "str", ("model_output", "model_out")),
+    "input_model": ("", "str", ("model_input", "model_in")),
+}
+
+# Build alias -> canonical map.
+_ALIASES: Dict[str, str] = {}
+for _name, (_d, _t, _al) in _PARAMS.items():
+    _ALIASES[_name] = _name
+    for _a in _al:
+        _ALIASES[_a] = _name
+
+_OBJECTIVE_ALIASES = {
+    "regression": "regression", "regression_l2": "regression", "l2": "regression",
+    "mean_squared_error": "regression", "mse": "regression", "l2_root": "regression",
+    "root_mean_squared_error": "regression", "rmse": "regression",
+    "regression_l1": "regression_l1", "l1": "regression_l1",
+    "mean_absolute_error": "regression_l1", "mae": "regression_l1",
+    "huber": "huber", "fair": "fair", "poisson": "poisson", "quantile": "quantile",
+    "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "gamma": "gamma", "tweedie": "tweedie",
+    "binary": "binary",
+    "multiclass": "multiclass", "softmax": "multiclass",
+    "multiclassova": "multiclassova", "multiclass_ova": "multiclassova",
+    "ova": "multiclassova", "ovr": "multiclassova",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "lambdarank": "lambdarank", "rank_xendcg": "rank_xendcg",
+    "xendcg": "rank_xendcg", "xe_ndcg": "rank_xendcg", "xe_ndcg_mart": "rank_xendcg",
+    "xendcg_mart": "rank_xendcg",
+    "custom": "custom", "none": "custom", "null": "custom", "na": "custom",
+}
+
+_METRIC_ALIASES = {
+    "l1": "l1", "mean_absolute_error": "l1", "mae": "l1", "regression_l1": "l1",
+    "l2": "l2", "mean_squared_error": "l2", "mse": "l2", "regression_l2": "l2",
+    "regression": "l2",
+    "rmse": "rmse", "root_mean_squared_error": "rmse", "l2_root": "rmse",
+    "quantile": "quantile", "mape": "mape", "mean_absolute_percentage_error": "mape",
+    "huber": "huber", "fair": "fair", "poisson": "poisson", "gamma": "gamma",
+    "gamma_deviance": "gamma_deviance", "tweedie": "tweedie",
+    "ndcg": "ndcg", "lambdarank": "ndcg", "rank_xendcg": "ndcg", "xendcg": "ndcg",
+    "map": "map", "mean_average_precision": "map",
+    "auc": "auc", "average_precision": "average_precision",
+    "binary_logloss": "binary_logloss", "binary": "binary_logloss",
+    "binary_error": "binary_error",
+    "auc_mu": "auc_mu",
+    "multi_logloss": "multi_logloss", "multiclass": "multi_logloss",
+    "softmax": "multi_logloss", "multiclassova": "multi_logloss",
+    "multi_error": "multi_error",
+    "cross_entropy": "cross_entropy", "xentropy": "cross_entropy",
+    "cross_entropy_lambda": "cross_entropy_lambda", "xentlambda": "cross_entropy_lambda",
+    "kullback_leibler": "kldiv", "kldiv": "kldiv",
+    "none": "", "na": "", "null": "", "custom": "",
+}
+
+
+def _coerce(value: Any, typ: str, name: str) -> Any:
+    if typ == "bool":
+        if isinstance(value, bool):
+            return value
+        if isinstance(value, (int, float)):
+            return bool(value)
+        if isinstance(value, str):
+            return value.lower() in ("true", "1", "+", "yes")
+        return bool(value)
+    if typ == "int":
+        return int(value)
+    if typ == "int_or_none":
+        return None if value is None else int(value)
+    if typ == "float":
+        return float(value)
+    if typ == "str":
+        return str(value)
+    if typ in ("vec_double", "vec_int", "vec_str"):
+        elem = {"vec_double": float, "vec_int": int, "vec_str": str}[typ]
+        if isinstance(value, str):
+            value = [v for v in value.replace(" ", ",").split(",") if v != ""]
+        if not isinstance(value, (list, tuple)):
+            value = [value]
+        return [elem(v) for v in value]
+    raise ValueError(f"unknown param type {typ} for {name}")
+
+
+class Config:
+    """Typed parameter holder with LightGBM alias resolution.
+
+    ``Config(params_dict)`` resolves aliases (first-written wins for the
+    canonical name, matching `Config::GetMembersOfAllAlias` precedence of the
+    canonical name over aliases), coerces types, and runs conflict checks
+    (ref: src/io/config.cpp `Config::CheckParamConflict`).
+    """
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None):
+        for name, (default, _typ, _al) in _PARAMS.items():
+            setattr(self, name, copy.copy(default))
+        self.raw_params: Dict[str, Any] = {}
+        self.unknown_params: Dict[str, Any] = {}
+        if params:
+            self.update(params)
+
+    def update(self, params: Dict[str, Any]) -> None:
+        resolved: Dict[str, Any] = {}
+        for key, value in params.items():
+            if value is None and key not in ("seed",):
+                continue
+            canonical = _ALIASES.get(key)
+            if canonical is None:
+                self.unknown_params[key] = value
+                log.warning(f"Unknown parameter: {key}")
+                continue
+            # canonical name literally present wins over aliases
+            if canonical in resolved and canonical in params and key != canonical:
+                continue
+            resolved[canonical] = value
+        for name, value in resolved.items():
+            _d, typ, _a = _PARAMS[name]
+            setattr(self, name, _coerce(value, typ, name))
+        self.raw_params.update(params)
+        self._explicit = getattr(self, "_explicit", set()) | set(resolved)
+        self._check_param_conflict()
+
+    def _check_param_conflict(self) -> None:
+        obj = _OBJECTIVE_ALIASES.get(str(self.objective), self.objective)
+        self.objective = obj
+        self.metric = [_METRIC_ALIASES.get(m, m) for m in self.metric if
+                       _METRIC_ALIASES.get(m, m) != ""]
+        if obj in ("multiclass", "multiclassova") and self.num_class <= 1:
+            log.fatal("Number of classes should be specified and greater than 1 "
+                      "for multiclass training")
+        if obj not in ("multiclass", "multiclassova") and self.num_class != 1 and \
+                obj != "custom":
+            log.fatal(f"Number of classes must be 1 for non-multiclass training, "
+                      f"got num_class={self.num_class} objective={obj}")
+        if self.is_unbalance and self.scale_pos_weight != 1.0:
+            log.fatal("Cannot set is_unbalance and scale_pos_weight at the same time")
+        if self.bagging_freq > 0 and (self.pos_bagging_fraction < 1.0 or
+                                      self.neg_bagging_fraction < 1.0):
+            if obj != "binary":
+                log.fatal("Unbalanced bagging is only available for binary objective")
+        if self.max_depth > 0:
+            full = 1 << min(self.max_depth, 30)
+            if self.num_leaves > full:
+                self.num_leaves = full
+        if self.num_leaves < 2:
+            self.num_leaves = 2
+        if self.seed is not None:
+            # derived seeds, same derivation idea as Config::Set in config.cpp;
+            # explicitly-passed component seeds win over the derived ones
+            explicit = getattr(self, "_explicit", set())
+            for offset, name in ((1, "data_random_seed"), (2, "bagging_seed"),
+                                 (4, "drop_seed"), (5, "feature_fraction_seed"),
+                                 (6, "extra_seed"), (7, "objective_seed")):
+                if name not in explicit:
+                    setattr(self, name, self.seed + offset)
+        log.set_verbosity(self.verbosity)
+
+    def default_metric(self) -> List[str]:
+        """Metric implied by the objective when none is given
+        (ref: objective `DefaultEvalAt`/metric factory convention)."""
+        obj = self.objective
+        implied = {
+            "regression": ["l2"], "regression_l1": ["l1"], "huber": ["huber"],
+            "fair": ["fair"], "poisson": ["poisson"], "quantile": ["quantile"],
+            "mape": ["mape"], "gamma": ["gamma"], "tweedie": ["tweedie"],
+            "binary": ["binary_logloss"],
+            "multiclass": ["multi_logloss"], "multiclassova": ["multi_logloss"],
+            "cross_entropy": ["cross_entropy"],
+            "cross_entropy_lambda": ["cross_entropy_lambda"],
+            "lambdarank": ["ndcg"], "rank_xendcg": ["ndcg"],
+        }
+        return implied.get(obj, [])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {name: getattr(self, name) for name in _PARAMS}
+
+
+def canonical_param_name(name: str) -> Optional[str]:
+    return _ALIASES.get(name)
+
+
+def resolve_objective(name: str) -> str:
+    return _OBJECTIVE_ALIASES.get(name, name)
+
+
+def resolve_metric(name: str) -> str:
+    return _METRIC_ALIASES.get(name, name)

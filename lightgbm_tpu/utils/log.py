@@ -1,0 +1,63 @@
+"""Logging for lightgbm_tpu.
+
+Mirrors the reference's Log class + registerable callback
+(ref: include/LightGBM/utils/log.h `Log`, python-package/lightgbm/basic.py
+`_log_callback` / `register_logger`): Fatal raises, Warning/Info/Debug route
+through a swappable Python logger.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+_logger: Any = logging.getLogger("lightgbm_tpu")
+_logger.setLevel(logging.INFO)
+if not _logger.handlers:
+    _h = logging.StreamHandler()
+    _h.setFormatter(logging.Formatter("[LightGBM-TPU] %(message)s"))
+    _logger.addHandler(_h)
+
+_info_method_name = "info"
+_warning_method_name = "warning"
+
+# LightGBM verbosity: <0 fatal only, 0 warning+, 1 info+ (default), >1 debug+
+_verbosity = 1
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = int(level)
+
+
+def register_logger(logger: Any, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    """Register a custom logger (parity with lightgbm.register_logger)."""
+    global _logger, _info_method_name, _warning_method_name
+    if not all(hasattr(logger, m) for m in (info_method_name, warning_method_name)):
+        raise TypeError("Logger must provide info and warning methods")
+    _logger = logger
+    _info_method_name = info_method_name
+    _warning_method_name = warning_method_name
+
+
+def debug(msg: str) -> None:
+    if _verbosity > 1:
+        getattr(_logger, _info_method_name)(msg)
+
+
+def info(msg: str) -> None:
+    if _verbosity >= 1:
+        getattr(_logger, _info_method_name)(msg)
+
+
+def warning(msg: str) -> None:
+    if _verbosity >= 0:
+        getattr(_logger, _warning_method_name)(msg)
+
+
+class LightGBMError(Exception):
+    """Error raised by lightgbm_tpu (parity with lightgbm.basic.LightGBMError)."""
+
+
+def fatal(msg: str) -> None:
+    raise LightGBMError(msg)

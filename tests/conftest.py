@@ -1,0 +1,16 @@
+"""Test config: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; per the project plan the
+distributed (data-parallel tree learner) tests validate sharding semantics on
+8 virtual CPU devices, and the driver separately dry-run-compiles the
+multi-chip path via `__graft_entry__.dryrun_multichip`.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# NOTE: x64 deliberately NOT enabled — tests must exercise the same f32
+# accumulation behavior the real TPU path uses.

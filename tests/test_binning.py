@@ -1,0 +1,120 @@
+"""Binning tests (model: tests/python_package_test/test_basic.py Dataset slices)."""
+import numpy as np
+import pytest
+
+from lightgbm_tpu.utils.binning import (BIN_TYPE_CATEGORICAL, BinMapper,
+                                        MISSING_TYPE_NAN, MISSING_TYPE_NONE,
+                                        greedy_find_bin)
+
+
+def test_greedy_find_bin_few_distinct():
+    vals = np.array([1.0, 2.0, 3.0])
+    counts = np.array([10, 10, 10])
+    bounds = greedy_find_bin(vals, counts, 3, 255, 30, 3)
+    assert bounds[-1] == np.inf
+    assert bounds[0] == pytest.approx(1.5)
+    assert bounds[1] == pytest.approx(2.5)
+
+
+def test_greedy_find_bin_respects_min_data_in_bin():
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    counts = np.array([1, 1, 1, 27])
+    bounds = greedy_find_bin(vals, counts, 4, 255, 30, 3)
+    # values 1,2,3 must be merged until >= 3 samples accumulate
+    assert len(bounds) <= 3
+
+
+def test_binmapper_roundtrip_uniform():
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1, 1, 10000)
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=255)
+    assert 2 < m.num_bin <= 255
+    bins = m.values_to_bins(x)
+    assert bins.min() >= 0 and bins.max() < m.num_bin
+    # every value must satisfy: value <= upper_bound[bin] and > upper_bound[bin-1]
+    ub = m.bin_upper_bound
+    for v, b in zip(x[:500], bins[:500]):
+        assert v <= ub[b] + 1e-12
+        if b > 0:
+            assert v > ub[b - 1] - 1e-12
+    # scalar path agrees with vectorized path
+    for v in x[:100]:
+        assert m.value_to_bin(v) == m.values_to_bins(np.array([v]))[0]
+
+
+def test_binmapper_nan_bin():
+    x = np.array([1.0, 2.0, np.nan, 3.0, np.nan] * 20)
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=255)
+    assert m.missing_type == MISSING_TYPE_NAN
+    bins = m.values_to_bins(x)
+    assert (bins[np.isnan(x)] == m.num_bin - 1).all()
+    assert (bins[~np.isnan(x)] < m.num_bin - 1).all()
+
+
+def test_binmapper_no_missing():
+    x = np.linspace(0, 1, 100)
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=64)
+    assert m.missing_type == MISSING_TYPE_NONE
+    assert m.num_bin <= 64
+
+
+def test_binmapper_zero_bin():
+    # heavy zeros: zero must land in its own bin
+    x = np.concatenate([np.zeros(500), np.linspace(1, 2, 100), -np.linspace(1, 2, 100)])
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=255)
+    zb = m.value_to_bin(0.0)
+    nb_neg = m.value_to_bin(-1.5)
+    nb_pos = m.value_to_bin(1.5)
+    assert zb != nb_neg and zb != nb_pos
+    assert m.default_bin == zb
+
+
+def test_binmapper_trivial():
+    x = np.full(100, 7.0)
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=255)
+    assert m.is_trivial
+
+
+def test_binmapper_categorical():
+    rng = np.random.RandomState(1)
+    x = rng.choice([3, 7, 11, 200], size=1000, p=[0.5, 0.3, 0.15, 0.05]).astype(float)
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=255, bin_type=BIN_TYPE_CATEGORICAL)
+    bins = m.values_to_bins(x)
+    # most frequent category gets bin 1
+    assert m.value_to_bin(3.0) == 1
+    assert (bins > 0).all()
+    # unseen category → bin 0
+    assert m.value_to_bin(999.0) == 0
+    # NaN → bin 0
+    assert m.value_to_bin(float("nan")) == 0
+    # round-trip: bin_to_value returns the category
+    assert m.bin_to_value(1) == 3.0
+
+
+def test_binmapper_serialization():
+    rng = np.random.RandomState(2)
+    x = rng.normal(size=5000)
+    x[::7] = np.nan
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=63)
+    m2 = BinMapper.from_dict(m.to_dict())
+    np.testing.assert_array_equal(m.values_to_bins(x), m2.values_to_bins(x))
+    assert m2.num_bin == m.num_bin
+
+
+def test_bin_to_value_is_upper_bound():
+    rng = np.random.RandomState(3)
+    x = rng.uniform(0, 10, 1000)
+    m = BinMapper()
+    m.find_bin(x, len(x), max_bin=16)
+    for b in range(m.num_bin - 1):
+        thr = m.bin_to_value(b)
+        # every value binned at <= b must be <= thr
+        bins = m.values_to_bins(x)
+        assert (x[bins <= b] <= thr + 1e-12).all()

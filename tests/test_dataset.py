@@ -1,0 +1,110 @@
+"""Dataset semantics tests (model: tests/python_package_test/test_basic.py)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Dataset
+
+
+def make_data(n=500, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float64)
+    return X, y
+
+
+def test_construct_basic():
+    X, y = make_data()
+    ds = Dataset(X, label=y).construct()
+    assert ds.num_data() == 500
+    assert ds.num_feature() == 5
+    assert ds.bin_data.shape == (500, 5)
+    assert ds.bin_data.dtype == np.uint8
+    np.testing.assert_allclose(ds.get_label(), y)
+
+
+def test_free_raw_data():
+    X, y = make_data()
+    ds = Dataset(X, label=y, free_raw_data=True).construct()
+    assert ds.data is None
+    with pytest.raises(lgb.LightGBMError):
+        ds.get_data()
+    ds2 = Dataset(X, label=y, free_raw_data=False).construct()
+    assert ds2.get_data() is X
+
+
+def test_reference_shares_bins():
+    X, y = make_data()
+    Xv, yv = make_data(seed=1)
+    train = Dataset(X, label=y).construct()
+    valid = train.create_valid(Xv, label=yv).construct()
+    assert valid.bin_mappers is train.bin_mappers
+    # same value must map to same bin in both datasets
+    assert valid.bin_data.shape == (500, 5)
+
+
+def test_subset():
+    X, y = make_data()
+    ds = Dataset(X, label=y).construct()
+    sub = ds.subset(np.arange(100)).construct()
+    assert sub.num_data() == 100
+    np.testing.assert_array_equal(np.asarray(sub.bin_data),
+                                  np.asarray(ds.bin_data)[:100])
+    np.testing.assert_allclose(sub.get_label(), y[:100])
+
+
+def test_fields():
+    X, y = make_data()
+    w = np.random.RandomState(0).uniform(0.5, 2.0, len(y))
+    ds = Dataset(X, label=y, weight=w).construct()
+    np.testing.assert_allclose(ds.get_weight(), w, rtol=1e-6)
+    ds.set_field("weight", w * 2)
+    np.testing.assert_allclose(ds.get_field("weight"), w * 2, rtol=1e-6)
+
+
+def test_group_sizes_and_ids():
+    X, y = make_data(n=10)
+    # group sizes
+    ds = Dataset(X, label=y, group=[4, 6]).construct()
+    np.testing.assert_array_equal(ds.get_group(), [4, 6])
+    # per-row query ids
+    qid = np.array([0, 0, 0, 0, 1, 1, 1, 1, 1, 1])
+    ds2 = Dataset(X, label=y, group=qid).construct()
+    np.testing.assert_array_equal(ds2.get_group(), [4, 6])
+
+
+def test_feature_names():
+    X, y = make_data(f=3)
+    ds = Dataset(X, label=y, feature_name=["a", "b", "c"]).construct()
+    assert ds.get_feature_name() == ["a", "b", "c"]
+    ds_auto = Dataset(X, label=y).construct()
+    assert ds_auto.get_feature_name() == ["Column_0", "Column_1", "Column_2"]
+
+
+def test_save_load_binary(tmp_path):
+    X, y = make_data()
+    ds = Dataset(X, label=y).construct()
+    path = str(tmp_path / "ds.npz")
+    ds.save_binary(path)
+    ds2 = Dataset.load_binary(path)
+    np.testing.assert_array_equal(np.asarray(ds2.bin_data), np.asarray(ds.bin_data))
+    np.testing.assert_allclose(ds2.get_label(), ds.get_label())
+    assert ds2.num_total_bin == ds.num_total_bin
+
+
+def test_label_length_mismatch():
+    X, _ = make_data()
+    with pytest.raises(lgb.LightGBMError):
+        Dataset(X, label=np.zeros(10)).construct()
+
+
+def test_categorical_feature_by_name():
+    rng = np.random.RandomState(0)
+    X = np.column_stack([rng.normal(size=200),
+                         rng.choice([1.0, 2.0, 3.0], size=200)])
+    y = rng.normal(size=200)
+    ds = Dataset(X, label=y, feature_name=["num", "cat"],
+                 categorical_feature=["cat"]).construct()
+    assert ds._categorical_indices == [1]
+    from lightgbm_tpu.utils.binning import BIN_TYPE_CATEGORICAL
+    assert ds.bin_mappers[1].bin_type == BIN_TYPE_CATEGORICAL

@@ -1,0 +1,794 @@
+"""Booster — the user-facing training/prediction handle.
+
+TPU-native re-design of the reference's GBDT core + Booster wrapper
+(ref: src/boosting/gbdt.cpp `GBDT::{Init,TrainOneIter,UpdateScore}`;
+src/boosting/gbdt_model_text.cpp `GBDT::SaveModelToString` /
+`LoadModelFromString`; python-package/lightgbm/basic.py `Booster`;
+src/c_api.cpp `Booster` wrapper).
+
+Architecture: the host Python object owns (a) the device-resident training
+state — feature-major bin matrix, scores, per-feature metadata — and (b) the
+host-side model (list of `Tree`).  One boosting iteration is:
+grad/hess (jit) → grow_tree (single jitted XLA program) → tiny device→host
+sync of the flat tree → jitted score updates for train + valid sets.  This
+mirrors the reference CUDA learner's design point: gradients, bins and
+partitions never leave the device; only the finished tree structure does
+(ref: cuda_single_gpu_tree_learner.cpp).
+"""
+from __future__ import annotations
+
+import copy
+import io
+import json
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .basic import Dataset, _to_2d_float
+from .metrics import Metric, create_metrics
+from .objectives import ObjectiveFunction, create_objective
+from .ops.grow import DeviceTree, GrowerSpec, make_grower
+from .ops.predict import traverse_bins
+from .tree import Tree
+from .utils import log
+from .utils.binning import BIN_TYPE_CATEGORICAL
+from .utils.config import Config
+from .utils.log import LightGBMError
+
+__all__ = ["Booster"]
+
+
+class _DeviceData:
+    """Device-resident view of a constructed Dataset."""
+
+    def __init__(self, ds: Dataset):
+        ds.construct()
+        bins = np.asarray(ds.bin_data)
+        self.num_data, self.num_feature = bins.shape
+        self.bins_fm = jnp.asarray(np.ascontiguousarray(bins.T))  # [F, N]
+        mappers = ds.bin_mappers
+        self.feat_nb = jnp.asarray(
+            np.array([m.num_bin for m in mappers], dtype=np.int32))
+        self.feat_missing = jnp.asarray(
+            np.array([m.missing_type for m in mappers], dtype=np.int32))
+        self.feat_default = jnp.asarray(
+            np.array([m.default_bin for m in mappers], dtype=np.int32))
+        self.base_allowed = np.array(
+            [not m.is_trivial and m.bin_type != BIN_TYPE_CATEGORICAL
+             for m in mappers], dtype=bool)
+        self.max_bin = max(int(m.num_bin) for m in mappers)
+        label = ds.get_label()
+        self.label = jnp.asarray(label.astype(np.float32)) \
+            if label is not None else None
+        w = ds.get_weight()
+        self.weight = jnp.asarray(w.astype(np.float32)) if w is not None else None
+        self.init_score = ds.get_init_score()
+        self.query_boundaries = ds._query_boundaries
+
+
+def _traverse_padded(tree: Tree, num_leaves_cap: int, dd: _DeviceData,
+                     scale_values: np.ndarray) -> Tuple:
+    """Pad host tree arrays to fixed [cap-1]/[cap] so the jitted traversal
+    compiles once per shape."""
+    ni_cap = max(num_leaves_cap - 1, 1)
+    ni = tree.num_internal()
+
+    def pad(a, size, dtype):
+        out = np.zeros(size, dtype=dtype)
+        out[:len(a)] = a
+        return jnp.asarray(out)
+
+    feat = pad(tree.split_feature[:ni], ni_cap, np.int32)
+    thr = pad(tree.threshold_bin[:ni], ni_cap, np.int32)
+    dl = pad((tree.decision_type[:ni] & 2) != 0, ni_cap, bool)
+    left = pad(tree.left_child[:ni], ni_cap, np.int32)
+    right = pad(tree.right_child[:ni], ni_cap, np.int32)
+    vals = pad(scale_values, num_leaves_cap, np.float32)
+    return feat, thr, dl, left, right, vals
+
+
+_jit_traverse = jax.jit(traverse_bins)
+
+
+@jax.jit
+def _add_leaf_values(score, leaf_idx, values):
+    return score + values[leaf_idx]
+
+
+class Booster:
+    """Booster (API parity: python-package/lightgbm/basic.py `Booster`)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = copy.deepcopy(params) if params else {}
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self.trees: List[Tree] = []
+        self.pandas_categorical = None
+        self.train_set: Optional[Dataset] = None
+        self.valid_sets: List[Dataset] = []
+        self.name_valid_sets: List[str] = []
+        self._network_initialized = False
+        self.cur_iter = 0
+
+        if train_set is not None:
+            if not isinstance(train_set, Dataset):
+                raise TypeError(
+                    f"Training data should be Dataset instance, met "
+                    f"{type(train_set).__name__}")
+            self._init_train(train_set)
+        elif model_file is not None:
+            with open(model_file, "r") as f:
+                self.model_from_string(f.read())
+        elif model_str is not None:
+            self.model_from_string(model_str)
+        else:
+            raise TypeError("Need at least one training dataset or model "
+                            "file or model string to create Booster instance")
+
+    # ------------------------------------------------------------- training
+    def _init_train(self, train_set: Dataset) -> None:
+        # objective may be passed as a callable in params (v4 custom-objective
+        # path); normalize to "custom"
+        self._fobj = None
+        obj = self.params.get("objective")
+        if callable(obj):
+            self._fobj = obj
+            self.params["objective"] = "none"
+        self.config = Config(self.params)
+        train_set.params = {**(train_set.params or {}), **{
+            k: v for k, v in self.params.items()
+            if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+                     "use_missing", "zero_as_missing", "data_random_seed",
+                     "max_bin_by_feature", "feature_pre_filter")}}
+        self.train_set = train_set
+        self._dd = _DeviceData(train_set)
+        self.objective_: Optional[ObjectiveFunction] = \
+            create_objective(self.config)
+        self.num_tree_per_iteration = (
+            self.objective_.num_tree_per_iteration
+            if self.objective_ is not None else max(self.config.num_class, 1))
+        if self.objective_ is not None:
+            label = train_set.get_label()
+            if label is None:
+                raise LightGBMError("Label should not be None")
+            self.objective_.init_meta(
+                label.astype(np.float64), train_set.get_weight(),
+                train_set._query_boundaries)
+
+        metric_names = self.config.metric or self.config.default_metric()
+        self.metrics_: List[Metric] = create_metrics(self.config, metric_names)
+
+        self._grower_spec = GrowerSpec(
+            num_leaves=self.config.num_leaves,
+            max_depth=self.config.max_depth,
+            max_bin=self._dd.max_bin,
+            lambda_l1=self.config.lambda_l1,
+            lambda_l2=self.config.lambda_l2,
+            min_data_in_leaf=float(self.config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.config.min_gain_to_split,
+            max_delta_step=self.config.max_delta_step,
+        )
+        self._grower = make_grower(self._grower_spec)
+        self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
+
+        K = self.num_tree_per_iteration
+        self._init_scores = [0.0] * K
+        self._boost_from_average_done = False
+        self._train_score = self._zero_score(self._dd)
+        self._valid_dd: List[_DeviceData] = []
+        self._valid_scores: List[jax.Array] = []
+
+        if self.objective_ is not None:
+            lbl = self._dd.label
+            wgt = self._dd.weight
+
+            def _grad(score):
+                return self.objective_.grad_hess(score, lbl, wgt)
+            self._grad_fn = jax.jit(_grad)
+
+    def _zero_score(self, dd: _DeviceData) -> jax.Array:
+        K = self.num_tree_per_iteration
+        shape = (dd.num_data,) if K == 1 else (dd.num_data, K)
+        score = jnp.zeros(shape, dtype=jnp.float32)
+        if dd.init_score is not None:
+            s = np.asarray(dd.init_score, dtype=np.float32)
+            score = score + jnp.asarray(s.reshape(shape, order="F"))
+        return score
+
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        """ref: basic.py `Booster.add_valid` / LGBM_BoosterAddValidData."""
+        if data.reference is not self.train_set and \
+                data.reference is not None and \
+                data.bin_mappers is not self.train_set.bin_mappers:
+            pass  # constructed against the right reference below
+        if data.reference is None:
+            data.reference = self.train_set
+        dd = _DeviceData(data)
+        self.valid_sets.append(data)
+        self.name_valid_sets.append(name)
+        self._valid_dd.append(dd)
+        score = self._zero_score(dd)
+        # replay existing model onto the new valid set (continued training)
+        for it in range(self.cur_iter):
+            for k in range(self.num_tree_per_iteration):
+                tree = self.trees[it * self.num_tree_per_iteration + k]
+                score = self._apply_tree_to_score(
+                    score, tree, dd, k, bias_included=True)
+        self._valid_scores.append(score)
+        return self
+
+    def _boost_from_average(self) -> None:
+        cfg = self.config
+        if (self._boost_from_average_done or self.objective_ is None
+                or self._dd.init_score is not None):
+            return
+        self._boost_from_average_done = True
+        if not cfg.boost_from_average:
+            return
+        label = self.train_set.get_label().astype(np.float64)
+        weight = self.train_set.get_weight()
+        init = self.objective_.boost_from_score(label, weight)
+        inits = init if isinstance(init, list) else [init]
+        K = self.num_tree_per_iteration
+        if len(inits) == 1 and K > 1:
+            inits = inits * K
+        self._init_scores = [float(v) for v in inits]
+        if any(abs(v) > 1e-35 for v in self._init_scores):
+            add = np.asarray(self._init_scores, dtype=np.float32)
+            if K == 1:
+                self._train_score = self._train_score + add[0]
+                self._valid_scores = [s + add[0] for s in self._valid_scores]
+            else:
+                self._train_score = self._train_score + add[None, :]
+                self._valid_scores = [s + add[None, :]
+                                      for s in self._valid_scores]
+
+    def _sample_weights(self, iteration: int) -> jax.Array:
+        """Bagging mask (ref: GBDT::Bagging / bagging.hpp) — fixed-shape
+        0/1 weights instead of index subsets."""
+        cfg = self.config
+        n = self._dd.num_data
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            if not (cfg.pos_bagging_fraction < 1.0 or
+                    cfg.neg_bagging_fraction < 1.0):
+                return self._ones
+        if iteration % max(cfg.bagging_freq, 1) == 0 or \
+                not hasattr(self, "_bag_mask"):
+            rng = np.random.RandomState(
+                (cfg.bagging_seed + iteration) % (2 ** 31))
+            if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
+                label = self.train_set.get_label()
+                mask = np.zeros(n, dtype=np.float32)
+                pos = label > 0
+                mask[pos] = (rng.rand(int(pos.sum())) <
+                             cfg.pos_bagging_fraction)
+                mask[~pos] = (rng.rand(int((~pos).sum())) <
+                              cfg.neg_bagging_fraction)
+            else:
+                mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
+            self._bag_mask = jnp.asarray(mask)
+        return self._bag_mask
+
+    def _feature_mask(self, iteration: int, k: int) -> jax.Array:
+        cfg = self.config
+        allowed = self._dd.base_allowed
+        if cfg.feature_fraction < 1.0:
+            f = self._dd.num_feature
+            n_pick = max(1, int(np.ceil(cfg.feature_fraction * f)))
+            rng = np.random.RandomState(
+                (cfg.feature_fraction_seed + iteration * 7 + k) % (2 ** 31))
+            chosen = rng.choice(f, n_pick, replace=False)
+            mask = np.zeros(f, dtype=bool)
+            mask[chosen] = True
+            allowed = allowed & mask
+        return jnp.asarray(allowed)
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration (ref: basic.py Booster.update →
+        LGBM_BoosterUpdateOneIter → GBDT::TrainOneIter)."""
+        if train_set is not None and train_set is not self.train_set:
+            self._init_train(train_set)
+        fobj = fobj or self._fobj
+        K = self.num_tree_per_iteration
+        if fobj is None:
+            if self.objective_ is None:
+                raise LightGBMError(
+                    "Custom objective function (fobj) is required when "
+                    "objective is none/custom")
+            self._boost_from_average()
+            grad, hess = self._grad_fn(self._train_score)
+        else:
+            preds = np.asarray(self._train_score, dtype=np.float64)
+            if K > 1:
+                preds = preds.reshape(-1, order="F")
+            g, h = fobj(preds, self.train_set)
+            grad = jnp.asarray(np.asarray(g, dtype=np.float32)
+                               .reshape((-1, K), order="F").squeeze())
+            hess = jnp.asarray(np.asarray(h, dtype=np.float32)
+                               .reshape((-1, K), order="F").squeeze())
+            if K > 1:
+                grad = grad.reshape((-1, K))
+                hess = hess.reshape((-1, K))
+        return self.__boost(grad, hess)
+
+    def __boost(self, grad, hess) -> bool:
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        it = self.cur_iter
+        sw = self._sample_weights(it)
+        dd = self._dd
+        lr = cfg.learning_rate
+        all_const = True
+        self._last_contribs = []  # for rollback_one_iter
+        for k in range(K):
+            gk = grad if K == 1 else grad[:, k]
+            hk = hess if K == 1 else hess[:, k]
+            allowed = self._feature_mask(it, k)
+            dev = self._grower(dd.bins_fm, gk.astype(jnp.float32),
+                               hk.astype(jnp.float32), sw,
+                               dd.feat_nb, dd.feat_missing, dd.feat_default,
+                               allowed)
+            tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
+            if tree.num_leaves > 1:
+                all_const = False
+            # train score: final leaf_id from growth → direct gather
+            scaled = dev.leaf_value * lr
+            contrib = scaled[dev.leaf_id]
+            if K == 1:
+                new_train = self._train_score + contrib
+            else:
+                new_train = self._train_score.at[:, k].add(contrib)
+            self._last_contribs.append(("train", k, contrib))
+            self._train_score = new_train
+            # valid scores: bin-level traversal (ref: ScoreUpdater::AddScore)
+            for vi, vdd in enumerate(self._valid_dd):
+                self._valid_scores[vi] = self._apply_tree_to_score(
+                    self._valid_scores[vi], tree, vdd, k,
+                    bias_included=False, record=vi)
+            # fold init score into the stored model's first tree
+            # (ref: gbdt.cpp TrainOneIter → Tree::AddBias after UpdateScore)
+            if it == 0 and abs(self._init_scores[k]) > 1e-35:
+                tree.add_bias(self._init_scores[k])
+            self.trees.append(tree)
+        self.cur_iter += 1
+        if all_const:
+            log.warning("Stopped training because there are no more leaves "
+                        "that meet the split requirements")
+        return all_const
+
+    def _apply_tree_to_score(self, score, tree: Tree, dd: _DeviceData, k: int,
+                             bias_included: bool, record=None):
+        if tree.num_leaves <= 1:
+            contrib = jnp.full((dd.num_data,), float(tree.leaf_value[0])
+                               if bias_included else 0.0, dtype=jnp.float32)
+        else:
+            feat, thr, dl, left, right, v = _traverse_padded(
+                tree, self.config.num_leaves, dd,
+                np.asarray(tree.leaf_value, dtype=np.float32))
+            leaf_idx = _jit_traverse(feat, thr, dl, left, right,
+                                     dd.feat_nb, dd.feat_missing, dd.bins_fm)
+            contrib = v[leaf_idx]
+        if record is not None:
+            self._last_contribs.append(("valid", record, k, contrib))
+        if score.ndim == 1:
+            return score + contrib
+        return score.at[:, k].add(contrib)
+
+    def rollback_one_iter(self) -> "Booster":
+        """Undo the last iteration (ref: GBDT::RollbackOneIter).
+
+        The most recent iteration's contributions are cached; deeper
+        rollbacks recompute the tree's contribution by bin-level traversal
+        (the reference recomputes scores the same way on `ResetTrainingData`).
+        """
+        if self.cur_iter <= 0:
+            return self
+        K = self.num_tree_per_iteration
+        cached = getattr(self, "_last_contribs", [])
+        if cached:
+            for entry in cached:
+                if entry[0] == "train":
+                    _, k, contrib = entry
+                    if self._train_score.ndim == 1:
+                        self._train_score = self._train_score - contrib
+                    else:
+                        self._train_score = \
+                            self._train_score.at[:, k].add(-contrib)
+                else:
+                    _, vi, k, contrib = entry
+                    if self._valid_scores[vi].ndim == 1:
+                        self._valid_scores[vi] = \
+                            self._valid_scores[vi] - contrib
+                    else:
+                        self._valid_scores[vi] = \
+                            self._valid_scores[vi].at[:, k].add(-contrib)
+            self._last_contribs = []
+        else:
+            rolling_first = self.cur_iter == 1
+            for k in range(K):
+                tree = self.trees[-K + k]
+                bias = self._init_scores[k] if rolling_first else 0.0
+                self._train_score = self._subtract_tree(
+                    self._train_score, tree, self._dd, k, bias)
+                for vi, vdd in enumerate(self._valid_dd):
+                    self._valid_scores[vi] = self._subtract_tree(
+                        self._valid_scores[vi], tree, vdd, k, bias)
+        del self.trees[-K:]
+        self.cur_iter -= 1
+        return self
+
+    def _subtract_tree(self, score, tree: Tree, dd: _DeviceData, k: int,
+                       bias: float):
+        """score -= tree(bins) where the stored tree may carry a folded-in
+        bias that the running score tracks separately."""
+        if tree.num_leaves <= 1:
+            return score
+        feat, thr, dl, left, right, v = _traverse_padded(
+            tree, self.config.num_leaves, dd,
+            np.asarray(tree.leaf_value - bias, dtype=np.float32))
+        leaf_idx = _jit_traverse(feat, thr, dl, left, right,
+                                 dd.feat_nb, dd.feat_missing, dd.bins_fm)
+        contrib = v[leaf_idx]
+        if score.ndim == 1:
+            return score - contrib
+        return score.at[:, k].add(-contrib)
+
+    # ------------------------------------------------------------------ eval
+    def _eval_one(self, score: np.ndarray, ds: Dataset, data_name: str,
+                  feval) -> List[Tuple[str, str, float, bool]]:
+        label = ds.get_label()
+        weight = ds.get_weight()
+        qb = ds._query_boundaries
+        label64 = label.astype(np.float64) if label is not None else None
+        w64 = weight.astype(np.float64) if weight is not None else None
+        out = []
+        for m in self.metrics_:
+            for name, val in m.eval(score, label64, w64, qb):
+                out.append((data_name, name, val, m.higher_better))
+        if feval is not None:
+            preds = score
+            if self.objective_ is not None and self._fobj is None and \
+                    self.objective_.need_convert:
+                preds = np.asarray(jax.device_get(
+                    self.objective_.convert_output(jnp.asarray(score))))
+            fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+            for fe in fevals:
+                res = fe(preds.reshape(-1, order="F")
+                         if preds.ndim > 1 else preds, ds)
+                if isinstance(res, list):
+                    for name, val, hib in res:
+                        out.append((data_name, name, val, hib))
+                elif res is not None:
+                    name, val, hib = res
+                    out.append((data_name, name, val, hib))
+        return out
+
+    def eval_train(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        score = np.asarray(self._train_score, dtype=np.float64)
+        return self._eval_one(score, self.train_set, "training", feval)
+
+    def eval_valid(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        out = []
+        for name, ds, score in zip(self.name_valid_sets, self.valid_sets,
+                                   self._valid_scores):
+            out.extend(self._eval_one(np.asarray(score, dtype=np.float64),
+                                      ds, name, feval))
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        if data is self.train_set:
+            return self.eval_train(feval)
+        for i, vs in enumerate(self.valid_sets):
+            if data is vs:
+                return self._eval_one(
+                    np.asarray(self._valid_scores[i], dtype=np.float64),
+                    data, name, feval)
+        raise LightGBMError("Data for eval must be training or validation "
+                            "data (use add_valid first)")
+
+    # --------------------------------------------------------------- predict
+    def _slice_trees(self, start_iteration: int,
+                     num_iteration: Optional[int]) -> List[Tree]:
+        K = self.num_tree_per_iteration
+        if num_iteration is None:
+            num_iteration = self.best_iteration \
+                if self.best_iteration > 0 else -1
+        if num_iteration <= 0:
+            end = len(self.trees)
+        else:
+            end = min((start_iteration + num_iteration) * K, len(self.trees))
+        return self.trees[start_iteration * K: end]
+
+    def predict(self, data, start_iteration: int = 0,
+                num_iteration: Optional[int] = None, raw_score: bool = False,
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                data_has_header: bool = False, validate_features: bool = False,
+                **kwargs) -> np.ndarray:
+        """ref: basic.py Booster.predict → gbdt_prediction.cpp."""
+        X = _to_2d_float(data)
+        n = X.shape[0]
+        K = self.num_tree_per_iteration
+        trees = self._slice_trees(start_iteration, num_iteration)
+        if pred_leaf:
+            out = np.zeros((n, len(trees)), dtype=np.int32)
+            for i, t in enumerate(trees):
+                out[:, i] = t.predict_leaf_index(X)
+            return out
+        if pred_contrib:
+            return self._predict_contrib(X, trees)
+        raw = np.zeros((n, K), dtype=np.float64)
+        for i, t in enumerate(trees):
+            raw[:, i % K] += t.predict(X)
+        if K == 1:
+            raw = raw[:, 0]
+        if raw_score or self.objective_ is None:
+            return raw
+        return np.asarray(jax.device_get(
+            self.objective_.convert_output(jnp.asarray(raw))))
+
+    def _predict_contrib(self, X: np.ndarray, trees: List[Tree]) -> np.ndarray:
+        """TreeSHAP feature contributions (ref: PredictContrib → tree.cpp
+        TreeSHAP recursion). Host implementation."""
+        from .contrib import predict_contrib
+        return predict_contrib(X, trees, self.num_tree_per_iteration)
+
+    # ----------------------------------------------------------- model text
+    def _objective_to_string(self) -> str:
+        cfg = self.config
+        o = cfg.objective
+        if self.objective_ is None:
+            return "custom"
+        if o == "binary":
+            return f"binary sigmoid:{cfg.sigmoid:g}"
+        if o == "multiclass":
+            return f"multiclass num_class:{cfg.num_class}"
+        if o == "multiclassova":
+            return (f"multiclassova num_class:{cfg.num_class} "
+                    f"sigmoid:{cfg.sigmoid:g}")
+        if o == "quantile":
+            return f"quantile alpha:{cfg.alpha:g}"
+        if o == "huber":
+            return f"huber alpha:{cfg.alpha:g}"
+        if o == "fair":
+            return f"fair fair_c:{cfg.fair_c:g}"
+        if o == "tweedie":
+            return (f"tweedie "
+                    f"tweedie_variance_power:{cfg.tweedie_variance_power:g}")
+        if o == "lambdarank":
+            return "lambdarank"
+        if o == "rank_xendcg":
+            return "rank_xendcg"
+        return o
+
+    def model_to_string(self, num_iteration: Optional[int] = None,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        """ref: gbdt_model_text.cpp `GBDT::SaveModelToString`."""
+        trees = self._slice_trees(start_iteration, num_iteration)
+        fnames = self.train_set.get_feature_name() if self.train_set \
+            else getattr(self, "_loaded_feature_names",
+                         [f"Column_{i}" for i in range(self.num_feature())])
+        buf = io.StringIO()
+        buf.write("tree\n")
+        buf.write("version=v4\n")
+        buf.write(f"num_class={max(self.num_tree_per_iteration, 1)}\n")
+        buf.write(f"num_tree_per_iteration={self.num_tree_per_iteration}\n")
+        buf.write("label_index=0\n")
+        buf.write(f"max_feature_idx={len(fnames) - 1}\n")
+        buf.write(f"objective={self._objective_to_string()}\n")
+        buf.write("feature_names=" + " ".join(fnames) + "\n")
+        if self.train_set is not None and self.train_set.bin_mappers:
+            infos = [m.feature_info_str() for m in self.train_set.bin_mappers]
+        else:
+            infos = getattr(self, "_loaded_feature_infos", ["none"] * len(fnames))
+        buf.write("feature_infos=" + " ".join(infos) + "\n")
+        tree_strs = [t.to_string(i) for i, t in enumerate(trees)]
+        buf.write("tree_sizes=" + " ".join(str(len(s) + 1)
+                                           for s in tree_strs) + "\n")
+        buf.write("\n")
+        for s in tree_strs:
+            buf.write(s + "\n")
+        buf.write("end of trees\n\n")
+        imp = self.feature_importance(importance_type)
+        pairs = sorted([(v, n) for n, v in zip(fnames, imp) if v > 0],
+                       reverse=True)
+        buf.write("feature_importances:\n")
+        for v, n in pairs:
+            buf.write(f"{n}={v:g}\n")
+        buf.write("\nparameters:\n")
+        for k, v in self.params.items():
+            if callable(v):
+                continue
+            if isinstance(v, (list, tuple)):
+                v = ",".join(str(x) for x in v)
+            buf.write(f"[{k}: {v}]\n")
+        buf.write("end of parameters\n")
+        buf.write("\npandas_categorical:" +
+                  json.dumps(self.pandas_categorical) + "\n")
+        return buf.getvalue()
+
+    def model_from_string(self, model_str: str) -> "Booster":
+        """ref: gbdt_model_text.cpp `GBDT::LoadModelFromString`."""
+        lines = model_str.split("\n")
+        header: Dict[str, str] = {}
+        i = 0
+        while i < len(lines):
+            ln = lines[i].strip()
+            if ln.startswith("Tree="):
+                break
+            if "=" in ln:
+                k, v = ln.split("=", 1)
+                header[k] = v
+            i += 1
+        self.num_tree_per_iteration = int(
+            header.get("num_tree_per_iteration", 1))
+        self._loaded_feature_names = header.get("feature_names", "").split()
+        self._loaded_feature_infos = header.get("feature_infos", "").split()
+        obj_str = header.get("objective", "regression").split()
+        obj_params = {}
+        for tok in obj_str[1:]:
+            if ":" in tok:
+                k, v = tok.split(":")
+                obj_params[k] = v
+        params = dict(self.params)
+        params["objective"] = obj_str[0] if obj_str else "regression"
+        params.update(obj_params)
+        params.setdefault("verbosity", -1)
+        self.config = Config(params)
+        self.objective_ = create_objective(self.config) \
+            if obj_str and obj_str[0] != "custom" else None
+        self.metrics_ = create_metrics(
+            self.config, self.config.metric or self.config.default_metric())
+        self._fobj = None
+        # parse trees
+        text = "\n".join(lines[i:])
+        self.trees = []
+        for section in text.split("Tree=")[1:]:
+            section = section.split("\nend of trees")[0]
+            self.trees.append(Tree.from_string("Tree=" + section))
+        self.cur_iter = len(self.trees) // max(self.num_tree_per_iteration, 1)
+        # pandas_categorical footer
+        for ln in reversed(lines):
+            if ln.startswith("pandas_categorical:"):
+                try:
+                    self.pandas_categorical = json.loads(
+                        ln[len("pandas_categorical:"):])
+                except json.JSONDecodeError:
+                    pass
+                break
+        return self
+
+    def save_model(self, filename: str, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as f:
+            f.write(self.model_to_string(num_iteration, start_iteration,
+                                         importance_type))
+        return self
+
+    def dump_model(self, num_iteration: Optional[int] = None,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> Dict:
+        """JSON model dump (ref: GBDT::DumpModel)."""
+        trees = self._slice_trees(start_iteration, num_iteration)
+        fnames = (self.train_set.get_feature_name() if self.train_set
+                  else getattr(self, "_loaded_feature_names", []))
+
+        def node_to_dict(t: Tree, node: int) -> Dict:
+            if node < 0:
+                leaf = ~node
+                return {"leaf_index": int(leaf),
+                        "leaf_value": float(t.leaf_value[leaf]),
+                        "leaf_weight": float(t.leaf_weight[leaf]),
+                        "leaf_count": int(t.leaf_count[leaf])}
+            return {
+                "split_index": int(node),
+                "split_feature": int(t.split_feature[node]),
+                "split_gain": float(t.split_gain[node]),
+                "threshold": float(t.threshold[node]),
+                "decision_type": "<=",
+                "default_left": bool(t.decision_type[node] & 2),
+                "missing_type": ["None", "Zero", "NaN"][
+                    (t.decision_type[node] >> 2) & 3],
+                "internal_value": float(t.internal_value[node]),
+                "internal_weight": float(t.internal_weight[node]),
+                "internal_count": int(t.internal_count[node]),
+                "left_child": node_to_dict(t, t.left_child[node]),
+                "right_child": node_to_dict(t, t.right_child[node]),
+            }
+
+        return {
+            "name": "tree",
+            "version": "v4",
+            "num_class": max(self.num_tree_per_iteration, 1),
+            "num_tree_per_iteration": self.num_tree_per_iteration,
+            "label_index": 0,
+            "max_feature_idx": len(fnames) - 1,
+            "objective": self._objective_to_string(),
+            "feature_names": fnames,
+            "tree_info": [{
+                "tree_index": i,
+                "num_leaves": t.num_leaves,
+                "num_cat": t.num_cat,
+                "shrinkage": t.shrinkage,
+                "tree_structure": node_to_dict(
+                    t, 0 if t.num_leaves > 1 else ~0),
+            } for i, t in enumerate(trees)],
+            "pandas_categorical": self.pandas_categorical,
+        }
+
+    # ------------------------------------------------------------- metadata
+    def current_iteration(self) -> int:
+        return self.cur_iter
+
+    def num_trees(self) -> int:
+        return len(self.trees)
+
+    def num_model_per_iteration(self) -> int:
+        return self.num_tree_per_iteration
+
+    def num_feature(self) -> int:
+        if self.train_set is not None:
+            return self.train_set.num_feature()
+        return len(getattr(self, "_loaded_feature_names", []))
+
+    def feature_name(self) -> List[str]:
+        if self.train_set is not None:
+            return self.train_set.get_feature_name()
+        return list(getattr(self, "_loaded_feature_names", []))
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: Optional[int] = None) -> np.ndarray:
+        """ref: gbdt.cpp `GBDT::FeatureImportance`."""
+        trees = self._slice_trees(0, iteration)
+        out = np.zeros(self.num_feature(), dtype=np.float64)
+        for t in trees:
+            if importance_type == "split":
+                t.feature_importance_split(out)
+            elif importance_type == "gain":
+                t.feature_importance_gain(out)
+            else:
+                raise LightGBMError(
+                    f"Unknown importance type: {importance_type}")
+        if importance_type == "split":
+            return out.astype(np.int32)
+        return out
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        """ref: basic.py Booster.reset_parameter (learning-rate schedules)."""
+        self.params.update(params)
+        self.config.update(params)
+        self._grower_spec = self._grower_spec._replace(
+            num_leaves=self.config.num_leaves,
+            max_depth=self.config.max_depth,
+            lambda_l1=self.config.lambda_l1,
+            lambda_l2=self.config.lambda_l2,
+            min_data_in_leaf=float(self.config.min_data_in_leaf),
+            min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
+            min_gain_to_split=self.config.min_gain_to_split,
+            max_delta_step=self.config.max_delta_step)
+        self._grower = make_grower(self._grower_spec)
+        return self
+
+    def __copy__(self):
+        return self.__deepcopy__(None)
+
+    def __deepcopy__(self, _):
+        return Booster(model_str=self.model_to_string(num_iteration=-1))
+
+    def __getstate__(self):
+        state = {"model_str": self.model_to_string(num_iteration=-1),
+                 "params": self.params,
+                 "best_iteration": self.best_iteration}
+        return state
+
+    def __setstate__(self, state):
+        self.__init__(params=state.get("params"),
+                      model_str=state["model_str"])
+        self.best_iteration = state.get("best_iteration", -1)

@@ -1,0 +1,371 @@
+"""Training engine: `train()` and `cv()`.
+
+API parity with python-package/lightgbm/engine.py (`train`, `cv`,
+`CVBooster`, `_make_n_folds`): the host-level boosting loop — per-iteration
+`booster.update()`, eval, callbacks, early stopping via EarlyStopException —
+sits exactly where the reference's does (ref: engine.py `train` hot loop,
+SURVEY §3.1).  The device-side work per iteration is one compiled XLA
+program; this file is deliberately thin Python.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict, Iterable, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Dataset
+from .booster import Booster
+from .utils import log
+from .utils.config import Config, canonical_param_name
+from .utils.log import LightGBMError
+
+__all__ = ["train", "cv", "CVBooster"]
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval=None, init_model: Optional[Union[str, Booster]] = None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List] = None) -> Booster:
+    """Train one model (ref: engine.py `train`)."""
+    params = copy.deepcopy(params) if params else {}
+    # num_boost_round aliases in params win (reference behavior)
+    for key in list(params.keys()):
+        if canonical_param_name(key) == "num_iterations" and \
+                params[key] is not None:
+            num_boost_round = int(params.pop(key))
+    params["num_iterations"] = num_boost_round
+
+    first_metric_only = bool(params.get("first_metric_only", False))
+
+    if not isinstance(train_set, Dataset):
+        raise TypeError("train() only accepts Dataset object, "
+                        f"got {type(train_set).__name__}")
+
+    predictor_model = None
+    if init_model is not None:
+        predictor_model = init_model if isinstance(init_model, Booster) \
+            else Booster(model_file=init_model, params={"verbosity": -1})
+
+    booster = Booster(params=params, train_set=train_set)
+    booster._train_data_name = "training"
+    if predictor_model is not None:
+        _continue_from(booster, predictor_model)
+
+    valid_sets = valid_sets or []
+    if isinstance(valid_sets, Dataset):
+        valid_sets = [valid_sets]
+    valid_names = valid_names or []
+    for i, vs in enumerate(valid_sets):
+        if vs is train_set:
+            booster._train_data_name = (valid_names[i] if i < len(valid_names)
+                                        else "training")
+            continue
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs.reference is None:
+            vs.reference = train_set
+        booster.add_valid(vs, name)
+
+    callbacks = list(callbacks) if callbacks else []
+    # early_stopping_round in params spawns the callback (reference behavior)
+    es_round = Config(params).early_stopping_round
+    if es_round and es_round > 0 and not any(
+            getattr(cb, "order", None) == 30 for cb in callbacks):
+        callbacks.append(callback_mod.early_stopping(
+            es_round, first_metric_only=first_metric_only))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    evaluation_result_list: List = []
+    begin_iteration = booster.current_iteration()
+    end_iteration = begin_iteration + num_boost_round
+    for i in range(begin_iteration, end_iteration):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=begin_iteration, end_iteration=end_iteration,
+                evaluation_result_list=None))
+        booster.update()
+
+        evaluation_result_list = []
+        if booster.valid_sets or _eval_train_requested(params):
+            if _eval_train_requested(params):
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=begin_iteration,
+                    end_iteration=end_iteration,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as es:
+            booster.best_iteration = es.best_iteration + 1
+            evaluation_result_list = es.best_score
+            break
+    booster.best_score = {}
+    for item in evaluation_result_list:
+        booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    if not keep_training_booster:
+        # reference frees raw data network handles; we keep the booster as-is
+        pass
+    return booster
+
+
+def _eval_train_requested(params: Dict[str, Any]) -> bool:
+    for alias in ("is_provide_training_metric", "training_metric",
+                  "is_training_metric", "train_metric"):
+        if params.get(alias):
+            return True
+    return False
+
+
+def _continue_from(booster: Booster, init_booster: Booster) -> None:
+    """Continued training: replay the init model's trees into the new
+    booster's scores (ref: engine.py init_model → _InnerPredictor path)."""
+    import jax.numpy as jnp
+
+    if init_booster.num_model_per_iteration() != booster.num_tree_per_iteration:
+        raise LightGBMError("init_model has different num_tree_per_iteration")
+    booster.trees = list(init_booster.trees)
+    booster.cur_iter = init_booster.current_iteration()
+    booster._boost_from_average_done = True  # bias lives in loaded tree 0
+    K = booster.num_tree_per_iteration
+    # loaded trees carry raw-value thresholds only; bin-level traversal
+    # (valid-score replay, rollback) needs threshold_bin re-derived from
+    # this training set's mappers
+    for t in booster.trees:
+        t.recompute_threshold_bins(booster.train_set.bin_mappers)
+    # training scores = raw predictions of the init model on the train data
+    raw_data = _raw_matrix(booster.train_set)
+    raw = init_booster.predict(raw_data, raw_score=True, num_iteration=-1) \
+        if raw_data is not None else None
+    if raw is None:
+        # raw data freed: traverse on bins instead
+        score = booster._train_score
+        for it in range(init_booster.current_iteration()):
+            for k in range(K):
+                t = booster.trees[it * K + k]
+                score = booster._apply_tree_to_score(
+                    score, t, booster._dd, k, bias_included=True)
+        booster._train_score = score
+    else:
+        booster._train_score = jnp.asarray(
+            np.asarray(raw, dtype=np.float32))
+
+
+def _raw_matrix(ds: Dataset):
+    try:
+        return ds.get_data()
+    except LightGBMError:
+        return None
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (ref: engine.py `CVBooster`)."""
+
+    def __init__(self, model_file: Optional[str] = None):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+        if model_file is not None:
+            import json
+            with open(model_file) as f:
+                payload = json.load(f)
+            self.best_iteration = payload["best_iteration"]
+            self.boosters = [Booster(model_str=s) for s in payload["boosters"]]
+
+    def append(self, booster: Booster) -> "CVBooster":
+        self.boosters.append(booster)
+        return self
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+    def save_model(self, filename: str) -> "CVBooster":
+        import json
+        payload = {"best_iteration": self.best_iteration,
+                   "boosters": [b.model_to_string() for b in self.boosters]}
+        with open(filename, "w") as f:
+            json.dump(payload, f)
+        return self
+
+
+def _make_n_folds(full_data: Dataset, folds, nfold: int, params: Dict,
+                  seed: int, stratified: bool, shuffle: bool):
+    """ref: engine.py `_make_n_folds`."""
+    full_data.construct()
+    num_data = full_data.num_data()
+    if folds is not None:
+        if not hasattr(folds, "__iter__") and not hasattr(folds, "split"):
+            raise AttributeError(
+                "folds should be a generator or iterator of (train_idx, "
+                "test_idx) tuples or scikit-learn splitter object")
+        if hasattr(folds, "split"):
+            group_info = full_data.get_group()
+            if group_info is not None:
+                group_info = np.asarray(group_info, dtype=np.int64)
+                flattened_group = np.repeat(
+                    np.arange(len(group_info)), repeats=group_info)
+            else:
+                flattened_group = np.zeros(num_data, dtype=np.int64)
+            folds = folds.split(X=np.empty(num_data),
+                                y=full_data.get_label(),
+                                groups=flattened_group)
+        return folds
+
+    if stratified:
+        label = full_data.get_label()
+        rng = np.random.RandomState(seed)
+        order = np.argsort(label, kind="mergesort")
+        if shuffle:
+            # shuffle within each class block so seed changes the folds
+            # while keeping per-fold class balance
+            for cls in np.unique(label):
+                block = np.nonzero(label[order] == cls)[0]
+                order[block] = order[block][rng.permutation(len(block))]
+        # round-robin assignment over label-sorted rows → per-fold class balance
+        assign = np.arange(num_data) % nfold
+        fold_of = np.empty(num_data, dtype=np.int64)
+        fold_of[order] = assign
+        out = []
+        for k in range(nfold):
+            test_idx = np.nonzero(fold_of == k)[0]
+            train_idx = np.nonzero(fold_of != k)[0]
+            out.append((train_idx, test_idx))
+        return out
+    # plain (optionally shuffled) contiguous folds; group-aware when ranking
+    group_sizes = full_data.get_group()
+    if group_sizes is not None:
+        ngroups = len(group_sizes)
+        gidx = np.arange(ngroups)
+        if shuffle:
+            np.random.RandomState(seed).shuffle(gidx)
+        boundaries = np.concatenate([[0], np.cumsum(group_sizes)])
+        out = []
+        for k in range(nfold):
+            test_groups = gidx[k::nfold]
+            mask = np.zeros(num_data, dtype=bool)
+            for g in test_groups:
+                mask[boundaries[g]:boundaries[g + 1]] = True
+            out.append((np.nonzero(~mask)[0], np.nonzero(mask)[0]))
+        return out
+    idx = np.arange(num_data)
+    if shuffle:
+        np.random.RandomState(seed).shuffle(idx)
+    out = []
+    for k in range(nfold):
+        test_idx = np.sort(idx[k::nfold])
+        train_idx = np.sort(np.setdiff1d(idx, test_idx, assume_unique=True))
+        out.append((train_idx, test_idx))
+    return out
+
+
+def _agg_cv_result(raw_results):
+    """ref: engine.py `_agg_cv_result` — mean/std across folds."""
+    cvmap: Dict[str, List[float]] = {}
+    metric_type: Dict[str, bool] = {}
+    for one_result in raw_results:
+        for one_line in one_result:
+            key = f"{one_line[0]} {one_line[1]}"
+            metric_type[key] = one_line[3]
+            cvmap.setdefault(key, []).append(one_line[2])
+    return [("cv_agg", k, float(np.mean(v)), metric_type[k], float(np.std(v)))
+            for k, v in cvmap.items()]
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True,
+       shuffle: bool = True, metrics=None, feval=None, init_model=None,
+       fpreproc=None, seed: int = 0, callbacks=None, eval_train_metric=False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """Cross-validation (ref: engine.py `cv`)."""
+    params = copy.deepcopy(params) if params else {}
+    for key in list(params.keys()):
+        if canonical_param_name(key) == "num_iterations" and \
+                params[key] is not None:
+            num_boost_round = int(params.pop(key))
+    params["num_iterations"] = num_boost_round
+    if metrics is not None:
+        params["metric"] = metrics
+    obj = Config(params).objective
+    if stratified and obj not in ("binary", "multiclass", "multiclassova"):
+        stratified = False
+
+    train_set.construct()
+    results: Dict[str, List[float]] = {}
+    cvbooster = CVBooster()
+
+    folds_idx = _make_n_folds(train_set, folds, nfold, params, seed,
+                              stratified, shuffle)
+    boosters = []
+    for train_idx, test_idx in folds_idx:
+        tr = train_set.subset(sorted(train_idx))
+        te = train_set.subset(sorted(test_idx))
+        if fpreproc is not None:
+            tr, te, fold_params = fpreproc(tr, te, params.copy())
+        else:
+            fold_params = params.copy()
+        booster = Booster(params=fold_params, train_set=tr)
+        booster._train_data_name = "train"
+        booster.add_valid(te, "valid")
+        boosters.append(booster)
+        cvbooster.append(booster)
+
+    callbacks = list(callbacks) if callbacks else []
+    es_round = Config(params).early_stopping_round
+    if es_round and es_round > 0 and not any(
+            getattr(cb, "order", None) == 30 for cb in callbacks):
+        callbacks.append(callback_mod.early_stopping(es_round))
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        fold_results = []
+        for booster in boosters:
+            booster.update()
+            one = []
+            if eval_train_metric:
+                one.extend(booster.eval_train(feval))
+            one.extend(booster.eval_valid(feval))
+            fold_results.append(one)
+        res = _agg_cv_result(fold_results)
+        for _, key, mean, _, std in res:
+            results.setdefault(f"{key}-mean", []).append(mean)
+            results.setdefault(f"{key}-stdv", []).append(std)
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=res))
+        except callback_mod.EarlyStopException as es:
+            cvbooster.best_iteration = es.best_iteration + 1
+            for bst in boosters:
+                bst.best_iteration = cvbooster.best_iteration
+            for k in results:
+                results[k] = results[k][:cvbooster.best_iteration]
+            break
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster  # type: ignore
+    return results

@@ -1,0 +1,5 @@
+"""Device-side compute ops: histogram construction, split finding, tree
+growth, and prediction traversal — the TPU-native replacement for the
+reference's treelearner/ CUDA kernels (ref: src/treelearner/cuda/)."""
+from .grow import GrowerSpec, make_grower  # noqa: F401
+from .histogram import leaf_histogram  # noqa: F401

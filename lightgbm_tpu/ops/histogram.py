@@ -1,0 +1,55 @@
+"""Histogram construction — the hottest kernel of GBDT training.
+
+TPU-native re-design of the reference's histogram path
+(ref: src/io/dense_bin.hpp `DenseBin::ConstructHistogram`;
+src/treelearner/cuda/cuda_histogram_constructor.cu
+`CUDAConstructHistogramKernel`).
+
+Reference design: per-thread/per-block partial histograms with atomic adds.
+TPUs have no atomics; the XLA formulation here is a batched segment-sum
+(scatter-add) over a feature-major bin matrix.  A Pallas kernel with per-tile
+VMEM-private histograms replaces this on the perf-critical path (ops/pallas
+milestone); both produce identical [F, MB, 3] (sum_grad, sum_hess, count)
+accumulators.
+
+Layout notes:
+ - bins are FEATURE-MAJOR [F, N] on device so each feature's column is
+   contiguous for both the scatter and future Pallas row-tiling.
+ - the (g, h, 1) payload is masked by bagging weights once per tree and by
+   leaf membership per call; count is the masked row count (float), which is
+   what min_data_in_leaf compares against under bagging.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def leaf_histogram(bins_fm: Array, payload: Array, row_mask: Array,
+                   max_bin: int) -> Array:
+    """Accumulate (Σgrad, Σhess, Σcount) per (feature, bin) over masked rows.
+
+    Args:
+      bins_fm: [F, N] integer bin matrix, feature-major.
+      payload: [N, 3] float32 — (grad*w, hess*w, w) with bagging weight w.
+      row_mask: [N] bool — leaf membership.
+      max_bin: padded bin-axis size MB.
+
+    Returns: [F, MB, 3] float32.
+    """
+    d = jnp.where(row_mask[:, None], payload, 0.0)
+
+    def per_feature(col: Array) -> Array:
+        return jax.ops.segment_sum(d, col.astype(jnp.int32),
+                                   num_segments=max_bin)
+
+    return jax.vmap(per_feature)(bins_fm)
+
+
+def root_histogram(bins_fm: Array, payload: Array, max_bin: int) -> Array:
+    """Histogram over all (bagging-weighted) rows — the root pass."""
+    n = bins_fm.shape[1]
+    return leaf_histogram(bins_fm, payload,
+                          jnp.ones((n,), dtype=bool), max_bin)

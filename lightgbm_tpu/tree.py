@@ -1,0 +1,326 @@
+"""Flat-array decision tree + LightGBM model-text round-trip.
+
+TPU-native re-design of the reference's tree container
+(ref: include/LightGBM/tree.h `Tree` [flat arrays split_feature_/threshold_/
+left_child_/right_child_/leaf_value_, negative child = ~leaf]; src/io/tree.cpp
+`Tree::ToString`, `Tree(const char*)`; src/boosting/gbdt_model_text.cpp).
+
+The same flat encoding as the reference is kept on purpose: the text model
+format serializes these arrays directly, so keeping the layout makes the
+format byte-level compatible and makes device-side traversal a simple gather
+walk.  Child encoding: >= 0 → internal node index, < 0 → leaf index ~child.
+"""
+from __future__ import annotations
+
+import math
+from typing import List, Optional
+
+import numpy as np
+
+from .utils.binning import BinMapper
+from .utils.log import LightGBMError
+
+# decision_type bit layout (ref: include/LightGBM/tree.h kCategoricalMask /
+# kDefaultLeftMask / GetMissingType)
+K_CATEGORICAL_MASK = 1
+K_DEFAULT_LEFT_MASK = 2
+# missing type in bits 2..3: 0=None, 1=Zero, 2=NaN
+
+K_ZERO_THRESHOLD = 1e-35
+
+
+def _fmt(x: float) -> str:
+    """Number formatting for model text (ref: Common::ArrayToString with
+    high precision for doubles)."""
+    if x == int(x) and abs(x) < 1e15:
+        return str(int(x))
+    return repr(float(x))
+
+
+def _fmt_g(x: float) -> str:
+    return f"{x:.17g}"
+
+
+class Tree:
+    """One decision tree, host-side numpy arrays."""
+
+    def __init__(self, num_leaves: int):
+        self.num_leaves = num_leaves
+        ni = max(num_leaves - 1, 0)
+        self.split_feature = np.zeros(ni, dtype=np.int32)
+        self.threshold_bin = np.zeros(ni, dtype=np.int32)
+        self.threshold = np.zeros(ni, dtype=np.float64)
+        self.decision_type = np.zeros(ni, dtype=np.int32)
+        self.left_child = np.zeros(ni, dtype=np.int32)
+        self.right_child = np.zeros(ni, dtype=np.int32)
+        self.split_gain = np.zeros(ni, dtype=np.float64)
+        self.internal_value = np.zeros(ni, dtype=np.float64)
+        self.internal_weight = np.zeros(ni, dtype=np.float64)
+        self.internal_count = np.zeros(ni, dtype=np.float64)
+        self.leaf_value = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_weight = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_count = np.zeros(num_leaves, dtype=np.float64)
+        self.shrinkage = 1.0
+        self.num_cat = 0
+        # categorical split storage (ref: tree.h cat_boundaries_/cat_threshold_)
+        self.cat_boundaries: np.ndarray = np.zeros(1, dtype=np.int64)
+        self.cat_threshold: np.ndarray = np.zeros(0, dtype=np.uint32)
+        self.is_linear = False
+
+    # ------------------------------------------------------------ construct
+    @classmethod
+    def from_device(cls, dev, bin_mappers: List[BinMapper],
+                    shrinkage: float, learner_output_scale: float = 1.0
+                    ) -> "Tree":
+        """Build a host Tree from ops.grow `DeviceTree` output.
+
+        Child-pointer fix-up happens here: the device records only
+        (step → split leaf); the reference's `Tree::Split` pointer surgery
+        (split leaf keeps its index as left child, new leaf = step+1 as right
+        child) is reproduced on host where it is O(num_leaves).
+        """
+        ns = int(dev.n_splits)
+        nl = ns + 1
+        t = cls(nl)
+        t.shrinkage = shrinkage
+        split_leaf = np.asarray(dev.split_leaf)[:ns]
+        feat = np.asarray(dev.split_feature)[:ns]
+        thr_bin = np.asarray(dev.threshold_bin)[:ns]
+        dl = np.asarray(dev.default_left)[:ns]
+        gains = np.asarray(dev.split_gain)[:ns]
+        ig = np.asarray(dev.internal_g)[:ns]
+        ih = np.asarray(dev.internal_h)[:ns]
+        ic = np.asarray(dev.internal_cnt)[:ns]
+
+        # leaf slot → (owning node, is_right) for pointer fix-up
+        leaf_pos = {0: (-1, False)}
+        for i in range(ns):
+            leaf = int(split_leaf[i])
+            p, is_right = leaf_pos[leaf]
+            if p >= 0:
+                if is_right:
+                    t.right_child[p] = i
+                else:
+                    t.left_child[p] = i
+            t.left_child[i] = ~leaf
+            t.right_child[i] = ~(i + 1)
+            leaf_pos[leaf] = (i, False)
+            leaf_pos[i + 1] = (i, True)
+
+            f = int(feat[i])
+            m = bin_mappers[f]
+            t.split_feature[i] = f
+            t.threshold_bin[i] = int(thr_bin[i])
+            t.threshold[i] = m.bin_to_value(int(thr_bin[i]))
+            dt = 0
+            if bool(dl[i]):
+                dt |= K_DEFAULT_LEFT_MASK
+            dt |= (m.missing_type & 3) << 2
+            t.decision_type[i] = dt
+            t.split_gain[i] = float(gains[i])
+            denom = ih[i] if ih[i] != 0 else 1.0
+            t.internal_value[i] = float(-ig[i] / denom) * shrinkage
+            t.internal_weight[i] = float(ih[i])
+            t.internal_count[i] = float(ic[i])
+
+        lv = np.asarray(dev.leaf_value)[:nl] * learner_output_scale
+        t.leaf_value = (lv * shrinkage).astype(np.float64)
+        t.leaf_weight = np.asarray(dev.leaf_h)[:nl].astype(np.float64)
+        t.leaf_count = np.asarray(dev.leaf_cnt)[:nl].astype(np.float64)
+        return t
+
+    def add_bias(self, val: float) -> None:
+        """ref: tree.h `Tree::AddBias` — folds boost_from_average init score
+        into the (first) tree so the saved model is self-contained."""
+        self.leaf_value = self.leaf_value + val
+        if self.num_leaves > 1:
+            self.internal_value = self.internal_value + val
+
+    # -------------------------------------------------------------- predict
+    def _decide_left(self, node: np.ndarray, fval: np.ndarray) -> np.ndarray:
+        """Vectorized NumericalDecision (ref: tree.h `Tree::NumericalDecision`)."""
+        dt = self.decision_type[node]
+        missing_type = (dt >> 2) & 3
+        default_left = (dt & K_DEFAULT_LEFT_MASK) != 0
+        thr = self.threshold[node]
+        isnan = np.isnan(fval)
+        fv = np.where(isnan & (missing_type != 2), 0.0, fval)
+        is_missing = ((missing_type == 1) & (np.abs(fv) <= K_ZERO_THRESHOLD)) | \
+                     ((missing_type == 2) & isnan)
+        return np.where(is_missing, default_left, fv <= thr)
+
+    def _decide_left_cat(self, node: np.ndarray, fval: np.ndarray) -> np.ndarray:
+        """Vectorized CategoricalDecision (ref: tree.h `Tree::CategoricalDecision`:
+        int category in the node's bitset → left)."""
+        out = np.zeros(len(node), dtype=bool)
+        isnan = np.isnan(fval)
+        ival = np.where(isnan, -1, fval).astype(np.int64)
+        for u in np.unique(node):
+            sel = node == u
+            cat_idx = self.threshold_bin[u]  # index into cat_boundaries
+            lo = self.cat_boundaries[cat_idx]
+            hi = self.cat_boundaries[cat_idx + 1]
+            bitset = self.cat_threshold[lo:hi]
+            v = ival[sel]
+            ok = (v >= 0) & (v < (hi - lo) * 32)
+            word = np.clip(v // 32, 0, hi - lo - 1)
+            bit = v % 32
+            inset = ok & ((bitset[word] >> bit) & 1).astype(bool)
+            out[sel] = inset
+        return out
+
+    def predict(self, X: np.ndarray) -> np.ndarray:
+        """Batch raw-value prediction, vectorized over rows."""
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        node = np.zeros(n, dtype=np.int64)
+        out = np.zeros(n, dtype=np.float64)
+        active = np.ones(n, dtype=bool)
+        for _ in range(self.num_leaves):  # depth bound
+            idx = np.nonzero(active)[0]
+            if len(idx) == 0:
+                break
+            nd = node[idx]
+            fv = X[idx, self.split_feature[nd]].astype(np.float64)
+            is_cat = (self.decision_type[nd] & K_CATEGORICAL_MASK) != 0
+            left = np.empty(len(idx), dtype=bool)
+            if is_cat.any():
+                left[is_cat] = self._decide_left_cat(nd[is_cat], fv[is_cat])
+            ncat = ~is_cat
+            if ncat.any():
+                left[ncat] = self._decide_left(nd[ncat], fv[ncat])
+            child = np.where(left, self.left_child[nd], self.right_child[nd])
+            leaf = child < 0
+            if leaf.any():
+                li = idx[leaf]
+                out[li] = self.leaf_value[~child[leaf]]
+                active[li] = False
+            node[idx[~leaf]] = child[~leaf]
+        return out
+
+    def predict_leaf_index(self, X: np.ndarray) -> np.ndarray:
+        n = X.shape[0]
+        if self.num_leaves <= 1:
+            return np.zeros(n, dtype=np.int32)
+        node = np.zeros(n, dtype=np.int64)
+        res = np.zeros(n, dtype=np.int32)
+        active = np.ones(n, dtype=bool)
+        for _ in range(self.num_leaves):
+            idx = np.nonzero(active)[0]
+            if len(idx) == 0:
+                break
+            nd = node[idx]
+            fv = X[idx, self.split_feature[nd]].astype(np.float64)
+            is_cat = (self.decision_type[nd] & K_CATEGORICAL_MASK) != 0
+            left = np.empty(len(idx), dtype=bool)
+            if is_cat.any():
+                left[is_cat] = self._decide_left_cat(nd[is_cat], fv[is_cat])
+            if (~is_cat).any():
+                left[~is_cat] = self._decide_left(nd[~is_cat], fv[~is_cat])
+            child = np.where(left, self.left_child[nd], self.right_child[nd])
+            leaf = child < 0
+            if leaf.any():
+                res[idx[leaf]] = ~child[leaf]
+                active[idx[leaf]] = False
+            node[idx[~leaf]] = child[~leaf]
+        return res
+
+    # ---------------------------------------------------------- model text
+    def to_string(self, tree_idx: int) -> str:
+        """ref: src/io/tree.cpp `Tree::ToString` field order."""
+        lines = [f"Tree={tree_idx}",
+                 f"num_leaves={self.num_leaves}",
+                 f"num_cat={self.num_cat}"]
+
+        def arr(name, a, fmt=_fmt_g):
+            lines.append(f"{name}=" + " ".join(fmt(v) for v in a))
+
+        if self.num_leaves > 1:
+            arr("split_feature", self.split_feature, str)
+            arr("split_gain", self.split_gain)
+            arr("threshold", self.threshold)
+            arr("decision_type", self.decision_type, str)
+            arr("left_child", self.left_child, str)
+            arr("right_child", self.right_child, str)
+            arr("leaf_value", self.leaf_value)
+            arr("leaf_weight", self.leaf_weight)
+            arr("leaf_count", self.leaf_count, lambda v: str(int(v)))
+            arr("internal_value", self.internal_value)
+            arr("internal_weight", self.internal_weight)
+            arr("internal_count", self.internal_count, lambda v: str(int(v)))
+            if self.num_cat > 0:
+                arr("cat_boundaries", self.cat_boundaries, str)
+                arr("cat_threshold", self.cat_threshold, str)
+        else:
+            arr("leaf_value", self.leaf_value)
+        lines.append(f"is_linear={int(self.is_linear)}")
+        lines.append(f"shrinkage={_fmt_g(self.shrinkage)}")
+        lines.append("")
+        return "\n".join(lines) + "\n"
+
+    @classmethod
+    def from_string(cls, s: str) -> "Tree":
+        """ref: src/io/tree.cpp `Tree::Tree(const char* str, ...)`."""
+        kv = {}
+        for line in s.splitlines():
+            line = line.strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            kv[k] = v
+        nl = int(kv["num_leaves"])
+        t = cls(nl)
+        t.num_cat = int(kv.get("num_cat", 0))
+
+        def get(name, dtype, size):
+            if name not in kv or kv[name] == "":
+                return np.zeros(size, dtype=dtype)
+            return np.array(kv[name].split(), dtype=np.float64).astype(dtype)
+
+        ni = max(nl - 1, 0)
+        if nl > 1:
+            t.split_feature = get("split_feature", np.int32, ni)
+            t.split_gain = get("split_gain", np.float64, ni)
+            t.threshold = get("threshold", np.float64, ni)
+            t.decision_type = get("decision_type", np.int32, ni)
+            t.left_child = get("left_child", np.int32, ni)
+            t.right_child = get("right_child", np.int32, ni)
+            t.leaf_value = get("leaf_value", np.float64, nl)
+            t.leaf_weight = get("leaf_weight", np.float64, nl)
+            t.leaf_count = get("leaf_count", np.float64, nl)
+            t.internal_value = get("internal_value", np.float64, ni)
+            t.internal_weight = get("internal_weight", np.float64, ni)
+            t.internal_count = get("internal_count", np.float64, ni)
+            if t.num_cat > 0:
+                t.cat_boundaries = get("cat_boundaries", np.int64,
+                                       t.num_cat + 1)
+                t.cat_threshold = get("cat_threshold", np.uint32, 0)
+        else:
+            t.leaf_value = get("leaf_value", np.float64, nl)
+        t.shrinkage = float(kv.get("shrinkage", 1.0))
+        t.is_linear = bool(int(kv.get("is_linear", 0)))
+        return t
+
+    def recompute_threshold_bins(self, bin_mappers: List[BinMapper]) -> None:
+        """Re-derive bin-level thresholds from raw-value thresholds after a
+        model-text load (thresholds are the inclusive upper bounds of their
+        bins, so value_to_bin(threshold) recovers the bin exactly)."""
+        for i in range(self.num_internal()):
+            if self.decision_type[i] & K_CATEGORICAL_MASK:
+                continue  # categorical threshold_bin indexes cat_boundaries
+            m = bin_mappers[int(self.split_feature[i])]
+            self.threshold_bin[i] = m.value_to_bin(float(self.threshold[i]))
+
+    # ----------------------------------------------------------- utilities
+    def num_internal(self) -> int:
+        return max(self.num_leaves - 1, 0)
+
+    def feature_importance_split(self, out: np.ndarray) -> None:
+        for f in self.split_feature[:self.num_internal()]:
+            out[f] += 1
+
+    def feature_importance_gain(self, out: np.ndarray) -> None:
+        ni = self.num_internal()
+        for f, g in zip(self.split_feature[:ni], self.split_gain[:ni]):
+            out[f] += g

@@ -1,0 +1,331 @@
+"""End-to-end training acceptance tests — the TPU build's slice of the
+reference's tests/python_package_test/test_engine.py (metric-threshold
+assertions on small synthetic data; real training, no mocks)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_regression(n=1200, f=8, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = 2 * X[:, 0] + np.sin(2 * X[:, 1]) + 0.3 * X[:, 2] ** 2 \
+        + 0.1 * rng.randn(n)
+    return X, y
+
+
+def make_binary(n=1500, f=10, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    logit = 1.5 * X[:, 0] - X[:, 1] + X[:, 2] * X[:, 3]
+    y = (logit + 0.4 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+class TestRegression:
+    def test_l2_learns(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "num_leaves": 15}, ds, 40)
+        pred = bst.predict(X)
+        assert np.mean((pred - y) ** 2) < 0.2 * np.var(y)
+
+    def test_valid_eval_improves(self):
+        X, y = make_regression(2000)
+        dtr = lgb.Dataset(X[:1500], label=y[:1500])
+        dva = dtr.create_valid(X[1500:], label=y[1500:])
+        evals = {}
+        lgb.train({"objective": "regression", "metric": "l2",
+                   "verbosity": -1}, dtr, 30, valid_sets=[dva],
+                  callbacks=[lgb.record_evaluation(evals)])
+        curve = evals["valid_0"]["l2"]
+        assert curve[-1] < curve[0] * 0.5
+
+    def test_l1_objective(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression_l1", "verbosity": -1},
+                        ds, 50)
+        pred = bst.predict(X)
+        assert np.mean(np.abs(pred - y)) < 0.7 * np.mean(np.abs(y - np.median(y)))
+
+    @pytest.mark.parametrize("obj", ["huber", "fair", "quantile", "mape"])
+    def test_robust_objectives_run(self, obj):
+        X, y = make_regression(600)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": obj, "verbosity": -1}, ds, 10)
+        assert np.isfinite(bst.predict(X)).all()
+
+    @pytest.mark.parametrize("obj", ["poisson", "gamma", "tweedie"])
+    def test_positive_objectives(self, obj):
+        X, y = make_regression(600)
+        y = np.exp(0.3 * y) + 0.01  # strictly positive targets
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": obj, "verbosity": -1}, ds, 20)
+        pred = bst.predict(X)
+        assert (pred > 0).all()
+        # log-link models should track the conditional mean reasonably
+        assert np.corrcoef(pred, y)[0, 1] > 0.5
+
+    def test_weights(self):
+        X, y = make_regression(800)
+        w = np.ones(len(y))
+        w[:400] = 10.0
+        ds = lgb.Dataset(X, label=y, weight=w)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 20)
+        pred = bst.predict(X)
+        err_hi = np.mean((pred[:400] - y[:400]) ** 2)
+        err_lo = np.mean((pred[400:] - y[400:]) ** 2)
+        assert err_hi < err_lo  # heavily-weighted rows fit better
+
+
+class TestBinary:
+    def test_auc_and_logloss(self):
+        X, y = make_binary()
+        dtr = lgb.Dataset(X[:1200], label=y[:1200])
+        dva = dtr.create_valid(X[1200:], label=y[1200:])
+        evals = {}
+        bst = lgb.train({"objective": "binary",
+                         "metric": ["binary_logloss", "auc"],
+                         "verbosity": -1}, dtr, 40, valid_sets=[dva],
+                        callbacks=[lgb.record_evaluation(evals)])
+        assert evals["valid_0"]["auc"][-1] > 0.9
+        assert evals["valid_0"]["binary_logloss"][-1] < 0.45
+        p = bst.predict(X)
+        assert p.min() >= 0 and p.max() <= 1
+
+    def test_boost_from_average_init(self):
+        X, y = make_binary(800)
+        y[:] = 0
+        y[:80] = 1  # 10% positive
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "verbosity": -1}, ds, 1,
+                        )
+        # with boost_from_average the mean prediction starts near base rate
+        assert abs(bst.predict(X).mean() - 0.1) < 0.05
+
+    def test_scale_pos_weight_runs(self):
+        X, y = make_binary(600)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "scale_pos_weight": 3.0,
+                         "verbosity": -1}, ds, 10)
+        assert bst.predict(X).mean() > 0.5  # positives upweighted
+
+
+class TestMulticlass:
+    def test_softmax(self):
+        rng = np.random.RandomState(5)
+        X = rng.randn(1200, 6)
+        y = np.argmax(X[:, :3] + 0.3 * rng.randn(1200, 3), axis=1)
+        ds = lgb.Dataset(X, label=y.astype(np.float64))
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "metric": "multi_logloss", "verbosity": -1}, ds, 25)
+        p = bst.predict(X)
+        assert p.shape == (1200, 3)
+        np.testing.assert_allclose(p.sum(axis=1), 1.0, rtol=1e-5)
+        assert (p.argmax(axis=1) == y).mean() > 0.8
+
+    def test_ova(self):
+        rng = np.random.RandomState(6)
+        X = rng.randn(900, 6)
+        y = np.argmax(X[:, :3], axis=1)
+        ds = lgb.Dataset(X, label=y.astype(np.float64))
+        bst = lgb.train({"objective": "multiclassova", "num_class": 3,
+                         "verbosity": -1}, ds, 20)
+        p = bst.predict(X)
+        assert (p.argmax(axis=1) == y).mean() > 0.8
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        X, y = make_binary(1500)
+        dtr = lgb.Dataset(X[:1000], label=y[:1000])
+        dva = dtr.create_valid(X[1000:], label=y[1000:])
+        bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                         "verbosity": -1}, dtr, 500, valid_sets=[dva],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert bst.best_iteration < 500
+        assert bst.best_score["valid_0"]["binary_logloss"] < 0.6
+
+    def test_reset_parameter_lr_decay(self):
+        X, y = make_regression(600)
+        ds = lgb.Dataset(X, label=y)
+        lrs = []
+        bst = lgb.train(
+            {"objective": "regression", "verbosity": -1}, ds, 5,
+            callbacks=[lgb.reset_parameter(
+                learning_rate=lambda i: 0.1 * (0.9 ** i))])
+        assert abs(bst.config.learning_rate - 0.1 * 0.9 ** 4) < 1e-12
+
+    def test_custom_feval(self):
+        X, y = make_binary(800)
+        dtr = lgb.Dataset(X[:600], label=y[:600])
+        dva = dtr.create_valid(X[600:], label=y[600:])
+        seen = []
+
+        def feval(preds, ds):
+            seen.append(len(preds))
+            return "my_metric", float(np.mean(preds)), False
+
+        evals = {}
+        lgb.train({"objective": "binary", "metric": "binary_logloss",
+                   "verbosity": -1}, dtr, 3, valid_sets=[dva], feval=feval,
+                  callbacks=[lgb.record_evaluation(evals)])
+        assert "my_metric" in evals["valid_0"]
+        assert seen and seen[0] == 200
+
+
+class TestCustomObjective:
+    def test_fobj_callable_params(self):
+        X, y = make_regression(600)
+        ds = lgb.Dataset(X, label=y)
+
+        def custom_l2(preds, dataset):
+            lab = dataset.get_label()
+            return preds - lab, np.ones_like(preds)
+
+        bst = lgb.train({"objective": custom_l2, "verbosity": -1}, ds, 30)
+        pred = bst.predict(X)
+        assert np.mean((pred - y) ** 2) < 0.4 * np.var(y)
+
+
+class TestModelIO:
+    def test_save_load_roundtrip(self, tmp_path):
+        X, y = make_binary(700)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "verbosity": -1}, ds, 10)
+        path = str(tmp_path / "model.txt")
+        bst.save_model(path)
+        bst2 = lgb.Booster(model_file=path)
+        np.testing.assert_array_equal(bst.predict(X), bst2.predict(X))
+        assert bst2.num_trees() == 10
+
+    def test_model_string_format(self):
+        X, y = make_regression(500)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 3)
+        s = bst.model_to_string()
+        assert s.startswith("tree\n")
+        assert "version=v4" in s
+        assert "end of trees" in s
+        assert "feature_importances:" in s
+        assert "Tree=2" in s and "Tree=3" not in s
+
+    def test_continued_training(self):
+        X, y = make_regression(800)
+        ds = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst1 = lgb.train({"objective": "regression", "verbosity": -1}, ds, 10)
+        mse1 = np.mean((bst1.predict(X) - y) ** 2)
+        ds2 = lgb.Dataset(X, label=y, free_raw_data=False)
+        bst2 = lgb.train({"objective": "regression", "verbosity": -1}, ds2,
+                         10, init_model=bst1)
+        assert bst2.num_trees() == 20
+        mse2 = np.mean((bst2.predict(X) - y) ** 2)
+        assert mse2 < mse1
+
+    def test_dump_model(self):
+        X, y = make_regression(500)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 2)
+        d = bst.dump_model()
+        assert d["num_class"] == 1
+        assert len(d["tree_info"]) == 2
+        assert "tree_structure" in d["tree_info"][0]
+
+    def test_feature_importance(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 20)
+        imp = bst.feature_importance("split")
+        gain = bst.feature_importance("gain")
+        # features 0..2 drive the target
+        assert imp[:3].sum() > imp[3:].sum()
+        assert gain[0] == gain.max()
+
+
+class TestSamplingParams:
+    def test_bagging(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "bagging_fraction": 0.5,
+                         "bagging_freq": 1, "verbosity": -1}, ds, 20)
+        assert np.mean((bst.predict(X) - y) ** 2) < 0.5 * np.var(y)
+
+    def test_feature_fraction(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "feature_fraction": 0.5,
+                         "verbosity": -1}, ds, 20)
+        assert np.mean((bst.predict(X) - y) ** 2) < 0.5 * np.var(y)
+
+    def test_min_data_in_leaf_respected(self):
+        X, y = make_regression(400)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "min_data_in_leaf": 100,
+                         "verbosity": -1}, ds, 5)
+        for t in bst.trees:
+            assert (t.leaf_count[:t.num_leaves] >= 100).all()
+
+    def test_max_depth(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "max_depth": 2,
+                         "num_leaves": 31, "verbosity": -1}, ds, 5)
+        # depth<=2 → at most 4 leaves
+        for t in bst.trees:
+            assert t.num_leaves <= 4
+
+    def test_regularization(self):
+        X, y = make_regression()
+        ds = lgb.Dataset(X, label=y)
+        b0 = lgb.train({"objective": "regression", "verbosity": -1}, ds, 10)
+        b1 = lgb.train({"objective": "regression", "lambda_l2": 100.0,
+                        "verbosity": -1}, ds, 10)
+        # heavy L2 shrinks leaf outputs
+        m0 = max(np.abs(t.leaf_value).max() for t in b0.trees)
+        m1 = max(np.abs(t.leaf_value).max() for t in b1.trees)
+        assert m1 < m0
+
+
+class TestCV:
+    def test_cv_regression(self):
+        X, y = make_regression(900)
+        ds = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "regression", "metric": "l2",
+                      "verbosity": -1}, ds, 10, nfold=3, stratified=False)
+        assert len(res["valid l2-mean"]) == 10
+        assert res["valid l2-mean"][-1] < res["valid l2-mean"][0]
+
+    def test_cv_binary_stratified(self):
+        X, y = make_binary(900)
+        ds = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "binary", "metric": "auc",
+                      "verbosity": -1}, ds, 8, nfold=3)
+        assert res["valid auc-mean"][-1] > 0.85
+
+
+class TestMissing:
+    def test_nan_handling(self):
+        X, y = make_regression(1000)
+        Xm = X.copy()
+        Xm[::3, 0] = np.nan
+        ds = lgb.Dataset(Xm, label=y)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 20)
+        pred = bst.predict(Xm)
+        assert np.isfinite(pred).all()
+        # decision_type missing bits recorded for feature-0 splits
+        f0 = [(t.decision_type[i] >> 2) & 3
+              for t in bst.trees
+              for i in range(t.num_internal())
+              if t.split_feature[i] == 0]
+        assert f0 and all(m == 2 for m in f0)  # MissingType::NaN
+
+    def test_predict_with_unseen_nan(self):
+        X, y = make_regression(600)
+        ds = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 10)
+        Xq = X[:50].copy()
+        Xq[:, 0] = np.nan  # NaN at predict time, none in training
+        assert np.isfinite(bst.predict(Xq)).all()

@@ -1,0 +1,102 @@
+"""Benchmark: boosting rounds/sec on a Higgs-shaped binary problem.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline derivation (see BASELINE.md — `published` was empty, so the anchor
+is the upstream-documented CPU number): reference LightGBM trains Higgs
+(10.5M×28, 255 bins, 31 leaves) at ~500 iters/130 s ≈ 3.85 rounds/s on a
+16-core Xeon.  Scaled linearly to this bench's N rows, baseline
+rounds/s = 3.85 × (10.5e6 / N).  vs_baseline = ours / baseline, i.e. >1.0
+means faster than the reference CPU learner at equal work per round.
+
+Dataset: synthetic Higgs-like (N×28 features, binary labels from a noisy
+nonlinear score), fixed seed.  Training runs the fused device-side chunk
+trainer (ops/fused.py) — the TPU hot path — and times steady-state chunks
+after one warmup chunk (compile excluded).  AUC is printed to stderr as a
+sanity check.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+N = int(os.environ.get("BENCH_N", 1_000_000))
+F = 28
+ROUNDS_TIMED = int(os.environ.get("BENCH_ROUNDS", 48))
+NUM_LEAVES = 31
+MAX_BIN = 255
+
+BASELINE_HIGGS_ROUNDS_PER_SEC = 500.0 / 130.0
+BASELINE_HIGGS_ROWS = 10_500_000
+
+
+def make_higgs_like(n, f, seed=77):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    score = (1.2 * X[:, 0] - 0.8 * X[:, 1] + X[:, 2] * X[:, 3]
+             + 0.5 * np.sin(3 * X[:, 4]) + 0.6 * X[:, 5] ** 2
+             - 0.4 * np.abs(X[:, 6]))
+    y = (score + rng.randn(n) * 1.0 > 0).astype(np.float64)
+    return X, y
+
+
+def main() -> None:
+    t0 = time.time()
+    X, y = make_higgs_like(N, F)
+    import jax
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.booster import Booster
+
+    print(f"[bench] data {X.shape} built in {time.time()-t0:.1f}s; "
+          f"devices={jax.devices()}", file=sys.stderr)
+
+    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
+              "max_bin": MAX_BIN, "learning_rate": 0.1, "verbosity": -1}
+    t0 = time.time()
+    ds = lgb.Dataset(X, label=y)
+    bst = Booster(params=params, train_set=ds)
+    print(f"[bench] dataset binned + device init in {time.time()-t0:.1f}s",
+          file=sys.stderr)
+
+    chunk = bst._BULK_CHUNK
+    # warmup chunk: includes compile
+    t0 = time.time()
+    bst.update_many(chunk)
+    print(f"[bench] warmup chunk ({chunk} rounds) incl. compile: "
+          f"{time.time()-t0:.1f}s", file=sys.stderr)
+
+    timed_rounds = max(chunk, (ROUNDS_TIMED // chunk) * chunk)
+    t0 = time.time()
+    bst.update_many(timed_rounds)
+    # update_many decodes trees on host (one sync per chunk) — that cost is
+    # part of real training, so it stays inside the timed window
+    elapsed = time.time() - t0
+    rounds_per_sec = timed_rounds / elapsed
+
+    # sanity: AUC on a held-out slice
+    try:
+        from lightgbm_tpu.metrics import _auc
+        n_eval = min(100_000, N)
+        raw = bst.predict(X[:n_eval], raw_score=True)
+        auc = _auc(raw, y[:n_eval], None, None)
+        print(f"[bench] train-slice AUC after {bst.current_iteration()} "
+              f"rounds: {auc:.4f}", file=sys.stderr)
+    except Exception as e:  # pragma: no cover
+        print(f"[bench] AUC check failed: {e}", file=sys.stderr)
+
+    baseline = BASELINE_HIGGS_ROUNDS_PER_SEC * (BASELINE_HIGGS_ROWS / N)
+    print(json.dumps({
+        "metric": f"boosting_rounds_per_sec_higgs{N//1000}k",
+        "value": round(rounds_per_sec, 3),
+        "unit": "rounds/s",
+        "vs_baseline": round(rounds_per_sec / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -56,8 +56,10 @@ class _DeviceData:
         self.feat_default = jnp.asarray(
             np.array([m.default_bin for m in mappers], dtype=np.int32))
         self.base_allowed = np.array(
-            [not m.is_trivial and m.bin_type != BIN_TYPE_CATEGORICAL
-             for m in mappers], dtype=bool)
+            [not m.is_trivial for m in mappers], dtype=bool)
+        self.is_cat = jnp.asarray(np.array(
+            [m.bin_type == BIN_TYPE_CATEGORICAL for m in mappers],
+            dtype=bool))
         self.max_bin = max(int(m.num_bin) for m in mappers)
         label = ds.get_label()
         self.label = jnp.asarray(label.astype(np.float32)) \
@@ -81,12 +83,20 @@ def _traverse_padded(tree: Tree, num_leaves_cap: int, dd: _DeviceData,
         return jnp.asarray(out)
 
     feat = pad(tree.split_feature[:ni], ni_cap, np.int32)
-    thr = pad(tree.threshold_bin[:ni], ni_cap, np.int32)
+    is_cat_node = (tree.decision_type[:ni] & 1) != 0
+    thr = pad(np.where(is_cat_node, 0, tree.threshold_bin[:ni]),
+              ni_cap, np.int32)
     dl = pad((tree.decision_type[:ni] & 2) != 0, ni_cap, bool)
     left = pad(tree.left_child[:ni], ni_cap, np.int32)
     right = pad(tree.right_child[:ni], ni_cap, np.int32)
     vals = pad(scale_values, num_leaves_cap, np.float32)
-    return feat, thr, dl, left, right, vals
+    iscat = pad(is_cat_node, ni_cap, bool)
+    catmask = np.zeros((ni_cap, dd.max_bin), dtype=bool)
+    if tree.num_cat > 0 and tree.cat_bin_masks.size:
+        for i in np.nonzero(is_cat_node)[0]:
+            m = tree.cat_bin_masks[int(tree.threshold_bin[i])]
+            catmask[i, :len(m)] = m[:dd.max_bin]
+    return feat, thr, dl, left, right, iscat, jnp.asarray(catmask), vals
 
 
 _jit_traverse = jax.jit(traverse_bins)
@@ -163,6 +173,31 @@ class Booster:
         metric_names = self.config.metric or self.config.default_metric()
         self.metrics_: List[Metric] = create_metrics(self.config, metric_names)
 
+        # boosting mode / sample strategy (ref: Boosting::CreateBoosting and
+        # v4 data_sample_strategy: "goss" as boosting type is the legacy
+        # spelling of strategy=goss on gbdt)
+        boosting = self.config.boosting
+        if boosting not in ("gbdt", "dart", "goss", "rf"):
+            raise LightGBMError(f"Unknown boosting type {boosting}")
+        self._use_goss = (boosting == "goss" or
+                          self.config.data_sample_strategy == "goss")
+        self._boost_mode = "gbdt" if boosting == "goss" else boosting
+        if self._boost_mode == "rf":
+            if not (self.config.bagging_freq > 0 and
+                    (self.config.bagging_fraction < 1.0 or
+                     self.config.feature_fraction < 1.0)):
+                raise LightGBMError(
+                    "Random forest mode requires bagging "
+                    "(bagging_freq > 0 and bagging_fraction < 1.0)")
+            # RF trees are independent averages: no init score, no shrinkage
+            self.config.boost_from_average = False
+        if self._boost_mode == "dart":
+            # keep DART trees bias-free so drop/rescale math stays exact
+            # (deviation: reference folds boost_from_average into tree 0 and
+            # scales it along; starting from 0 avoids that coupling)
+            self.config.boost_from_average = False
+        self._average_output = self._boost_mode == "rf"
+
         self._grower_spec = GrowerSpec(
             num_leaves=self.config.num_leaves,
             max_depth=self.config.max_depth,
@@ -173,9 +208,17 @@ class Booster:
             min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
             min_gain_to_split=self.config.min_gain_to_split,
             max_delta_step=self.config.max_delta_step,
+            cat_smooth=self.config.cat_smooth,
+            cat_l2=self.config.cat_l2,
+            max_cat_threshold=self.config.max_cat_threshold,
+            max_cat_to_onehot=self.config.max_cat_to_onehot,
         )
         self._grower = make_grower(self._grower_spec)
         self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
+        self._rng_key0 = jax.random.PRNGKey(
+            self.config.bagging_seed % (2 ** 31))
+        self._ff_key0 = jax.random.PRNGKey(
+            self.config.feature_fraction_seed % (2 ** 31))
 
         K = self.num_tree_per_iteration
         self._init_scores = [0.0] * K
@@ -187,10 +230,18 @@ class Booster:
         if self.objective_ is not None:
             lbl = self._dd.label
             wgt = self._dd.weight
-
-            def _grad(score):
-                return self.objective_.grad_hess(score, lbl, wgt)
-            self._grad_fn = jax.jit(_grad)
+            if getattr(self.objective_, "needs_rng", False):
+                def _grad(score, key):
+                    return self.objective_.grad_hess(score, lbl, wgt, key=key)
+                self._grad_rng_fn = jax.jit(_grad)
+                self._grad_fn = lambda s: self._grad_rng_fn(
+                    s, jax.random.PRNGKey(
+                        (self.config.objective_seed + self.cur_iter)
+                        % (2 ** 31)))
+            else:
+                def _grad(score):
+                    return self.objective_.grad_hess(score, lbl, wgt)
+                self._grad_fn = jax.jit(_grad)
 
     def _zero_score(self, dd: _DeviceData) -> jax.Array:
         K = self.num_tree_per_iteration
@@ -251,43 +302,36 @@ class Booster:
 
     def _sample_weights(self, iteration: int) -> jax.Array:
         """Bagging mask (ref: GBDT::Bagging / bagging.hpp) — fixed-shape
-        0/1 weights instead of index subsets."""
+        0/1 weights instead of index subsets; key derivation shared with the
+        fused chunk trainer (ops/fused.py) so both paths grow identical trees."""
         cfg = self.config
         n = self._dd.num_data
-        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
-            if not (cfg.pos_bagging_fraction < 1.0 or
-                    cfg.neg_bagging_fraction < 1.0):
-                return self._ones
-        if iteration % max(cfg.bagging_freq, 1) == 0 or \
-                not hasattr(self, "_bag_mask"):
+        if (cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0) \
+                and cfg.bagging_freq > 0:
+            # per-class bagging stays host-side (label-dependent, binary
+            # only); the bag renews every bagging_freq iterations
+            bag_it = iteration // cfg.bagging_freq
             rng = np.random.RandomState(
-                (cfg.bagging_seed + iteration) % (2 ** 31))
-            if cfg.pos_bagging_fraction < 1.0 or cfg.neg_bagging_fraction < 1.0:
-                label = self.train_set.get_label()
-                mask = np.zeros(n, dtype=np.float32)
-                pos = label > 0
-                mask[pos] = (rng.rand(int(pos.sum())) <
-                             cfg.pos_bagging_fraction)
-                mask[~pos] = (rng.rand(int((~pos).sum())) <
-                              cfg.neg_bagging_fraction)
-            else:
-                mask = (rng.rand(n) < cfg.bagging_fraction).astype(np.float32)
-            self._bag_mask = jnp.asarray(mask)
-        return self._bag_mask
+                (cfg.bagging_seed + bag_it) % (2 ** 31))
+            label = self.train_set.get_label()
+            mask = np.zeros(n, dtype=np.float32)
+            pos = label > 0
+            mask[pos] = (rng.rand(int(pos.sum())) < cfg.pos_bagging_fraction)
+            mask[~pos] = (rng.rand(int((~pos).sum())) <
+                          cfg.neg_bagging_fraction)
+            return jnp.asarray(mask)
+        if cfg.bagging_freq <= 0 or cfg.bagging_fraction >= 1.0:
+            return self._ones
+        from .ops.fused import bagging_weights
+        return bagging_weights(iteration, self._rng_key0, n,
+                               bagging_fraction=cfg.bagging_fraction,
+                               bagging_freq=cfg.bagging_freq)
 
     def _feature_mask(self, iteration: int, k: int) -> jax.Array:
-        cfg = self.config
-        allowed = self._dd.base_allowed
-        if cfg.feature_fraction < 1.0:
-            f = self._dd.num_feature
-            n_pick = max(1, int(np.ceil(cfg.feature_fraction * f)))
-            rng = np.random.RandomState(
-                (cfg.feature_fraction_seed + iteration * 7 + k) % (2 ** 31))
-            chosen = rng.choice(f, n_pick, replace=False)
-            mask = np.zeros(f, dtype=bool)
-            mask[chosen] = True
-            allowed = allowed & mask
-        return jnp.asarray(allowed)
+        from .ops.fused import feature_mask
+        base = jnp.asarray(self._dd.base_allowed)
+        return feature_mask(iteration, k, self._ff_key0, base,
+                            feature_fraction=self.config.feature_fraction)
 
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration (ref: basic.py Booster.update →
@@ -296,13 +340,20 @@ class Booster:
             self._init_train(train_set)
         fobj = fobj or self._fobj
         K = self.num_tree_per_iteration
+        if self._boost_mode == "dart":
+            return self._update_dart(fobj)
         if fobj is None:
             if self.objective_ is None:
                 raise LightGBMError(
                     "Custom objective function (fobj) is required when "
                     "objective is none/custom")
             self._boost_from_average()
-            grad, hess = self._grad_fn(self._train_score)
+            score = self._train_score
+            if self._boost_mode == "rf":
+                # RF trees are independent: gradients always taken at the
+                # constant base score (ref: rf.hpp RF::Boosting)
+                score = jnp.zeros_like(self._train_score)
+            grad, hess = self._grad_fn(score)
         else:
             preds = np.asarray(self._train_score, dtype=np.float64)
             if K > 1:
@@ -317,13 +368,34 @@ class Booster:
                 hess = hess.reshape((-1, K))
         return self.__boost(grad, hess)
 
+    def _goss_weights(self, iteration: int, grad, hess) -> jax.Array:
+        """GOSS sample weights (ref: src/boosting/goss.hpp `GOSS::Bagging`):
+        keep top_rate by |g·h|, sample other_rate of the rest, amplify the
+        sampled small-gradient rows by (1-a)/b so the distribution is
+        unbiased.  Fixed-shape mask instead of index subsets."""
+        cfg = self.config
+        n = self._dd.num_data
+        # ref: GOSS waits 1/learning_rate iterations before sampling
+        if iteration < int(1.0 / cfg.learning_rate):
+            return self._ones
+        if cfg.top_rate + cfg.other_rate >= 1.0:
+            return self._ones
+        from .ops.fused import goss_weights
+        return goss_weights(iteration, self._rng_key0, grad, hess, n,
+                            top_rate=cfg.top_rate,
+                            other_rate=cfg.other_rate,
+                            goss_start_iter=int(1.0 / cfg.learning_rate))
+
     def __boost(self, grad, hess) -> bool:
         cfg = self.config
         K = self.num_tree_per_iteration
         it = self.cur_iter
-        sw = self._sample_weights(it)
+        if self._use_goss:
+            sw = self._goss_weights(it, grad, hess)
+        else:
+            sw = self._sample_weights(it)
         dd = self._dd
-        lr = cfg.learning_rate
+        lr = 1.0 if self._boost_mode == "rf" else cfg.learning_rate
         all_const = True
         self._last_contribs = []  # for rollback_one_iter
         for k in range(K):
@@ -333,7 +405,7 @@ class Booster:
             dev = self._grower(dd.bins_fm, gk.astype(jnp.float32),
                                hk.astype(jnp.float32), sw,
                                dd.feat_nb, dd.feat_missing, dd.feat_default,
-                               allowed)
+                               allowed, dd.is_cat)
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
             if tree.num_leaves > 1:
                 all_const = False
@@ -368,11 +440,13 @@ class Booster:
             contrib = jnp.full((dd.num_data,), float(tree.leaf_value[0])
                                if bias_included else 0.0, dtype=jnp.float32)
         else:
-            feat, thr, dl, left, right, v = _traverse_padded(
-                tree, self.config.num_leaves, dd,
-                np.asarray(tree.leaf_value, dtype=np.float32))
-            leaf_idx = _jit_traverse(feat, thr, dl, left, right,
-                                     dd.feat_nb, dd.feat_missing, dd.bins_fm)
+            feat, thr, dl, left, right, iscat, catmask, v = \
+                _traverse_padded(
+                    tree, self.config.num_leaves, dd,
+                    np.asarray(tree.leaf_value, dtype=np.float32))
+            leaf_idx = _jit_traverse(feat, thr, dl, left, right, iscat,
+                                     catmask, dd.feat_nb, dd.feat_missing,
+                                     dd.bins_fm)
             contrib = v[leaf_idx]
         if record is not None:
             self._last_contribs.append(("valid", record, k, contrib))
@@ -423,16 +497,195 @@ class Booster:
         self.cur_iter -= 1
         return self
 
+    # ------------------------------------------------- fused bulk training
+    _BULK_CHUNK = 16
+
+    def _bulk_eligible(self) -> bool:
+        cfg = self.config
+        return (self._fobj is None and self.objective_ is not None
+                and not getattr(self.objective_, "needs_rng", False)
+                and self._boost_mode == "gbdt"
+                and not self._valid_dd
+                and cfg.pos_bagging_fraction >= 1.0
+                and cfg.neg_bagging_fraction >= 1.0)
+
+    def update_many(self, n_rounds: int) -> bool:
+        """Run `n_rounds` boosting iterations, fusing them into compiled
+        device-side chunks when nothing needs the host in between.  Falls
+        back to per-iteration updates otherwise.  Returns the final
+        `update()`-style is_finished flag."""
+        finished = False
+        remaining = n_rounds
+        if self._bulk_eligible() and remaining >= self._BULK_CHUNK:
+            from .ops.fused import BulkSpec, make_bulk_trainer
+            cfg = self.config
+            self._boost_from_average()
+            spec = BulkSpec(
+                grower=self._grower_spec, chunk=self._BULK_CHUNK,
+                num_class=self.num_tree_per_iteration,
+                learning_rate=cfg.learning_rate,
+                bagging_fraction=cfg.bagging_fraction,
+                bagging_freq=cfg.bagging_freq,
+                use_goss=self._use_goss
+                and cfg.top_rate + cfg.other_rate < 1.0,
+                top_rate=cfg.top_rate,
+                other_rate=cfg.other_rate,
+                goss_start_iter=int(1.0 / cfg.learning_rate),
+                feature_fraction=cfg.feature_fraction)
+            trainer = self._bulk_trainer_cache = getattr(
+                self, "_bulk_trainer_cache", None)
+            if trainer is None or \
+                    getattr(self, "_bulk_spec", None) != spec:
+                trainer = make_bulk_trainer(spec, self._grad_fn)
+                self._bulk_trainer_cache = trainer
+                self._bulk_spec = spec
+            dd = self._dd
+            base = jnp.asarray(dd.base_allowed)
+            while remaining >= self._BULK_CHUNK:
+                score, stacked = trainer(
+                    self._train_score, jnp.int32(self.cur_iter),
+                    self._rng_key0, self._ff_key0, dd.bins_fm, dd.feat_nb,
+                    dd.feat_missing, dd.feat_default, base, dd.is_cat)
+                self._train_score = score
+                finished = self._decode_stacked(stacked)
+                remaining -= self._BULK_CHUNK
+        for _ in range(remaining):
+            finished = self.update()
+        return finished
+
+    def _decode_stacked(self, stacked) -> bool:
+        """Decode a chunk of stacked device trees into host Tree objects —
+        ONE device→host sync for the whole chunk."""
+        host = jax.device_get(stacked)
+        K = self.num_tree_per_iteration
+        lr = self.config.learning_rate
+        chunk = host.n_splits.shape[0]
+        all_const = True
+        for c in range(chunk):
+            for k in range(K):
+                if K == 1:
+                    dev = DeviceTree(*[np.asarray(f[c]) for f in host])
+                else:
+                    dev = DeviceTree(*[np.asarray(f[c, k]) for f in host])
+                tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
+                if tree.num_leaves > 1:
+                    all_const = False
+                if self.cur_iter == 0 and abs(self._init_scores[k]) > 1e-35:
+                    tree.add_bias(self._init_scores[k])
+                self.trees.append(tree)
+            self.cur_iter += 1
+        self._last_contribs = []
+        return all_const
+
+    def _update_dart(self, fobj=None) -> bool:
+        """DART iteration (ref: src/boosting/dart.hpp `DART::TrainOneIter`:
+        `DroppingTrees` → re-score without dropped trees → train → `Normalize`)."""
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        it = self.cur_iter
+        if fobj is None and self.objective_ is None:
+            raise LightGBMError("Custom objective function (fobj) is "
+                                "required when objective is none/custom")
+        self._boost_from_average()
+        rng = np.random.RandomState((cfg.drop_seed + it) % (2 ** 31))
+        dropped: List[int] = []
+        if it > 0 and rng.rand() >= cfg.skip_drop:
+            sel = np.nonzero(rng.rand(it) < cfg.drop_rate)[0]
+            if cfg.max_drop > 0 and len(sel) > cfg.max_drop:
+                sel = rng.choice(sel, cfg.max_drop, replace=False)
+            if len(sel) == 0:
+                sel = np.array([rng.randint(it)])
+            dropped = sorted(int(d) for d in sel)
+        # drop: remove their contributions from all running scores
+        for d in dropped:
+            for k in range(K):
+                tree = self.trees[d * K + k]
+                self._train_score = self._subtract_tree(
+                    self._train_score, tree, self._dd, k, 0.0)
+                for vi, vdd in enumerate(self._valid_dd):
+                    self._valid_scores[vi] = self._subtract_tree(
+                        self._valid_scores[vi], tree, vdd, k, 0.0)
+        if fobj is not None:
+            preds = np.asarray(self._train_score, dtype=np.float64)
+            if K > 1:
+                preds = preds.reshape(-1, order="F")
+            g, h = fobj(preds, self.train_set)
+            grad = jnp.asarray(np.asarray(g, dtype=np.float32)
+                               .reshape((-1, K), order="F").squeeze())
+            hess = jnp.asarray(np.asarray(h, dtype=np.float32)
+                               .reshape((-1, K), order="F").squeeze())
+            if K > 1:
+                grad = grad.reshape((-1, K))
+                hess = hess.reshape((-1, K))
+        else:
+            grad, hess = self._grad_fn(self._train_score)
+        finished = self.__boost(grad, hess)
+        kdrop = len(dropped)
+        if kdrop > 0:
+            # ref: DART::Normalize
+            if cfg.xgboost_dart_mode:
+                new_scale = cfg.learning_rate / (kdrop + cfg.learning_rate)
+                old_scale = kdrop / (kdrop + cfg.learning_rate)
+            else:
+                new_scale = 1.0 / (kdrop + 1.0)
+                old_scale = kdrop / (kdrop + 1.0)
+            for k in range(K):
+                tree = self.trees[-K + k]
+                tree.leaf_value = tree.leaf_value * new_scale
+                tree.internal_value = tree.internal_value * new_scale
+                tree.shrinkage *= new_scale
+            # new trees entered the scores at full scale: shave the excess
+            for entry in self._last_contribs:
+                if entry[0] == "train":
+                    _, k, contrib = entry
+                    adj = contrib * (1.0 - new_scale)
+                    if self._train_score.ndim == 1:
+                        self._train_score = self._train_score - adj
+                    else:
+                        self._train_score = \
+                            self._train_score.at[:, k].add(-adj)
+                else:
+                    _, vi, k, contrib = entry
+                    adj = contrib * (1.0 - new_scale)
+                    if self._valid_scores[vi].ndim == 1:
+                        self._valid_scores[vi] = self._valid_scores[vi] - adj
+                    else:
+                        self._valid_scores[vi] = \
+                            self._valid_scores[vi].at[:, k].add(-adj)
+            self._last_contribs = []
+            # dropped trees come back rescaled
+            for d in dropped:
+                for k in range(K):
+                    tree = self.trees[d * K + k]
+                    tree.leaf_value = tree.leaf_value * old_scale
+                    tree.internal_value = tree.internal_value * old_scale
+                    tree.shrinkage *= old_scale
+                    self._train_score = self._apply_tree_to_score(
+                        self._train_score, tree, self._dd, k,
+                        bias_included=True)
+                    for vi, vdd in enumerate(self._valid_dd):
+                        self._valid_scores[vi] = self._apply_tree_to_score(
+                            self._valid_scores[vi], tree, vdd, k,
+                            bias_included=True)
+        return finished
+
     def _subtract_tree(self, score, tree: Tree, dd: _DeviceData, k: int,
                        bias: float):
         """score -= tree(bins) where the stored tree may carry a folded-in
-        bias that the running score tracks separately."""
+        bias that the running score tracks separately.  Mirrors
+        `_apply_tree_to_score` exactly, including the constant-tree case."""
         if tree.num_leaves <= 1:
-            return score
-        feat, thr, dl, left, right, v = _traverse_padded(
+            const = float(tree.leaf_value[0]) - bias \
+                if len(tree.leaf_value) else 0.0
+            if const == 0.0:
+                return score
+            if score.ndim == 1:
+                return score - const
+            return score.at[:, k].add(-const)
+        feat, thr, dl, left, right, iscat, catmask, v = _traverse_padded(
             tree, self.config.num_leaves, dd,
             np.asarray(tree.leaf_value - bias, dtype=np.float32))
-        leaf_idx = _jit_traverse(feat, thr, dl, left, right,
+        leaf_idx = _jit_traverse(feat, thr, dl, left, right, iscat, catmask,
                                  dd.feat_nb, dd.feat_missing, dd.bins_fm)
         contrib = v[leaf_idx]
         if score.ndim == 1:
@@ -469,16 +722,22 @@ class Booster:
                     out.append((data_name, name, val, hib))
         return out
 
+    def _eval_score(self, score) -> np.ndarray:
+        s = np.asarray(score, dtype=np.float64)
+        if self._average_output and self.cur_iter > 0:
+            s = s / self.cur_iter
+        return s
+
     def eval_train(self, feval=None) -> List[Tuple[str, str, float, bool]]:
-        score = np.asarray(self._train_score, dtype=np.float64)
-        return self._eval_one(score, self.train_set, "training", feval)
+        return self._eval_one(self._eval_score(self._train_score),
+                              self.train_set, "training", feval)
 
     def eval_valid(self, feval=None) -> List[Tuple[str, str, float, bool]]:
         out = []
         for name, ds, score in zip(self.name_valid_sets, self.valid_sets,
                                    self._valid_scores):
-            out.extend(self._eval_one(np.asarray(score, dtype=np.float64),
-                                      ds, name, feval))
+            out.extend(self._eval_one(self._eval_score(score), ds, name,
+                                      feval))
         return out
 
     def eval(self, data: Dataset, name: str, feval=None):
@@ -486,9 +745,8 @@ class Booster:
             return self.eval_train(feval)
         for i, vs in enumerate(self.valid_sets):
             if data is vs:
-                return self._eval_one(
-                    np.asarray(self._valid_scores[i], dtype=np.float64),
-                    data, name, feval)
+                return self._eval_one(self._eval_score(self._valid_scores[i]),
+                                      data, name, feval)
         raise LightGBMError("Data for eval must be training or validation "
                             "data (use add_valid first)")
 
@@ -525,6 +783,8 @@ class Booster:
         raw = np.zeros((n, K), dtype=np.float64)
         for i, t in enumerate(trees):
             raw[:, i % K] += t.predict(X)
+        if getattr(self, "_average_output", False) and len(trees) >= K:
+            raw /= max(len(trees) // K, 1)
         if K == 1:
             raw = raw[:, 0]
         if raw_score or self.objective_ is None:
@@ -582,6 +842,8 @@ class Booster:
         buf.write("label_index=0\n")
         buf.write(f"max_feature_idx={len(fnames) - 1}\n")
         buf.write(f"objective={self._objective_to_string()}\n")
+        if getattr(self, "_average_output", False):
+            buf.write("average_output\n")
         buf.write("feature_names=" + " ".join(fnames) + "\n")
         if self.train_set is not None and self.train_set.bin_mappers:
             infos = [m.feature_info_str() for m in self.train_set.bin_mappers]
@@ -628,6 +890,7 @@ class Booster:
             i += 1
         self.num_tree_per_iteration = int(
             header.get("num_tree_per_iteration", 1))
+        self._average_output = "average_output" in lines[:i]
         self._loaded_feature_names = header.get("feature_names", "").split()
         self._loaded_feature_infos = header.get("feature_infos", "").split()
         obj_str = header.get("objective", "regression").split()

@@ -87,6 +87,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     evaluation_result_list: List = []
     begin_iteration = booster.current_iteration()
     end_iteration = begin_iteration + num_boost_round
+
+    # nothing needs the host between iterations → fused device-side chunks
+    if (not booster.valid_sets and feval is None and not callbacks_before
+            and not callbacks_after and not _eval_train_requested(params)):
+        booster.update_many(num_boost_round)
+        booster.best_iteration = booster.current_iteration()
+        return booster
+
     for i in range(begin_iteration, end_iteration):
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(

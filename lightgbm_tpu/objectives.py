@@ -500,5 +500,11 @@ def create_objective(config: Config) -> Optional[ObjectiveFunction]:
     if name in ("custom", "none", None):
         return None
     if name not in _OBJECTIVES:
+        # ranking objectives live in rank_objective.py; lazy-register to
+        # avoid an import cycle
+        from . import rank_objective
+        _OBJECTIVES.setdefault("lambdarank", rank_objective.LambdarankNDCG)
+        _OBJECTIVES.setdefault("rank_xendcg", rank_objective.RankXENDCG)
+    if name not in _OBJECTIVES:
         raise LightGBMError(f"Unknown objective: {name}")
     return _OBJECTIVES[name](config)

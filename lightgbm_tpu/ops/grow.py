@@ -47,6 +47,10 @@ class GrowerSpec(NamedTuple):
     min_sum_hessian_in_leaf: float
     min_gain_to_split: float
     max_delta_step: float
+    cat_smooth: float = 10.0
+    cat_l2: float = 10.0
+    max_cat_threshold: int = 32
+    max_cat_to_onehot: int = 4
 
 
 class DeviceTree(NamedTuple):
@@ -63,6 +67,8 @@ class DeviceTree(NamedTuple):
     split_feature: Array  # [L-1] i32
     threshold_bin: Array  # [L-1] i32
     default_left: Array   # [L-1] bool
+    split_is_cat: Array   # [L-1] bool
+    split_cat_mask: Array  # [L-1, MB] bool — left-subset bins of cat splits
     split_gain: Array     # [L-1] f32
     internal_g: Array     # [L-1] f32 — node Σgrad (left+right)
     internal_h: Array     # [L-1] f32
@@ -77,12 +83,24 @@ class DeviceTree(NamedTuple):
 def _split_to_arrays(s: SplitResult):
     return (s.gain, s.feature, s.threshold_bin, s.default_left,
             s.left_sum_g, s.left_sum_h, s.left_cnt,
-            s.right_sum_g, s.right_sum_h, s.right_cnt)
+            s.right_sum_g, s.right_sum_h, s.right_cnt,
+            s.is_cat, s.cat_mask)
 
 
 @functools.lru_cache(maxsize=64)
-def make_grower(spec: GrowerSpec):
-    """Build (and cache) the jitted grow function for a static spec."""
+def make_grower(spec: GrowerSpec, axis_name: str = None):
+    """Build (and cache) the jitted grow function for a static spec.
+
+    With `axis_name`, the grower becomes the DATA-PARALLEL tree learner
+    (ref: src/treelearner/data_parallel_tree_learner.cpp): rows are sharded
+    over the named mesh axis, each shard histograms its local rows, and the
+    histograms are `psum`med over ICI — the TPU equivalent of
+    `Network::ReduceScatter` + per-feature split finding + the SplitInfo
+    `Allreduce(max)` (every shard then computes the identical argmax from the
+    identical summed histogram, trading redundant O(F·MB) compute for zero
+    extra collectives; split application is shard-local, no row exchange,
+    exactly like the reference).  Call it under `jax.shard_map`.
+    """
     L = spec.num_leaves
     MB = spec.max_bin
     find = functools.partial(
@@ -90,7 +108,10 @@ def make_grower(spec: GrowerSpec):
         l1=spec.lambda_l1, l2=spec.lambda_l2,
         min_data_in_leaf=spec.min_data_in_leaf,
         min_sum_hessian=spec.min_sum_hessian_in_leaf,
-        min_gain_to_split=spec.min_gain_to_split)
+        min_gain_to_split=spec.min_gain_to_split,
+        cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
+        max_cat_threshold=spec.max_cat_threshold,
+        max_cat_to_onehot=spec.max_cat_to_onehot)
 
     def grow(bins_fm: Array,       # [F, N] uint8/16 feature-major
              grad: Array,          # [N] f32
@@ -99,18 +120,22 @@ def make_grower(spec: GrowerSpec):
              feat_nb: Array,       # [F] i32
              feat_missing: Array,  # [F] i32
              feat_default: Array,  # [F] i32
-             allowed: Array,       # [F] bool (trivial/categorical/colsample)
+             allowed: Array,       # [F] bool (trivial/colsample masked out)
+             is_cat: Array,        # [F] bool categorical features
              ) -> DeviceTree:
         F, N = bins_fm.shape
         payload = jnp.stack([grad * sample_weight, hess * sample_weight,
                              sample_weight], axis=1)  # [N, 3]
 
         def hist_of(mask_rows):
-            return leaf_histogram(bins_fm, payload, mask_rows, MB)
+            h = leaf_histogram(bins_fm, payload, mask_rows, MB)
+            if axis_name is not None:
+                h = jax.lax.psum(h, axis_name)
+            return h
 
         def split_of(hist, g, h, c, node_allowed):
             return find(hist, g, h, c, feat_nb, feat_missing, feat_default,
-                        node_allowed)
+                        node_allowed, is_cat)
 
         # ---- root ----
         root_mask = jnp.ones((N,), dtype=bool)
@@ -118,10 +143,15 @@ def make_grower(spec: GrowerSpec):
         root_g = payload[:, 0].sum()
         root_h = payload[:, 1].sum()
         root_c = payload[:, 2].sum()
+        if axis_name is not None:
+            # ref: DataParallelTreeLearner::BeforeTrain root-stat Allreduce
+            root_g = jax.lax.psum(root_g, axis_name)
+            root_h = jax.lax.psum(root_h, axis_name)
+            root_c = jax.lax.psum(root_c, axis_name)
         s0 = split_of(hist0, root_g, root_h, root_c, allowed)
 
         hist = jnp.zeros((L, F, MB, 3), dtype=jnp.float32).at[0].set(hist0)
-        leaf_best = [jnp.zeros((L,), dtype=a.dtype)
+        leaf_best = [jnp.zeros((L,) + a.shape, dtype=a.dtype)
                      .at[0].set(a) for a in _split_to_arrays(s0)]
         leaf_best[0] = jnp.full((L,), NEG_INF, dtype=jnp.float32).at[0]\
             .set(s0.gain)
@@ -135,6 +165,8 @@ def make_grower(spec: GrowerSpec):
             split_feature=jnp.zeros((L - 1,), jnp.int32),
             threshold_bin=jnp.zeros((L - 1,), jnp.int32),
             default_left=jnp.zeros((L - 1,), bool),
+            split_is_cat=jnp.zeros((L - 1,), bool),
+            split_cat_mask=jnp.zeros((L - 1, MB), bool),
             split_gain=jnp.zeros((L - 1,), jnp.float32),
             internal_g=jnp.zeros((L - 1,), jnp.float32),
             internal_h=jnp.zeros((L - 1,), jnp.float32),
@@ -148,6 +180,7 @@ def make_grower(spec: GrowerSpec):
             leaf_thr=leaf_best[2], leaf_dl=leaf_best[3],
             leaf_lg=leaf_best[4], leaf_lh=leaf_best[5], leaf_lc=leaf_best[6],
             leaf_rg=leaf_best[7], leaf_rh=leaf_best[8], leaf_rc=leaf_best[9],
+            leaf_iscat=leaf_best[10], leaf_catmask=leaf_best[11],
             leaf_g=leaf_g, leaf_h=leaf_h, leaf_c=leaf_c,
             leaf_depth=leaf_depth, nodes=nodes,
         )
@@ -162,11 +195,14 @@ def make_grower(spec: GrowerSpec):
             f = st["leaf_feat"][best]
             t = st["leaf_thr"][best]
             dl = st["leaf_dl"][best]
+            node_cat = st["leaf_iscat"][best]
+            node_mask = st["leaf_catmask"][best]
 
             # ---- partition: dense leaf_id update (no row movement) ----
             fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)  # [N]
             is_nan_bin = (feat_missing[f] == 2) & (fbins == feat_nb[f] - 1)
-            go_left = jnp.where(is_nan_bin, dl, fbins <= t)
+            go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
+            go_left = jnp.where(node_cat, node_mask[fbins], go_left_num)
             in_leaf = st["leaf_id"] == best
             leaf_id = jnp.where(in_leaf & ~go_left, new, st["leaf_id"])
 
@@ -177,6 +213,8 @@ def make_grower(spec: GrowerSpec):
                 split_feature=nodes["split_feature"].at[step].set(f),
                 threshold_bin=nodes["threshold_bin"].at[step].set(t),
                 default_left=nodes["default_left"].at[step].set(dl),
+                split_is_cat=nodes["split_is_cat"].at[step].set(node_cat),
+                split_cat_mask=nodes["split_cat_mask"].at[step].set(node_mask),
                 split_gain=nodes["split_gain"].at[step].set(
                     st["leaf_gain"][best]),
                 internal_g=nodes["internal_g"].at[step].set(st["leaf_g"][best]),
@@ -223,6 +261,8 @@ def make_grower(spec: GrowerSpec):
                 leaf_rg=put2(st["leaf_rg"], la[7], ra[7]),
                 leaf_rh=put2(st["leaf_rh"], la[8], ra[8]),
                 leaf_rc=put2(st["leaf_rc"], la[9], ra[9]),
+                leaf_iscat=put2(st["leaf_iscat"], la[10], ra[10]),
+                leaf_catmask=put2(st["leaf_catmask"], la[11], ra[11]),
                 leaf_g=put2(st["leaf_g"], lg, rg),
                 leaf_h=put2(st["leaf_h"], lh, rh),
                 leaf_c=put2(st["leaf_c"], lc, rc),
@@ -249,6 +289,8 @@ def make_grower(spec: GrowerSpec):
             split_feature=st["nodes"]["split_feature"],
             threshold_bin=st["nodes"]["threshold_bin"],
             default_left=st["nodes"]["default_left"],
+            split_is_cat=st["nodes"]["split_is_cat"],
+            split_cat_mask=st["nodes"]["split_cat_mask"],
             split_gain=st["nodes"]["split_gain"],
             internal_g=st["nodes"]["internal_g"],
             internal_h=st["nodes"]["internal_h"],

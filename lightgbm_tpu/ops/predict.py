@@ -22,12 +22,14 @@ Array = jax.Array
 
 def traverse_bins(node_feat: Array, node_thr_bin: Array, node_dl: Array,
                   node_left: Array, node_right: Array,
+                  node_iscat: Array, node_catmask: Array,
                   feat_nb: Array, feat_missing: Array,
                   bins_fm: Array) -> Array:
     """Route every row to its leaf using bin-level decisions.
 
     Args:
-      node_*: [NI] internal-node arrays (child < 0 encodes leaf ~child).
+      node_*: [NI] internal-node arrays (child < 0 encodes leaf ~child);
+        node_catmask is [NI, MB] — left-subset bins of categorical splits.
       feat_nb / feat_missing: [F] per-feature bin metadata.
       bins_fm: [F, N] feature-major bin matrix.
 
@@ -43,7 +45,8 @@ def traverse_bins(node_feat: Array, node_thr_bin: Array, node_dl: Array,
             f = node_feat[nd]
             b = bins_fm[f, r].astype(jnp.int32)
             is_nan = (feat_missing[f] == 2) & (b == feat_nb[f] - 1)
-            go_left = jnp.where(is_nan, node_dl[nd], b <= node_thr_bin[nd])
+            go_num = jnp.where(is_nan, node_dl[nd], b <= node_thr_bin[nd])
+            go_left = jnp.where(node_iscat[nd], node_catmask[nd, b], go_num)
             return jnp.where(go_left, node_left[nd], node_right[nd])
 
         nd = jax.lax.while_loop(cond, body, jnp.int32(0))

@@ -3,15 +3,26 @@
 TPU-native re-design of the reference's split finder
 (ref: src/treelearner/feature_histogram.hpp
 `FeatureHistogram::FindBestThresholdNumerical` [fwd+bwd missing-direction
-scans], `GetSplitGains`, `CalculateSplittedLeafOutput`, `GetLeafGain`;
+scans], `FindBestThresholdCategorical` [one-vs-rest for few categories,
+sorted many-vs-rest by grad/hess ratio with cat_smooth/cat_l2 otherwise],
+`GetSplitGains`, `CalculateSplittedLeafOutput`, `GetLeafGain`;
 src/treelearner/cuda/cuda_best_split_finder.cu `FindBestSplitsForLeafKernel`).
 
-The reference scans each feature's bins serially twice (missing-left /
-missing-right).  Here both scans are one vectorized computation: cumulative
-sums along the bin axis give every candidate left-partition in parallel, the
-gain formula is evaluated over the whole [2 (missing dir), F, MB] grid, and a
-single flat argmax (first-wins, matching `SplitInfo` deterministic tie-break
-order) picks the winner.
+The reference scans each feature's bins serially (two missing-direction
+scans for numerical; two sorted-prefix scans for categorical).  Here all
+scans are one vectorized computation: cumulative sums along the bin axis
+give every candidate partition in parallel, the gain formula is evaluated
+over the whole [case, F, MB] grid, and a single flat argmax (first-wins,
+matching `SplitInfo` deterministic tie-break order) picks the winner:
+
+  case 0: numerical, missing right      case 3: categorical asc-prefix
+  case 1: numerical, missing left       case 4: categorical desc-prefix
+  case 2: categorical one-vs-rest
+
+Categorical deviation from the reference: bin 0 (this build's "other/rare +
+missing" categorical bin) is never placed in the left subset, so unseen
+categories and NaN always route right — which keeps bin-level training
+decisions and raw-value bitset prediction exactly consistent.
 """
 from __future__ import annotations
 
@@ -31,11 +42,13 @@ MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
 class SplitResult(NamedTuple):
     """Best split for one leaf (ref: src/treelearner/split_info.hpp
     `SplitInfo` — the fixed-layout struct the reference Allreduces; here a
-    NamedTuple of scalars so it pmax/psums cleanly over a mesh)."""
+    NamedTuple of fixed-shape arrays so it pmax/psums cleanly over a mesh)."""
     gain: Array          # f32; -inf when no valid split
     feature: Array       # i32
-    threshold_bin: Array  # i32; split goes left iff bin <= threshold_bin
+    threshold_bin: Array  # i32; numerical: left iff bin <= threshold_bin
     default_left: Array  # bool; missing direction
+    is_cat: Array        # bool; categorical split
+    cat_mask: Array      # [MB] bool; categorical: left iff mask[bin]
     left_sum_g: Array
     left_sum_h: Array
     left_cnt: Array
@@ -71,62 +84,99 @@ def leaf_output(g: Array, h: Array, l1: float, l2: float,
 def find_best_split(hist: Array,
                     parent_g: Array, parent_h: Array, parent_c: Array,
                     feat_nb: Array, feat_missing: Array, feat_default: Array,
-                    allowed: Array,
+                    allowed: Array, is_cat: Array,
                     l1: float, l2: float,
                     min_data_in_leaf: float, min_sum_hessian: float,
-                    min_gain_to_split: float) -> SplitResult:
-    """Best numerical split over all features of one leaf.
-
-    Args:
-      hist: [F, MB, 3] (Σg, Σh, Σcnt) per (feature, bin).
-      parent_*: scalar leaf totals.
-      feat_nb: [F] i32 bins per feature (incl. NaN bin when present).
-      feat_missing: [F] i32 missing type (0 none / 1 zero / 2 nan).
-      feat_default: [F] i32 default (zero) bin index.
-      allowed: [F] bool — splittable this tree/node (trivial features,
-        categorical-pending features and feature_fraction masks all land here).
-    """
+                    min_gain_to_split: float,
+                    cat_smooth: float, cat_l2: float,
+                    max_cat_threshold: int, max_cat_to_onehot: int
+                    ) -> SplitResult:
+    """Best split over all features of one leaf (numerical + categorical)."""
     F, MB, _ = hist.shape
     bin_ar = jnp.arange(MB, dtype=jnp.int32)
     valid_bin = bin_ar[None, :] < feat_nb[:, None]              # [F, MB]
     h = jnp.where(valid_bin[..., None], hist, 0.0)
-    cum = jnp.cumsum(h, axis=1)                                  # [F, MB, 3]
+    parent = jnp.stack([parent_g, parent_h, parent_c])           # [3]
+    num_ok = allowed & ~is_cat
+    cat_ok = allowed & is_cat
 
+    def constraints_ok(left, right):
+        return ((left[..., 2] >= min_data_in_leaf)
+                & (right[..., 2] >= min_data_in_leaf)
+                & (left[..., 1] >= min_sum_hessian)
+                & (right[..., 1] >= min_sum_hessian))
+
+    def split_gain(left, right, l2_eff, shift):
+        return (leaf_gain(left[..., 0], left[..., 1], l1, l2_eff)
+                + leaf_gain(right[..., 0], right[..., 1], l1, l2_eff)
+                - shift)
+
+    # ---------------------------------------------------------- numerical
+    cum = jnp.cumsum(h, axis=1)                                  # [F, MB, 3]
     has_nan = feat_missing == MISSING_NAN                        # [F]
     nan_idx = jnp.where(has_nan, feat_nb - 1, 0)
     nanv = jnp.take_along_axis(h, nan_idx[:, None, None]
                                .astype(jnp.int32), axis=1)[:, 0, :]  # [F, 3]
     nanv = jnp.where(has_nan[:, None], nanv, 0.0)
 
-    parent = jnp.stack([parent_g, parent_h, parent_c])           # [3]
     # threshold t valid iff at least one numeric bin remains on each side:
     # numeric bins are [0, nb - 1 - has_nan); t in [0, nb - 2 - has_nan]
     t_max = feat_nb - 2 - has_nan.astype(jnp.int32)
-    valid_t = bin_ar[None, :] <= t_max[:, None]                  # [F, MB]
+    valid_t = (bin_ar[None, :] <= t_max[:, None]) & num_ok[:, None]
 
-    # case 0: missing right (default_left=False) — NaN bin is last, so the
-    # prefix sums up to any valid t exclude it naturally.
+    shift_num = leaf_gain(parent_g, parent_h, l1, l2) + min_gain_to_split
+    # case 0: missing right (NaN bin is last; prefix sums exclude it).
     left0 = cum
-    # case 1: missing left (default_left=True) — add the NaN bin to the left.
+    right0 = parent[None, None, :] - left0
+    gain0 = jnp.where(valid_t & constraints_ok(left0, right0),
+                      split_gain(left0, right0, l2, shift_num), NEG_INF)
+    # case 1: missing left.
     left1 = cum + nanv[:, None, :]
+    right1 = parent[None, None, :] - left1
+    gain1 = jnp.where(valid_t & has_nan[:, None]
+                      & constraints_ok(left1, right1),
+                      split_gain(left1, right1, l2, shift_num), NEG_INF)
 
-    shift = leaf_gain(parent_g, parent_h, l1, l2) + min_gain_to_split
+    # --------------------------------------------------------- categorical
+    l2c = l2 + cat_l2
+    shift_cat = leaf_gain(parent_g, parent_h, l1, l2c) + min_gain_to_split
+    cnt = h[..., 2]
+    # bin 0 = other/missing bin: never in the left subset (see docstring)
+    cat_valid = (bin_ar[None, :] >= 1) & valid_bin & (cnt > 0) \
+        & cat_ok[:, None]                                        # [F, MB]
+    used = cat_valid.sum(axis=1)                                 # [F]
 
-    def gains_for(left):
-        right = parent[None, None, :] - left
-        gl, hl, cl = left[..., 0], left[..., 1], left[..., 2]
-        gr, hr, cr = right[..., 0], right[..., 1], right[..., 2]
-        ok = (valid_t
-              & (cl >= min_data_in_leaf) & (cr >= min_data_in_leaf)
-              & (hl >= min_sum_hessian) & (hr >= min_sum_hessian)
-              & allowed[:, None])
-        g = leaf_gain(gl, hl, l1, l2) + leaf_gain(gr, hr, l1, l2) - shift
-        return jnp.where(ok, g, NEG_INF)
+    # case 2: one-vs-rest (used <= max_cat_to_onehot)
+    left2 = h
+    right2 = parent[None, None, :] - left2
+    ok2 = cat_valid & (used[:, None] <= max_cat_to_onehot) \
+        & constraints_ok(left2, right2)
+    gain2 = jnp.where(ok2, split_gain(left2, right2, l2c, shift_cat), NEG_INF)
 
-    gain0 = gains_for(left0)                                     # [F, MB]
-    gain1 = jnp.where(has_nan[:, None], gains_for(left1), NEG_INF)
+    # cases 3/4: sorted many-vs-rest (used > max_cat_to_onehot)
+    # ref: FindBestThresholdCategorical sorts by sum_grad/(sum_hess+cat_smooth)
+    ratio = jnp.where(cat_valid,
+                      h[..., 0] / (h[..., 1] + cat_smooth), jnp.inf)
+    order_asc = jnp.argsort(ratio, axis=1)                       # [F, MB]
+    ratio_desc = jnp.where(cat_valid, ratio, -jnp.inf)
+    order_desc = jnp.argsort(-ratio_desc, axis=1)
 
-    gains = jnp.stack([gain0, gain1])                            # [2, F, MB]
+    def prefix_gains(order):
+        hs = jnp.take_along_axis(h, order[..., None], axis=1)
+        cumk = jnp.cumsum(hs, axis=1)       # prefix of k = t+1 sorted bins
+        k = bin_ar[None, :] + 1
+        okk = (k <= max_cat_threshold) & (k < used[:, None]) \
+            & (used[:, None] > max_cat_to_onehot) & cat_ok[:, None]
+        right = parent[None, None, :] - cumk
+        g = jnp.where(okk & constraints_ok(cumk, right),
+                      split_gain(cumk, right, l2c, shift_cat), NEG_INF)
+        return g, cumk
+
+    gain3, cum3 = prefix_gains(order_asc)
+    gain4, cum4 = prefix_gains(order_desc)
+
+    # ------------------------------------------------------------- decide
+    gains = jnp.stack([gain0, gain1, gain2, gain3, gain4])       # [5, F, MB]
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]
@@ -135,17 +185,29 @@ def find_best_split(hist: Array,
     feat = (rem // MB).astype(jnp.int32)
     thr = (rem % MB).astype(jnp.int32)
 
-    left = jnp.where(case == 1, left1[feat, thr], left0[feat, thr])  # [3]
+    lefts = jnp.stack([left0[feat, thr], left1[feat, thr], left2[feat, thr],
+                       cum3[feat, thr], cum4[feat, thr]])        # [5, 3]
+    left = lefts[case]
     right = parent - left
 
-    # default_left: NaN-missing → which scan won; zero-missing → whether the
-    # zero bin landed left (bin-level decision is the same either way, the
-    # flag matters for raw-value prediction of NaNs mapped to zero);
-    # no-missing → False (ref: decision_type kDefaultLeftMask semantics)
+    best_is_cat = case >= 2
+    # categorical left-subset membership mask over bins
+    rank_asc = jnp.argsort(order_asc[feat])    # position of bin in asc order
+    rank_desc = jnp.argsort(order_desc[feat])
+    mask2 = bin_ar == thr
+    mask3 = rank_asc <= thr
+    mask4 = rank_desc <= thr
+    cat_mask = jnp.where(case == 2, mask2,
+                         jnp.where(case == 3, mask3, mask4)) \
+        & cat_valid[feat] & best_is_cat
+
+    # default_left (numerical only): NaN-missing → which scan won;
+    # zero-missing → whether the zero bin landed left; else False
     mtype = feat_missing[feat]
-    dl = jnp.where(mtype == MISSING_NAN, case == 1,
-                   jnp.where(mtype == MISSING_ZERO,
-                             feat_default[feat] <= thr, False))
+    dl = jnp.where(best_is_cat, False,
+                   jnp.where(mtype == MISSING_NAN, case == 1,
+                             jnp.where(mtype == MISSING_ZERO,
+                                       feat_default[feat] <= thr, False)))
 
     no_split = ~jnp.isfinite(best_gain)
     return SplitResult(
@@ -153,6 +215,8 @@ def find_best_split(hist: Array,
         feature=jnp.where(no_split, -1, feat),
         threshold_bin=thr,
         default_left=dl,
+        is_cat=best_is_cat & ~no_split,
+        cat_mask=cat_mask & ~no_split,
         left_sum_g=left[0], left_sum_h=left[1], left_cnt=left[2],
         right_sum_g=right[0], right_sum_h=right[1], right_cnt=right[2],
     )

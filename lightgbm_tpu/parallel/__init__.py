@@ -1,0 +1,12 @@
+"""Distributed training over a device mesh.
+
+TPU-native replacement for the reference's network stack
+(ref: src/network/ — TCP socket mesh / MPI linkers, Bruck allgather,
+recursive-halving reduce-scatter — and src/treelearner/
+data_parallel_tree_learner.cpp): the transport, topology, and reducer
+plumbing collapse into `jax.sharding.Mesh` + XLA collectives over ICI/DCN.
+`init()` replaces the whole `machines`/`local_listen_port`/Dask
+port-negotiation dance (ref: python-package/lightgbm/dask.py `_train`).
+"""
+from .mesh import get_mesh, init  # noqa: F401
+from .data_parallel import make_sharded_train_step, shard_dataset  # noqa: F401

@@ -1,0 +1,50 @@
+"""Mesh construction and multi-host initialization.
+
+ref parity: `Network::Init` + `Linkers::Construct` (src/network/network.cpp,
+linkers_socket.cpp) and the Dask machines/ports bootstrap
+(python-package/lightgbm/dask.py).  On TPU all of it is:
+`jax.distributed.initialize()` (multi-host) + one `Mesh` over the devices;
+XLA routes collectives over ICI within a slice and DCN across slices.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils import log
+
+_initialized = False
+
+
+def init(coordinator_address: Optional[str] = None,
+         num_processes: Optional[int] = None,
+         process_id: Optional[int] = None) -> None:
+    """Multi-host bring-up (replaces machines/machine_list_file/port config;
+    ref: Config network params + LGBM_NetworkInit).  Single-host callers can
+    skip this entirely."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator_address is not None or num_processes is not None:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+    _initialized = True
+    log.info(f"parallel.init: {jax.process_count()} process(es), "
+             f"{len(jax.devices())} device(s)")
+
+
+def get_mesh(num_shards: int = 0, axis: str = "data",
+             devices: Optional[Sequence] = None) -> Mesh:
+    """Build a 1-D data mesh over `num_shards` devices (0 = all visible)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if num_shards and num_shards > 0:
+        if num_shards > len(devs):
+            raise ValueError(
+                f"num_shards={num_shards} exceeds visible devices "
+                f"({len(devs)})")
+        devs = devs[:num_shards]
+    return Mesh(np.array(devs), (axis,))

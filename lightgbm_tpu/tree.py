@@ -65,6 +65,9 @@ class Tree:
         # categorical split storage (ref: tree.h cat_boundaries_/cat_threshold_)
         self.cat_boundaries: np.ndarray = np.zeros(1, dtype=np.int64)
         self.cat_threshold: np.ndarray = np.zeros(0, dtype=np.uint32)
+        # bin-level left-subset masks per cat split (training-side view used
+        # by the device traversal; rebuilt from the bitset on model load)
+        self.cat_bin_masks: np.ndarray = np.zeros((0, 0), dtype=bool)
         self.is_linear = False
 
     # ------------------------------------------------------------ construct
@@ -87,10 +90,17 @@ class Tree:
         feat = np.asarray(dev.split_feature)[:ns]
         thr_bin = np.asarray(dev.threshold_bin)[:ns]
         dl = np.asarray(dev.default_left)[:ns]
+        is_cat = np.asarray(dev.split_is_cat)[:ns]
+        cat_masks = np.asarray(dev.split_cat_mask)[:ns]
         gains = np.asarray(dev.split_gain)[:ns]
         ig = np.asarray(dev.internal_g)[:ns]
         ih = np.asarray(dev.internal_h)[:ns]
         ic = np.asarray(dev.internal_cnt)[:ns]
+
+        mb = cat_masks.shape[1] if ns else 0
+        t.cat_bin_masks = np.zeros((0, mb), dtype=bool)
+        cat_bounds = [0]
+        cat_words: List[np.ndarray] = []
 
         # leaf slot → (owning node, is_right) for pointer fix-up
         leaf_pos = {0: (-1, False)}
@@ -110,18 +120,42 @@ class Tree:
             f = int(feat[i])
             m = bin_mappers[f]
             t.split_feature[i] = f
-            t.threshold_bin[i] = int(thr_bin[i])
-            t.threshold[i] = m.bin_to_value(int(thr_bin[i]))
             dt = 0
-            if bool(dl[i]):
-                dt |= K_DEFAULT_LEFT_MASK
-            dt |= (m.missing_type & 3) << 2
+            if bool(is_cat[i]):
+                # categorical split: threshold_bin indexes cat_boundaries,
+                # bitset holds the raw category values of left-subset bins
+                # (ref: tree.h cat_boundaries_/cat_threshold_, Tree::Split
+                # categorical overload)
+                dt |= K_CATEGORICAL_MASK
+                cats = [m.bin_2_categorical[b - 1]
+                        for b in np.nonzero(cat_masks[i])[0] if b >= 1]
+                n_words = (max(cats) // 32 + 1) if cats else 1
+                words = np.zeros(n_words, dtype=np.uint32)
+                for c in cats:
+                    words[c // 32] |= np.uint32(1 << (c % 32))
+                t.threshold_bin[i] = t.num_cat
+                t.threshold[i] = float(t.num_cat)
+                cat_words.append(words)
+                cat_bounds.append(cat_bounds[-1] + n_words)
+                t.cat_bin_masks = np.concatenate(
+                    [t.cat_bin_masks, cat_masks[i][None, :]])
+                t.num_cat += 1
+            else:
+                t.threshold_bin[i] = int(thr_bin[i])
+                t.threshold[i] = m.bin_to_value(int(thr_bin[i]))
+                if bool(dl[i]):
+                    dt |= K_DEFAULT_LEFT_MASK
+                dt |= (m.missing_type & 3) << 2
             t.decision_type[i] = dt
             t.split_gain[i] = float(gains[i])
             denom = ih[i] if ih[i] != 0 else 1.0
             t.internal_value[i] = float(-ig[i] / denom) * shrinkage
             t.internal_weight[i] = float(ih[i])
             t.internal_count[i] = float(ic[i])
+
+        if t.num_cat > 0:
+            t.cat_boundaries = np.asarray(cat_bounds, dtype=np.int64)
+            t.cat_threshold = np.concatenate(cat_words).astype(np.uint32)
 
         lv = np.asarray(dev.leaf_value)[:nl] * learner_output_scale
         t.leaf_value = (lv * shrinkage).astype(np.float64)
@@ -296,6 +330,12 @@ class Tree:
                 t.cat_boundaries = get("cat_boundaries", np.int64,
                                        t.num_cat + 1)
                 t.cat_threshold = get("cat_threshold", np.uint32, 0)
+                # categorical nodes store their cat index in `threshold`
+                # (ref: tree.cpp — threshold_ doubles as cat_idx for
+                # categorical splits); recover the integer view
+                cat_nodes = (t.decision_type & K_CATEGORICAL_MASK) != 0
+                t.threshold_bin[cat_nodes] = \
+                    t.threshold[cat_nodes].astype(np.int32)
         else:
             t.leaf_value = get("leaf_value", np.float64, nl)
         t.shrinkage = float(kv.get("shrinkage", 1.0))
@@ -305,11 +345,23 @@ class Tree:
     def recompute_threshold_bins(self, bin_mappers: List[BinMapper]) -> None:
         """Re-derive bin-level thresholds from raw-value thresholds after a
         model-text load (thresholds are the inclusive upper bounds of their
-        bins, so value_to_bin(threshold) recovers the bin exactly)."""
+        bins, so value_to_bin(threshold) recovers the bin exactly).  Also
+        rebuilds the per-cat-split bin masks from the category bitsets."""
+        mb = max((m.num_bin for m in bin_mappers), default=1)
+        if self.num_cat > 0:
+            self.cat_bin_masks = np.zeros((self.num_cat, mb), dtype=bool)
         for i in range(self.num_internal()):
-            if self.decision_type[i] & K_CATEGORICAL_MASK:
-                continue  # categorical threshold_bin indexes cat_boundaries
             m = bin_mappers[int(self.split_feature[i])]
+            if self.decision_type[i] & K_CATEGORICAL_MASK:
+                cat_idx = int(self.threshold_bin[i])
+                lo = int(self.cat_boundaries[cat_idx])
+                hi = int(self.cat_boundaries[cat_idx + 1])
+                bitset = self.cat_threshold[lo:hi]
+                for b, cat in enumerate(m.bin_2_categorical, start=1):
+                    if cat < (hi - lo) * 32 and \
+                            (bitset[cat // 32] >> (cat % 32)) & 1:
+                        self.cat_bin_masks[cat_idx, b] = True
+                continue
             self.threshold_bin[i] = m.value_to_bin(float(self.threshold[i]))
 
     # ----------------------------------------------------------- utilities

@@ -1,0 +1,100 @@
+"""Categorical split tests — the TPU build's slice of the reference's
+test_engine.py categorical scenarios."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_cat_data(n=1500, n_cats=12, seed=5):
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, n_cats, n).astype(np.float64)
+    # target depends on a subset of categories plus a numeric feature
+    cat_effect = np.where(np.isin(cat, [1, 4, 7]), 2.0,
+                          np.where(np.isin(cat, [2, 9]), -1.5, 0.0))
+    x_num = rng.randn(n)
+    y = cat_effect + 0.5 * x_num + 0.2 * rng.randn(n)
+    X = np.column_stack([cat, x_num, rng.randn(n)])
+    return X, y
+
+
+class TestCategorical:
+    def test_categorical_split_learns(self):
+        X, y = make_cat_data()
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "min_data_in_leaf": 20}, ds, 30)
+        pred = bst.predict(X)
+        assert np.mean((pred - y) ** 2) < 0.15 * np.var(y)
+        # categorical splits were actually used
+        n_cat_splits = sum(t.num_cat for t in bst.trees)
+        assert n_cat_splits > 0
+
+    def test_categorical_beats_numerical_encoding(self):
+        X, y = make_cat_data()
+        ds_cat = lgb.Dataset(X, label=y, categorical_feature=[0])
+        ds_num = lgb.Dataset(X, label=y)
+        p = {"objective": "regression", "verbosity": -1, "num_leaves": 8}
+        bst_cat = lgb.train(p, ds_cat, 10)
+        bst_num = lgb.train(p, ds_num, 10)
+        mse_cat = np.mean((bst_cat.predict(X) - y) ** 2)
+        mse_num = np.mean((bst_num.predict(X) - y) ** 2)
+        # set-splits isolate {1,4,7} / {2,9} faster than ordered thresholds
+        assert mse_cat < mse_num
+
+    def test_internal_external_prediction_consistency(self):
+        X, y = make_cat_data(800)
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0],
+                         free_raw_data=False)
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 10)
+        internal = np.asarray(bst._train_score, dtype=np.float64)
+        external = bst.predict(X, raw_score=True)
+        np.testing.assert_allclose(internal, external, atol=1e-5)
+
+    def test_model_text_roundtrip_with_cats(self):
+        X, y = make_cat_data(800)
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 8)
+        s = bst.model_to_string()
+        assert "num_cat=" in s
+        b2 = lgb.Booster(model_str=s)
+        np.testing.assert_array_equal(bst.predict(X), b2.predict(X))
+
+    def test_unseen_category_goes_right(self):
+        X, y = make_cat_data(800)
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 10)
+        Xq = X[:10].copy()
+        Xq[:, 0] = 99  # never seen in training
+        out = bst.predict(Xq)
+        assert np.isfinite(out).all()
+
+    def test_nan_category(self):
+        X, y = make_cat_data(800)
+        X[::5, 0] = np.nan
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 10)
+        assert np.isfinite(bst.predict(X)).all()
+
+    def test_max_cat_to_onehot(self):
+        # few categories → one-vs-rest splits (single-category subsets)
+        X, y = make_cat_data(1000, n_cats=3)
+        ds = lgb.Dataset(X, label=y, categorical_feature=[0],
+                         params={"max_cat_to_onehot": 4})
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "max_cat_to_onehot": 4}, ds, 5)
+        for t in bst.trees:
+            for i in range(t.num_internal()):
+                if t.decision_type[i] & 1:
+                    cat_idx = int(t.threshold_bin[i])
+                    mask = t.cat_bin_masks[cat_idx]
+                    assert mask.sum() == 1  # one-vs-rest
+
+    def test_pandas_category_dtype(self):
+        pd = pytest.importorskip("pandas")
+        X, y = make_cat_data(600)
+        df = pd.DataFrame({"c": X[:, 0].astype(int), "x1": X[:, 1],
+                           "x2": X[:, 2]})
+        ds = lgb.Dataset(df, label=y, categorical_feature=["c"])
+        bst = lgb.train({"objective": "regression", "verbosity": -1}, ds, 5)
+        assert np.isfinite(bst.predict(df)).all()

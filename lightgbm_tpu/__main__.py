@@ -1,0 +1,5 @@
+"""`python -m lightgbm_tpu config=train.conf` — CLI parity with the
+reference's `lightgbm` binary (ref: src/main.cpp)."""
+from .cli import main
+
+main()

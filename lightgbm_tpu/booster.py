@@ -409,8 +409,16 @@ class Booster:
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
             if tree.num_leaves > 1:
                 all_const = False
+            # L1-family leaf refit (ref: ObjectiveFunction::RenewTreeOutput →
+            # serial_tree_learner.cpp RenewTreeOutput; applied pre-shrinkage)
+            renew_alpha = getattr(self.objective_, "renew_percentile", None) \
+                if self.objective_ is not None else None
+            if renew_alpha is not None and tree.num_leaves > 1:
+                scaled = self._renew_tree_output(tree, dev, sw,
+                                                 float(renew_alpha), lr)
+            else:
+                scaled = dev.leaf_value * lr
             # train score: final leaf_id from growth → direct gather
-            scaled = dev.leaf_value * lr
             contrib = scaled[dev.leaf_id]
             if K == 1:
                 new_train = self._train_score + contrib
@@ -433,6 +441,35 @@ class Booster:
             log.warning("Stopped training because there are no more leaves "
                         "that meet the split requirements")
         return all_const
+
+    def _renew_tree_output(self, tree: Tree, dev: DeviceTree, sw,
+                           alpha: float, lr: float) -> jax.Array:
+        """Refit leaf values as the alpha-percentile of in-leaf residuals
+        (ref: regression_objective.hpp `RenewTreeOutput` — exact leaf
+        optimum for L1/quantile/MAPE which their grad/hess only approximate).
+        Returns the shrunken per-slot leaf values as a device array and
+        rewrites the host tree in place."""
+        from .objectives import _weighted_percentile
+        label = self.train_set.get_label().astype(np.float64)
+        score = np.asarray(self._train_score, dtype=np.float64)
+        residual = label - score
+        leaf_id = np.asarray(dev.leaf_id)
+        bag = np.asarray(sw, dtype=np.float64)
+        weight = self.train_set.get_weight()
+        w = bag if weight is None else bag * weight.astype(np.float64)
+        if self.config.objective == "mape":
+            w = w / np.maximum(1.0, np.abs(label))
+        new_vals = np.zeros(self.config.num_leaves, dtype=np.float64)
+        for leaf in range(tree.num_leaves):
+            rows = (leaf_id == leaf) & (bag > 0)
+            if not rows.any():
+                new_vals[leaf] = tree.leaf_value[leaf] / lr
+                continue
+            new_vals[leaf] = _weighted_percentile(
+                residual[rows], w[rows] if weight is not None or
+                self.config.objective == "mape" else None, alpha)
+        tree.leaf_value = new_vals[:tree.num_leaves] * lr
+        return jnp.asarray((new_vals * lr).astype(np.float32))
 
     def _apply_tree_to_score(self, score, tree: Tree, dd: _DeviceData, k: int,
                              bias_included: bool, record=None):
@@ -504,6 +541,7 @@ class Booster:
         cfg = self.config
         return (self._fobj is None and self.objective_ is not None
                 and not getattr(self.objective_, "needs_rng", False)
+                and getattr(self.objective_, "renew_percentile", None) is None
                 and self._boost_mode == "gbdt"
                 and not self._valid_dd
                 and cfg.pos_bagging_fraction >= 1.0

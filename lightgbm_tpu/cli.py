@@ -1,0 +1,162 @@
+"""Command-line entry point: `python -m lightgbm_tpu config=train.conf`.
+
+TPU-native re-design of the reference's CLI Application
+(ref: src/main.cpp `main`; src/application/application.cpp
+`Application::{LoadData,InitTrain,Train,Predict,ConvertModel}`; config-file
+`key=value` parsing in src/io/config.cpp `Config::Set`).
+
+Accepts the same `key=value` argument and conf-file syntax: a `config=` arg
+names a conf file whose lines are `key = value` (with `#` comments);
+command-line pairs override file pairs.  Tasks: train, predict, refit.
+Data files are CSV/TSV/LibSVM, auto-detected like src/io/parser.cpp
+`Parser::CreateParser`.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .basic import Dataset
+from .booster import Booster
+from .engine import train as engine_train
+from .utils import log
+from .utils.config import Config
+from .utils.log import LightGBMError
+
+
+def parse_conf_file(path: str) -> Dict[str, str]:
+    """ref: Application config-file parsing (key=value lines, # comments)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def parse_args(argv: List[str]) -> Dict[str, str]:
+    params: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            raise LightGBMError(f"Unknown argument format: {arg!r} "
+                                f"(expect key=value)")
+        k, v = arg.split("=", 1)
+        params[k.strip()] = v.strip()
+    if "config" in params and params["config"]:
+        file_params = parse_conf_file(params["config"])
+        # command-line pairs override conf-file pairs (ref: Application ctor)
+        file_params.update(params)
+        params = file_params
+    return params
+
+
+def _sniff_format(path: str) -> Tuple[str, bool]:
+    """Detect csv/tsv/libsvm + header (ref: parser.cpp auto-detection)."""
+    with open(path) as f:
+        first = f.readline()
+    sep = "\t" if first.count("\t") >= first.count(",") else ","
+    tokens = first.strip().split(sep)
+    if any(":" in t for t in tokens[1:3] if t):
+        return "libsvm", False
+    def _is_num(t):
+        try:
+            float(t)
+            return True
+        except ValueError:
+            return False
+    has_header = not all(_is_num(t) for t in tokens if t != "")
+    return ("tsv" if sep == "\t" else "csv"), has_header
+
+
+def load_data_file(path: str, config: Config
+                   ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Load a training/prediction text file → (X, label or None).
+
+    ref: src/io/parser.cpp CSVParser/TSVParser/LibSVMParser;
+    label_column handling in dataset_loader.cpp.
+    """
+    fmt, has_header = _sniff_format(path)
+    if config.header:
+        has_header = True
+    if fmt == "libsvm":
+        from sklearn.datasets import load_svmlight_file
+        X, y = load_svmlight_file(path)
+        return np.asarray(X.todense(), dtype=np.float64), y
+    sep = "\t" if fmt == "tsv" else ","
+    data = np.genfromtxt(path, delimiter=sep,
+                         skip_header=1 if has_header else 0,
+                         dtype=np.float64)
+    if data.ndim == 1:
+        data = data.reshape(-1, 1)
+    label_col = 0
+    lc = config.label_column
+    if lc.startswith("name:"):
+        raise LightGBMError("label_column=name: requires header parsing; "
+                            "use column index form (e.g. label_column=0)")
+    if lc != "":
+        label_col = int(lc)
+    y = data[:, label_col].copy()
+    X = np.delete(data, label_col, axis=1)
+    return X, y
+
+
+def run(argv: List[str]) -> int:
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
+              "tasks: train | predict | refit", file=sys.stderr)
+        return 0
+    params = parse_args(argv)
+    config = Config(params)
+    task = config.task
+
+    if task == "train":
+        if not config.data:
+            raise LightGBMError("No training data file (set data=...)")
+        X, y = load_data_file(config.data, config)
+        train_set = Dataset(X, label=y, params=dict(params))
+        valid_sets = []
+        valid_names = []
+        for i, vf in enumerate(config.valid):
+            vx, vy = load_data_file(vf, config)
+            valid_sets.append(train_set.create_valid(vx, label=vy))
+            valid_names.append(f"valid_{i}")
+        from .callback import log_evaluation
+        booster = engine_train(
+            dict(params), train_set, num_boost_round=config.num_iterations,
+            valid_sets=valid_sets or None, valid_names=valid_names or None,
+            callbacks=[log_evaluation(max(config.metric_freq, 1))])
+        booster.save_model(config.output_model)
+        log.info(f"Finished training; model saved to {config.output_model}")
+        return 0
+
+    if task in ("predict", "prediction", "test"):
+        if not config.input_model:
+            raise LightGBMError("No input model (set input_model=...)")
+        booster = Booster(model_file=config.input_model)
+        X, _ = load_data_file(config.data, config)
+        out = booster.predict(
+            X, raw_score=config.predict_raw_score,
+            pred_leaf=config.predict_leaf_index,
+            pred_contrib=config.predict_contrib,
+            start_iteration=config.start_iteration_predict,
+            num_iteration=(None if config.num_iteration_predict < 0
+                           else config.num_iteration_predict))
+        np.savetxt(config.output_result, np.atleast_2d(out.T).T, fmt="%.10g",
+                   delimiter="\t")
+        log.info(f"Finished prediction; results saved to "
+                 f"{config.output_result}")
+        return 0
+
+    if task == "refit":
+        raise LightGBMError("task=refit: use Booster.refit from Python "
+                            "(CLI refit lands with the refit milestone)")
+    raise LightGBMError(f"Unknown task: {task}")
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))

@@ -18,6 +18,14 @@ Key TPU-first departures from the reference:
  - The histogram subtraction trick is preserved: the smaller child is
    histogrammed, the larger is parent − smaller
    (ref: serial_tree_learner.cpp smaller_leaf/larger_leaf logic).
+ - Monotone constraints ride along as fixed per-leaf [lb, ub] output bounds
+   (ref: monotone_constraints.hpp `BasicLeafConstraints` — the "basic"
+   method: split candidates violating the direction are masked, child
+   outputs clamped at the parents' midpoint).
+
+Per-feature metadata travels as one dict pytree `feat`:
+  nb [F] i32 bins per feature; missing [F] i32 missing type;
+  default [F] i32 zero bin; is_cat [F] bool; mono [F] i32 in {-1, 0, +1}.
 
 The grower is specialized per `GrowerSpec` (static shapes + hyperparams) and
 cached, so repeated boosting iterations reuse one compiled executable.
@@ -34,6 +42,8 @@ from .histogram import leaf_histogram
 from .split import NEG_INF, SplitResult, find_best_split, leaf_output
 
 Array = jax.Array
+
+INF = jnp.inf
 
 
 class GrowerSpec(NamedTuple):
@@ -109,23 +119,28 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
         min_data_in_leaf=spec.min_data_in_leaf,
         min_sum_hessian=spec.min_sum_hessian_in_leaf,
         min_gain_to_split=spec.min_gain_to_split,
+        max_delta_step=spec.max_delta_step,
         cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
         max_cat_threshold=spec.max_cat_threshold,
         max_cat_to_onehot=spec.max_cat_to_onehot)
+
+    def clamp_output(g, h):
+        return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
+                           spec.max_delta_step)
 
     def grow(bins_fm: Array,       # [F, N] uint8/16 feature-major
              grad: Array,          # [N] f32
              hess: Array,          # [N] f32
              sample_weight: Array,  # [N] f32 bagging/GOSS weights (0 = out)
-             feat_nb: Array,       # [F] i32
-             feat_missing: Array,  # [F] i32
-             feat_default: Array,  # [F] i32
+             feat: Dict[str, Array],  # per-feature metadata pytree (above)
              allowed: Array,       # [F] bool (trivial/colsample masked out)
-             is_cat: Array,        # [F] bool categorical features
              ) -> DeviceTree:
         F, N = bins_fm.shape
         payload = jnp.stack([grad * sample_weight, hess * sample_weight,
                              sample_weight], axis=1)  # [N, 3]
+        mono = feat.get("mono")
+        if mono is None:
+            mono = jnp.zeros((F,), jnp.int32)
 
         def hist_of(mask_rows):
             h = leaf_histogram(bins_fm, payload, mask_rows, MB)
@@ -133,9 +148,10 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
                 h = jax.lax.psum(h, axis_name)
             return h
 
-        def split_of(hist, g, h, c, node_allowed):
-            return find(hist, g, h, c, feat_nb, feat_missing, feat_default,
-                        node_allowed, is_cat)
+        def split_of(hist, g, h, c, node_allowed, lb, ub):
+            return find(hist, g, h, c, feat["nb"], feat["missing"],
+                        feat["default"], node_allowed, feat["is_cat"],
+                        mono=mono, out_lb=lb, out_ub=ub)
 
         # ---- root ----
         root_mask = jnp.ones((N,), dtype=bool)
@@ -148,7 +164,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
             root_g = jax.lax.psum(root_g, axis_name)
             root_h = jax.lax.psum(root_h, axis_name)
             root_c = jax.lax.psum(root_c, axis_name)
-        s0 = split_of(hist0, root_g, root_h, root_c, allowed)
+        s0 = split_of(hist0, root_g, root_h, root_c, allowed,
+                      jnp.float32(-INF), jnp.float32(INF))
 
         hist = jnp.zeros((L, F, MB, 3), dtype=jnp.float32).at[0].set(hist0)
         leaf_best = [jnp.zeros((L,) + a.shape, dtype=a.dtype)
@@ -182,6 +199,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
             leaf_rg=leaf_best[7], leaf_rh=leaf_best[8], leaf_rc=leaf_best[9],
             leaf_iscat=leaf_best[10], leaf_catmask=leaf_best[11],
             leaf_g=leaf_g, leaf_h=leaf_h, leaf_c=leaf_c,
+            leaf_lb=jnp.full((L,), -INF, jnp.float32),
+            leaf_ub=jnp.full((L,), INF, jnp.float32),
             leaf_depth=leaf_depth, nodes=nodes,
         )
 
@@ -200,7 +219,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
 
             # ---- partition: dense leaf_id update (no row movement) ----
             fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)  # [N]
-            is_nan_bin = (feat_missing[f] == 2) & (fbins == feat_nb[f] - 1)
+            is_nan_bin = (feat["missing"][f] == 2) & \
+                (fbins == feat["nb"][f] - 1)
             go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
             go_left = jnp.where(node_cat, node_mask[fbins], go_left_num)
             in_leaf = st["leaf_id"] == best
@@ -228,6 +248,17 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
             rg, rh, rc = st["leaf_rg"][best], st["leaf_rh"][best], \
                 st["leaf_rc"][best]
 
+            # ---- monotone bounds for the children (ref: "basic" method) ----
+            lb, ub = st["leaf_lb"][best], st["leaf_ub"][best]
+            mc_f = jnp.where(node_cat, 0, mono[f])
+            l_out = jnp.clip(clamp_output(lg, lh), lb, ub)
+            r_out = jnp.clip(clamp_output(rg, rh), lb, ub)
+            mid = 0.5 * (l_out + r_out)
+            l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
+            r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
+            l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
+            r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
+
             # ---- histogram: smaller child scanned, larger by subtraction ----
             left_smaller = lc <= rc
             small_leaf = jnp.where(left_smaller, best, new)
@@ -242,8 +273,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
             depth = st["leaf_depth"][best] + 1
             deep_ok = (spec.max_depth <= 0) | (depth < spec.max_depth)
             child_allowed = allowed & deep_ok
-            ls = split_of(lhist, lg, lh, lc, child_allowed)
-            rs = split_of(rhist, rg, rh, rc, child_allowed)
+            ls = split_of(lhist, lg, lh, lc, child_allowed, l_lb, l_ub)
+            rs = split_of(rhist, rg, rh, rc, child_allowed, r_lb, r_ub)
 
             def put2(arr, a, b):
                 return arr.at[best].set(a).at[new].set(b)
@@ -266,6 +297,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
                 leaf_g=put2(st["leaf_g"], lg, rg),
                 leaf_h=put2(st["leaf_h"], lh, rh),
                 leaf_c=put2(st["leaf_c"], lc, rc),
+                leaf_lb=put2(st["leaf_lb"], l_lb, r_lb),
+                leaf_ub=put2(st["leaf_ub"], l_ub, r_ub),
                 leaf_depth=put2(st["leaf_depth"], depth, depth),
                 nodes=nodes,
             )
@@ -273,12 +306,12 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
         st = jax.lax.while_loop(cond, body, state)
 
         n_splits = st["step"]
-        # leaf outputs from final per-leaf sums (slots >= nl are zeroed)
+        # leaf outputs from final per-leaf sums (slots >= nl are zeroed),
+        # clamped to the monotone bounds accumulated on the way down
         slot = jnp.arange(L)
         active = slot < st["nl"]
-        values = leaf_output(st["leaf_g"], st["leaf_h"],
-                             spec.lambda_l1, spec.lambda_l2,
-                             spec.max_delta_step)
+        values = jnp.clip(clamp_output(st["leaf_g"], st["leaf_h"]),
+                          st["leaf_lb"], st["leaf_ub"])
         # single-leaf tree predicts 0 (ref: GBDT logs "no more leaves that
         # meet the split requirements" and the tree contributes nothing)
         values = jnp.where(active & (st["nl"] > 1), values, 0.0)

@@ -2,18 +2,27 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Baseline derivation (see BASELINE.md — `published` was empty, so the anchor
-is the upstream-documented CPU number): reference LightGBM trains Higgs
-(10.5M×28, 255 bins, 31 leaves) at ~500 iters/130 s ≈ 3.85 rounds/s on a
-16-core Xeon.  Scaled linearly to this bench's N rows, baseline
-rounds/s = 3.85 × (10.5e6 / N).  vs_baseline = ours / baseline, i.e. >1.0
-means faster than the reference CPU learner at equal work per round.
+Baseline anchor (documented; see BASELINE.md "Our target"): the target is
+the reference's **CUDA learner** on Higgs-10.5M (BASELINE.json: ">=1.5x
+CUDA rounds/sec, equal AUC").  No exact public CUDA-learner table exists, so
+the anchor is derived from the published chain and recorded here:
+  CPU  (docs/Experiments.rst):   500 iters / 130 s = 3.85 rounds/s
+  OpenCL (docs/GPU-Performance.rst): ~3.5x CPU      = ~13.5 rounds/s
+  CUDA (v4 release notes, "faster than OpenCL, esp. max_bin=255"):
+       assumed 1.5x OpenCL                           = ~20.2 rounds/s
+  => CUDA_ANCHOR_ROUNDS_PER_SEC = 20.2 at N = 10.5M rows, 255 bins,
+     31 leaves.  Scaled linearly in rows to this bench's N.
+vs_baseline = ours / (anchor * 10.5e6 / N); >= 1.5 meets the north star.
 
-Dataset: synthetic Higgs-like (N×28 features, binary labels from a noisy
-nonlinear score), fixed seed.  Training runs the fused device-side chunk
-trainer (ops/fused.py) — the TPU hot path — and times steady-state chunks
-after one warmup chunk (compile excluded).  AUC is printed to stderr as a
-sanity check.
+Dataset: synthetic Higgs-like (N x 28 features, binary labels from a noisy
+nonlinear score), fixed seed, plus a 200k held-out slice for AUC.  Training
+runs the fused device-side chunk trainer (ops/fused.py) — the TPU hot path —
+and times steady-state chunks after one warmup chunk (compile excluded).
+
+Backend handling: the remote-TPU (axon) backend can be transiently
+unavailable; we retry init several times and, if it never comes up, fall
+back to CPU so a number (flagged "backend: cpu-fallback" on stderr) is
+recorded instead of rc=1 — round 1 recorded nothing for exactly this reason.
 """
 from __future__ import annotations
 
@@ -24,14 +33,16 @@ import time
 
 import numpy as np
 
-N = int(os.environ.get("BENCH_N", 1_000_000))
+N = int(os.environ.get("BENCH_N", 2_000_000))
 F = 28
+N_EVAL = 200_000
 ROUNDS_TIMED = int(os.environ.get("BENCH_ROUNDS", 48))
 NUM_LEAVES = 31
 MAX_BIN = 255
 
-BASELINE_HIGGS_ROUNDS_PER_SEC = 500.0 / 130.0
-BASELINE_HIGGS_ROWS = 10_500_000
+# documented anchor chain (see module docstring)
+CUDA_ANCHOR_ROUNDS_PER_SEC = 20.2
+ANCHOR_ROWS = 10_500_000
 
 
 def make_higgs_like(n, f, seed=77):
@@ -44,16 +55,48 @@ def make_higgs_like(n, f, seed=77):
     return X, y
 
 
+def _init_backend():
+    """Init the JAX backend with retries; fall back to CPU if remote TPU
+    never comes up.  Returns (jax, backend_desc)."""
+    attempts = int(os.environ.get("BENCH_BACKEND_ATTEMPTS", 4))
+    last_err = None
+    for i in range(attempts):
+        try:
+            import jax
+            devs = jax.devices()
+            return jax, f"{devs[0].platform}x{len(devs)}"
+        except RuntimeError as e:
+            last_err = e
+            print(f"[bench] backend init attempt {i + 1}/{attempts} "
+                  f"failed: {e}", file=sys.stderr)
+            time.sleep(10)
+    # fall back to CPU in a re-exec'd interpreter (plugin may already be
+    # registered here, which makes in-process fallback hang)
+    if os.environ.get("BENCH_CPU_FALLBACK") != "1":
+        print(f"[bench] backend unavailable after {attempts} attempts "
+              f"({last_err}); re-exec on CPU", file=sys.stderr)
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_CPU_FALLBACK"] = "1"
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
+    raise SystemExit(f"backend init failed: {last_err}")
+
+
 def main() -> None:
+    # init the backend FIRST: the CPU-fallback path re-execs, and building
+    # the dataset before that would do the expensive work twice
+    jax, backend = _init_backend()
     t0 = time.time()
-    X, y = make_higgs_like(N, F)
-    import jax
+    X, y = make_higgs_like(N + N_EVAL, F)
+    X_eval, y_eval = X[N:], y[N:]
+    X, y = X[:N], y[:N]
 
     import lightgbm_tpu as lgb
     from lightgbm_tpu.booster import Booster
 
     print(f"[bench] data {X.shape} built in {time.time()-t0:.1f}s; "
-          f"devices={jax.devices()}", file=sys.stderr)
+          f"backend={backend}", file=sys.stderr)
 
     params = {"objective": "binary", "num_leaves": NUM_LEAVES,
               "max_bin": MAX_BIN, "learning_rate": 0.1, "verbosity": -1}
@@ -78,18 +121,26 @@ def main() -> None:
     elapsed = time.time() - t0
     rounds_per_sec = timed_rounds / elapsed
 
-    # sanity: AUC on a held-out slice
+    # rough effective-bandwidth estimate (see PROFILE.md): each split level
+    # re-reads the smaller child's bin rows + payload; with the subtraction
+    # trick a tree of L leaves scans ~N*log2(L)/2 rows of (F + 16) bytes
+    levels = np.log2(NUM_LEAVES) / 2 + 1
+    bytes_per_round = N * (F + 16) * levels
+    gbps = bytes_per_round * rounds_per_sec / 1e9
+    print(f"[bench] est. effective HBM traffic ~{gbps:.0f} GB/s "
+          f"(analytic, not profiled)", file=sys.stderr)
+
+    # held-out AUC sanity check
     try:
         from lightgbm_tpu.metrics import _auc
-        n_eval = min(100_000, N)
-        raw = bst.predict(X[:n_eval], raw_score=True)
-        auc = _auc(raw, y[:n_eval], None, None)
-        print(f"[bench] train-slice AUC after {bst.current_iteration()} "
-              f"rounds: {auc:.4f}", file=sys.stderr)
+        raw = bst.predict(X_eval, raw_score=True)
+        auc = _auc(raw, y_eval, None, None)
+        print(f"[bench] held-out AUC after {bst.current_iteration()} "
+              f"rounds: {auc:.4f} (n_eval={N_EVAL})", file=sys.stderr)
     except Exception as e:  # pragma: no cover
         print(f"[bench] AUC check failed: {e}", file=sys.stderr)
 
-    baseline = BASELINE_HIGGS_ROUNDS_PER_SEC * (BASELINE_HIGGS_ROWS / N)
+    baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / N)
     print(json.dumps({
         "metric": f"boosting_rounds_per_sec_higgs{N//1000}k",
         "value": round(rounds_per_sec, 3),

@@ -214,6 +214,7 @@ class Booster:
             max_cat_to_onehot=self.config.max_cat_to_onehot,
         )
         self._grower = make_grower(self._grower_spec)
+        self._build_feat()
         self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
@@ -242,6 +243,20 @@ class Booster:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
+
+    def _build_feat(self) -> None:
+        """Per-feature metadata pytree for the grower, incl. monotone
+        constraints (ref: monotone_constraints.hpp BasicLeafConstraints;
+        config.h monotone_constraints is per-feature in {-1, 0, +1},
+        shorter vectors are zero-extended like the reference's parser)."""
+        mono_cfg = list(self.config.monotone_constraints or [])
+        mono = np.zeros(self._dd.num_feature, dtype=np.int32)
+        if mono_cfg:
+            k = min(len(mono_cfg), self._dd.num_feature)
+            mono[:k] = np.asarray(mono_cfg[:k], dtype=np.int32)
+        self._feat = dict(nb=self._dd.feat_nb, missing=self._dd.feat_missing,
+                          default=self._dd.feat_default,
+                          is_cat=self._dd.is_cat, mono=jnp.asarray(mono))
 
     def _zero_score(self, dd: _DeviceData) -> jax.Array:
         K = self.num_tree_per_iteration
@@ -404,8 +419,7 @@ class Booster:
             allowed = self._feature_mask(it, k)
             dev = self._grower(dd.bins_fm, gk.astype(jnp.float32),
                                hk.astype(jnp.float32), sw,
-                               dd.feat_nb, dd.feat_missing, dd.feat_default,
-                               allowed, dd.is_cat)
+                               self._feat, allowed)
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
             if tree.num_leaves > 1:
                 all_const = False
@@ -582,8 +596,8 @@ class Booster:
             while remaining >= self._BULK_CHUNK:
                 score, stacked = trainer(
                     self._train_score, jnp.int32(self.cur_iter),
-                    self._rng_key0, self._ff_key0, dd.bins_fm, dd.feat_nb,
-                    dd.feat_missing, dd.feat_default, base, dd.is_cat)
+                    self._rng_key0, self._ff_key0, dd.bins_fm, self._feat,
+                    base)
                 self._train_score = score
                 finished = self._decode_stacked(stacked)
                 remaining -= self._BULK_CHUNK
@@ -1075,6 +1089,7 @@ class Booster:
             min_gain_to_split=self.config.min_gain_to_split,
             max_delta_step=self.config.max_delta_step)
         self._grower = make_grower(self._grower_spec)
+        self._build_feat()
         return self
 
     def __copy__(self):

@@ -188,6 +188,9 @@ class QuantileLoss(RegressionL2):
     def grad_hess(self, score, label, weight):
         a = self.config.alpha
         d = score - label
+        # ties (delta == 0) get gradient 1-alpha, matching the reference
+        # (ref: regression_objective.hpp RegressionQuantileloss::GetGradients
+        # `if (delta >= 0) grad = 1-alpha else -alpha`)
         grad = jnp.where(d >= 0, 1.0 - a, -a)
         hess = jnp.ones_like(score)
         return _apply_weight(grad, hess, weight)
@@ -347,7 +350,8 @@ class MulticlassSoftmax(ObjectiveFunction):
         onehot = jax.nn.one_hot(label.astype(jnp.int32), self.num_class,
                                 dtype=score.dtype)
         grad = p - onehot
-        factor = 2.0  # ref: multiclass_objective.hpp hessian factor
+        # ref: multiclass_objective.hpp factor_ = num_class/(num_class-1)
+        factor = self.num_class / max(self.num_class - 1, 1)
         hess = factor * p * (1.0 - p)
         return _apply_weight(grad, hess, weight)
 

@@ -103,8 +103,7 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable):
     K = spec.num_class
     lr = spec.learning_rate
 
-    def chunk_step(carry, it, *, bins_fm, feat_nb, feat_missing,
-                   feat_default, base_allowed, is_cat, key0, ff_key0):
+    def chunk_step(carry, it, *, bins_fm, feat, base_allowed, key0, ff_key0):
         score = carry
         grad, hess = grad_fn(score)
         n = bins_fm.shape[1]
@@ -127,8 +126,7 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable):
             allowed = feature_mask(it, k, ff_key0, base_allowed,
                                    feature_fraction=spec.feature_fraction)
             dev = grow(bins_fm, gk.astype(jnp.float32),
-                       hk.astype(jnp.float32), sw,
-                       feat_nb, feat_missing, feat_default, allowed, is_cat)
+                       hk.astype(jnp.float32), sw, feat, allowed)
             contrib = dev.leaf_value[dev.leaf_id] * lr
             if K == 1:
                 new_score = new_score + contrib
@@ -141,13 +139,10 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable):
         return new_score, stacked
 
     @jax.jit
-    def train_chunk(score, it0, key0, ff_key0, bins_fm, feat_nb,
-                    feat_missing, feat_default, base_allowed, is_cat):
+    def train_chunk(score, it0, key0, ff_key0, bins_fm, feat, base_allowed):
         step = functools.partial(
-            chunk_step, bins_fm=bins_fm, feat_nb=feat_nb,
-            feat_missing=feat_missing, feat_default=feat_default,
-            base_allowed=base_allowed, is_cat=is_cat, key0=key0,
-            ff_key0=ff_key0)
+            chunk_step, bins_fm=bins_fm, feat=feat,
+            base_allowed=base_allowed, key0=key0, ff_key0=ff_key0)
         its = it0 + jnp.arange(spec.chunk)
         return jax.lax.scan(step, score, its)
 

@@ -89,9 +89,22 @@ def find_best_split(hist: Array,
                     min_data_in_leaf: float, min_sum_hessian: float,
                     min_gain_to_split: float,
                     cat_smooth: float, cat_l2: float,
-                    max_cat_threshold: int, max_cat_to_onehot: int
-                    ) -> SplitResult:
-    """Best split over all features of one leaf (numerical + categorical)."""
+                    max_cat_threshold: int, max_cat_to_onehot: int,
+                    max_delta_step: float = 0.0,
+                    mono: Array = None, out_lb: Array = None,
+                    out_ub: Array = None) -> SplitResult:
+    """Best split over all features of one leaf (numerical + categorical).
+
+    `mono` [F] in {-1, 0, +1} plus scalar leaf output bounds [out_lb, out_ub]
+    implement the reference's "basic" monotone method (ref:
+    feature_histogram.hpp under USE_MC + monotone_constraints.hpp
+    `BasicLeafConstraints`): candidate child outputs are clamped to the
+    leaf's bounds, splits whose clamped outputs violate the feature's
+    direction are masked, and the gain of constrained candidates uses the
+    given-output form `-(2·ThresholdL1(g)·w + (h+λ₂)·w²)` — which equals the
+    closed form when no clamping binds, so unconstrained training is
+    bit-identical to passing mono=0.
+    """
     F, MB, _ = hist.shape
     bin_ar = jnp.arange(MB, dtype=jnp.int32)
     valid_bin = bin_ar[None, :] < feat_nb[:, None]              # [F, MB]
@@ -99,6 +112,10 @@ def find_best_split(hist: Array,
     parent = jnp.stack([parent_g, parent_h, parent_c])           # [3]
     num_ok = allowed & ~is_cat
     cat_ok = allowed & is_cat
+    if mono is None:
+        mono = jnp.zeros((F,), jnp.int32)
+    lb = jnp.float32(-jnp.inf) if out_lb is None else out_lb
+    ub = jnp.float32(jnp.inf) if out_ub is None else out_ub
 
     def constraints_ok(left, right):
         return ((left[..., 2] >= min_data_in_leaf)
@@ -110,6 +127,11 @@ def find_best_split(hist: Array,
         return (leaf_gain(left[..., 0], left[..., 1], l1, l2_eff)
                 + leaf_gain(right[..., 0], right[..., 1], l1, l2_eff)
                 - shift)
+
+    def gain_given_output(side, out, l2_eff):
+        # ref: feature_histogram.hpp GetLeafGainGivenOutput
+        t = threshold_l1(side[..., 0], l1)
+        return -(2.0 * t * out + (side[..., 1] + l2_eff) * out * out)
 
     # ---------------------------------------------------------- numerical
     cum = jnp.cumsum(h, axis=1)                                  # [F, MB, 3]
@@ -125,21 +147,52 @@ def find_best_split(hist: Array,
     valid_t = (bin_ar[None, :] <= t_max[:, None]) & num_ok[:, None]
 
     shift_num = leaf_gain(parent_g, parent_h, l1, l2) + min_gain_to_split
+    # any active constraint (finite bounds / nonzero mono) switches the
+    # candidate to clamped-output gain; otherwise closed form (identical)
+    constrained = (jnp.isfinite(lb) | jnp.isfinite(ub)
+                   | (mono[:, None] != 0))                       # [F, 1]
+
+    def num_gain(left, right, valid):
+        plain = split_gain(left, right, l2, shift_num)
+        l_out = jnp.clip(leaf_output(left[..., 0], left[..., 1], l1, l2,
+                                     max_delta_step), lb, ub)
+        r_out = jnp.clip(leaf_output(right[..., 0], right[..., 1], l1, l2,
+                                     max_delta_step), lb, ub)
+        cg = (gain_given_output(left, l_out, l2)
+              + gain_given_output(right, r_out, l2)) - shift_num
+        viol = (((mono[:, None] > 0) & (l_out > r_out))
+                | ((mono[:, None] < 0) & (l_out < r_out)))
+        g = jnp.where(constrained, jnp.where(viol, NEG_INF, cg), plain)
+        return jnp.where(valid & constraints_ok(left, right), g, NEG_INF)
+
     # case 0: missing right (NaN bin is last; prefix sums exclude it).
     left0 = cum
     right0 = parent[None, None, :] - left0
-    gain0 = jnp.where(valid_t & constraints_ok(left0, right0),
-                      split_gain(left0, right0, l2, shift_num), NEG_INF)
+    gain0 = num_gain(left0, right0, valid_t)
     # case 1: missing left.
     left1 = cum + nanv[:, None, :]
     right1 = parent[None, None, :] - left1
-    gain1 = jnp.where(valid_t & has_nan[:, None]
-                      & constraints_ok(left1, right1),
-                      split_gain(left1, right1, l2, shift_num), NEG_INF)
+    gain1 = num_gain(left1, right1, valid_t & has_nan[:, None])
 
     # --------------------------------------------------------- categorical
+    # ancestor output bounds clamp categorical candidates too (reference:
+    # GetSplitGains is constraint-aware in FindBestThresholdCategorical);
+    # no direction check — monotone on a categorical feature is meaningless
+    # and treated as 0 (the reference rejects it at config time)
     l2c = l2 + cat_l2
     shift_cat = leaf_gain(parent_g, parent_h, l1, l2c) + min_gain_to_split
+    cat_bounded = jnp.isfinite(lb) | jnp.isfinite(ub)
+
+    def cat_gain(left, right, valid):
+        plain = split_gain(left, right, l2c, shift_cat)
+        l_out = jnp.clip(leaf_output(left[..., 0], left[..., 1], l1, l2c,
+                                     max_delta_step), lb, ub)
+        r_out = jnp.clip(leaf_output(right[..., 0], right[..., 1], l1, l2c,
+                                     max_delta_step), lb, ub)
+        cg = (gain_given_output(left, l_out, l2c)
+              + gain_given_output(right, r_out, l2c)) - shift_cat
+        g = jnp.where(cat_bounded, cg, plain)
+        return jnp.where(valid & constraints_ok(left, right), g, NEG_INF)
     cnt = h[..., 2]
     # bin 0 = other/missing bin: never in the left subset (see docstring)
     cat_valid = (bin_ar[None, :] >= 1) & valid_bin & (cnt > 0) \
@@ -149,9 +202,8 @@ def find_best_split(hist: Array,
     # case 2: one-vs-rest (used <= max_cat_to_onehot)
     left2 = h
     right2 = parent[None, None, :] - left2
-    ok2 = cat_valid & (used[:, None] <= max_cat_to_onehot) \
-        & constraints_ok(left2, right2)
-    gain2 = jnp.where(ok2, split_gain(left2, right2, l2c, shift_cat), NEG_INF)
+    gain2 = cat_gain(left2, right2,
+                     cat_valid & (used[:, None] <= max_cat_to_onehot))
 
     # cases 3/4: sorted many-vs-rest (used > max_cat_to_onehot)
     # ref: FindBestThresholdCategorical sorts by sum_grad/(sum_hess+cat_smooth)
@@ -168,8 +220,7 @@ def find_best_split(hist: Array,
         okk = (k <= max_cat_threshold) & (k < used[:, None]) \
             & (used[:, None] > max_cat_to_onehot) & cat_ok[:, None]
         right = parent[None, None, :] - cumk
-        g = jnp.where(okk & constraints_ok(cumk, right),
-                      split_gain(cumk, right, l2c, shift_cat), NEG_INF)
+        g = cat_gain(cumk, right, okk)
         return g, cumk
 
     gain3, cum3 = prefix_gains(order_asc)

@@ -65,20 +65,23 @@ def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
                             axis: str = "data"):
     """One full boosting iteration as a single SPMD program.
 
-    grad_fn(score, label, weight) -> (grad, hess), elementwise.
-    Returns step(score, label, weight, bins_fm, feat_nb, feat_missing,
-    feat_default, allowed) -> (new_score, DeviceTree) with the tree arrays
-    replicated across shards and score/leaf_id sharded.
+    grad_fn(score, label) -> (grad, hess), elementwise and UNWEIGHTED —
+    the grower applies `weight` exactly once (payload = [g·w, h·w, w]),
+    so row weights (incl. the 0-weight padding rows from `shard_dataset`)
+    enter the histogram a single time, matching the reference's
+    weighted-gradient semantics (ref: objective_function.h GetGradients
+    weighted variants).
+    Returns step(score, label, weight, bins_fm, feat, allowed)
+    -> (new_score, DeviceTree) with the tree arrays replicated across
+    shards and score/leaf_id sharded.
     """
     grow = make_grower(spec, axis_name=axis)
     lr = learning_rate
 
-    def step(score, label, weight, bins_fm, feat_nb, feat_missing,
-             feat_default, allowed, is_cat):
-        grad, hess = grad_fn(score, label, weight)
+    def step(score, label, weight, bins_fm, feat, allowed):
+        grad, hess = grad_fn(score, label)
         dev = grow(bins_fm, grad.astype(jnp.float32),
-                   hess.astype(jnp.float32), weight,
-                   feat_nb, feat_missing, feat_default, allowed, is_cat)
+                   hess.astype(jnp.float32), weight, feat, allowed)
         new_score = score + dev.leaf_value[dev.leaf_id] * lr
         return new_score, dev
 
@@ -92,7 +95,7 @@ def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(None, axis),
-                  P(None), P(None), P(None), P(None), P(None)),
+                  P(None), P(None)),
         out_specs=(P(axis), tree_specs),
         check_vma=False)
     return jax.jit(sharded)

@@ -13,9 +13,9 @@ from lightgbm_tpu.parallel import get_mesh, make_sharded_train_step, \
     shard_dataset
 
 
-def _binary_grad(score, label, weight):
+def _binary_grad(score, label):
     p = jax.nn.sigmoid(score)
-    return (p - label) * weight, p * (1 - p) * weight
+    return p - label, p * (1 - p)
 
 
 def make_data(n=2048, f=6, seed=3):
@@ -23,6 +23,18 @@ def make_data(n=2048, f=6, seed=3):
     X = rng.randn(n, f)
     y = (X[:, 0] - 0.5 * X[:, 1] + 0.3 * rng.randn(n) > 0).astype(np.float64)
     return X, y
+
+
+def _feat_of(mappers, f):
+    return dict(
+        nb=jnp.asarray(np.array([m.num_bin for m in mappers], np.int32)),
+        missing=jnp.asarray(np.array([m.missing_type for m in mappers],
+                                     np.int32)),
+        default=jnp.asarray(np.array([m.default_bin for m in mappers],
+                                     np.int32)),
+        is_cat=jnp.asarray(np.array([m.bin_type == 1 for m in mappers],
+                                    dtype=bool)),
+        mono=jnp.zeros(f, jnp.int32))
 
 
 class TestShardedGrower:
@@ -42,10 +54,7 @@ class TestShardedGrower:
                           min_data_in_leaf=20.0,
                           min_sum_hessian_in_leaf=1e-3,
                           min_gain_to_split=0.0, max_delta_step=0.0)
-        nb = jnp.asarray(np.array([m.num_bin for m in mappers], np.int32))
-        ms = jnp.asarray(np.array([m.missing_type for m in mappers],
-                                  np.int32))
-        df = jnp.asarray(np.array([m.default_bin for m in mappers], np.int32))
+        feat = _feat_of(mappers, bins.shape[1])
         allowed = jnp.asarray(np.array(
             [not m.is_trivial for m in mappers], dtype=bool))
 
@@ -54,10 +63,8 @@ class TestShardedGrower:
         label32 = jnp.asarray(y.astype(np.float32))
         score0 = jnp.zeros(len(y), jnp.float32)
         ones = jnp.ones(len(y), jnp.float32)
-        g, h = _binary_grad(score0, label32, ones)
-        no_cat = jnp.zeros(bins.shape[1], dtype=bool)
-        ref = grow(jnp.asarray(bins.T), g, h, ones, nb, ms, df, allowed,
-                   no_cat)
+        g, h = _binary_grad(score0, label32)
+        ref = grow(jnp.asarray(bins.T), g, h, ones, feat, allowed)
 
         # sharded step
         mesh = get_mesh(shards)
@@ -69,7 +76,7 @@ class TestShardedGrower:
             jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec("data")))
         new_score, tree = step(score, dev_label, dev_w, dev_bins,
-                               nb, ms, df, allowed, no_cat)
+                               feat, allowed)
 
         assert int(tree.n_splits) == int(ref.n_splits)
         np.testing.assert_array_equal(np.asarray(tree.split_feature),
@@ -92,10 +99,7 @@ class TestShardedGrower:
         mappers = ds.bin_mappers
         spec = GrowerSpec(15, -1, max(m.num_bin for m in mappers),
                           0.0, 0.0, 20.0, 1e-3, 0.0, 0.0)
-        nb = jnp.asarray(np.array([m.num_bin for m in mappers], np.int32))
-        ms = jnp.asarray(np.array([m.missing_type for m in mappers],
-                                  np.int32))
-        df = jnp.asarray(np.array([m.default_bin for m in mappers], np.int32))
+        feat = _feat_of(mappers, bins.shape[1])
         allowed = jnp.asarray(np.ones(bins.shape[1], dtype=bool))
         mesh = get_mesh(8)
         step = make_sharded_train_step(spec, mesh, _binary_grad, 0.2)
@@ -104,11 +108,45 @@ class TestShardedGrower:
             np.zeros(len(y), np.float32),
             jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec("data")))
-        no_cat = jnp.zeros(bins.shape[1], dtype=bool)
         for _ in range(10):
             score, _tree = step(score, dev_label, dev_w, dev_bins,
-                                nb, ms, df, allowed, no_cat)
+                                feat, allowed)
         p = 1.0 / (1.0 + np.exp(-np.asarray(score)))
         logloss = -np.mean(y * np.log(p + 1e-9)
                            + (1 - y) * np.log(1 - p + 1e-9))
         assert logloss < 0.45  # learned something across 8 shards
+
+    def test_fractional_weights_not_squared(self):
+        """Row weights must enter the histogram exactly once (g·w, h·w, w) —
+        a rank-weighted run must match an unsharded grower given the same
+        weighted payload."""
+        X, y = make_data(1024)
+        w = np.full(len(y), 0.5, np.float32)
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+        bins = np.asarray(ds.bin_data)
+        mappers = ds.bin_mappers
+        spec = GrowerSpec(15, -1, max(m.num_bin for m in mappers),
+                          0.0, 0.0, 5.0, 1e-3, 0.0, 0.0)
+        feat = _feat_of(mappers, bins.shape[1])
+        allowed = jnp.asarray(np.ones(bins.shape[1], dtype=bool))
+
+        grow = make_grower(spec)
+        label32 = jnp.asarray(y.astype(np.float32))
+        score0 = jnp.zeros(len(y), jnp.float32)
+        g, h = _binary_grad(score0, label32)
+        ref = grow(jnp.asarray(bins.T), g, h, jnp.asarray(w), feat, allowed)
+
+        mesh = get_mesh(8)
+        step = make_sharded_train_step(spec, mesh, _binary_grad, 0.1)
+        dev_bins, dev_label, dev_w, _ = shard_dataset(bins, y, mesh,
+                                                      weight=w)
+        score = jax.device_put(
+            np.zeros(len(y), np.float32),
+            jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec("data")))
+        _, tree = step(score, dev_label, dev_w, dev_bins, feat, allowed)
+        assert int(tree.n_splits) == int(ref.n_splits)
+        np.testing.assert_allclose(np.asarray(tree.leaf_value),
+                                   np.asarray(ref.leaf_value),
+                                   rtol=2e-4, atol=2e-6)

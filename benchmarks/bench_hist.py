@@ -1,0 +1,62 @@
+"""Micro-benchmark: histogram implementations at Higgs shape.
+
+Usage (real TPU):  python benchmarks/bench_hist.py [N] [F] [MB]
+Compares jax.ops.segment_sum vs the Pallas kernel (onehot / hilo) and
+prints ms/call + effective GB/s (bins + payload read per call).
+"""
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    f = int(sys.argv[2]) if len(sys.argv) > 2 else 28
+    mb = int(sys.argv[3]) if len(sys.argv) > 3 else 256
+
+    import jax
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.ops.histogram import leaf_histogram
+    from lightgbm_tpu.ops.pallas_hist import pallas_histogram
+
+    print(f"backend={jax.devices()[0].platform} n={n} f={f} mb={mb}")
+    rng = np.random.RandomState(0)
+    bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+    payload = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+    mask = jnp.asarray(rng.rand(n) < 0.5)
+    seg = jax.jit(lambda b, p, m: leaf_histogram(b, p, m, mb))
+
+    bytes_per_call = n * f + n * 3 * 4 + n  # bins + payload + mask
+
+    impls = {"segment_sum": lambda: seg(bins, payload, mask)}
+    for impl in ("onehot", "hilo"):
+        impls[f"pallas_{impl}"] = (
+            lambda impl=impl: pallas_histogram(bins, payload, mask, mb,
+                                               impl=impl))
+
+    results = {}
+    for name, fn in impls.items():
+        try:
+            out = jax.block_until_ready(fn())  # compile + warmup
+            reps = 10
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = fn()
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / reps
+            results[name] = dt
+            print(f"{name:16s} {dt*1e3:8.2f} ms/call  "
+                  f"{bytes_per_call/dt/1e9:7.1f} GB/s")
+        except Exception as e:
+            print(f"{name:16s} FAILED: {type(e).__name__}: {e}")
+    if "segment_sum" in results:
+        for k, v in results.items():
+            if k != "segment_sum":
+                print(f"{k} speedup vs segment_sum: "
+                      f"{results['segment_sum']/v:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

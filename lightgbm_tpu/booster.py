@@ -212,6 +212,7 @@ class Booster:
             cat_l2=self.config.cat_l2,
             max_cat_threshold=self.config.max_cat_threshold,
             max_cat_to_onehot=self.config.max_cat_to_onehot,
+            hist_impl=self._resolve_hist_impl(),
         )
         self._grower = make_grower(self._grower_spec)
         self._build_feat()
@@ -243,6 +244,26 @@ class Booster:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
+
+    def _resolve_hist_impl(self) -> str:
+        """Pick the histogram implementation: the Pallas kernel on real TPU
+        backends (gated on a tiny compile-and-compare probe so a Mosaic
+        regression degrades to the XLA path instead of crashing training),
+        segment-sum elsewhere (CPU tests, interpret)."""
+        if not self.config.tpu_use_pallas:
+            return "segment_sum"
+        try:
+            platform = jax.devices()[0].platform
+        except RuntimeError:
+            return "segment_sum"
+        if platform not in ("tpu", "axon"):
+            return "segment_sum"
+        from .ops.pallas_hist import probe_cached
+        if probe_cached(self._dd.max_bin, self._dd.num_feature):
+            return "pallas"
+        log.warning("Pallas histogram probe failed on this backend; "
+                    "falling back to segment-sum")
+        return "segment_sum"
 
     def _build_feat(self) -> None:
         """Per-feature metadata pytree for the grower, incl. monotone

@@ -61,6 +61,7 @@ class GrowerSpec(NamedTuple):
     cat_l2: float = 10.0
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
+    hist_impl: str = "segment_sum"  # or "pallas" (ops/pallas_hist.py)
 
 
 class DeviceTree(NamedTuple):
@@ -143,7 +144,11 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
             mono = jnp.zeros((F,), jnp.int32)
 
         def hist_of(mask_rows):
-            h = leaf_histogram(bins_fm, payload, mask_rows, MB)
+            if spec.hist_impl == "pallas":
+                from .pallas_hist import pallas_histogram
+                h = pallas_histogram(bins_fm, payload, mask_rows, MB)
+            else:
+                h = leaf_histogram(bins_fm, payload, mask_rows, MB)
             if axis_name is not None:
                 h = jax.lax.psum(h, axis_name)
             return h

@@ -1,0 +1,28 @@
+"""Environment helpers for forcing a clean CPU backend.
+
+The session environment may pre-register a remote-TPU PJRT plugin (axon)
+via sitecustomize; with it registered, even ``JAX_PLATFORMS=cpu`` hangs at
+backend init, so anything that needs a CPU mesh (tests, multichip dry run,
+bench fallback) must strip the registration gate and re-exec/subprocess.
+This is the single copy of that workaround (used by tests/conftest.py,
+__graft_entry__.py and bench.py).
+"""
+from __future__ import annotations
+
+import re
+
+
+def cleaned_cpu_env(base_env: dict, n_devices: int = 8) -> dict:
+    """A copy of `base_env` for a subprocess that must run on a pure CPU
+    backend with exactly `n_devices` virtual devices."""
+    env = dict(base_env)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    # replace (not keep) any existing device-count flag: a stale value from
+    # another harness would silently under-provision the mesh
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
+    return env

@@ -216,6 +216,7 @@ class Booster:
         )
         self._grower = make_grower(self._grower_spec)
         self._build_feat()
+        self._setup_tree_learner()
         self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
@@ -278,6 +279,55 @@ class Booster:
         self._feat = dict(nb=self._dd.feat_nb, missing=self._dd.feat_missing,
                           default=self._dd.feat_default,
                           is_cat=self._dd.is_cat, mono=jnp.asarray(mono))
+
+    def _setup_tree_learner(self) -> None:
+        """Resolve `tree_learner` (+ device count) into the grower used for
+        training — the TPU analog of the reference's learner factory
+        (ref: tree_learner.cpp `TreeLearner::CreateTreeLearner`; the
+        reference dispatches {serial,feature,data,voting} x device; here
+        serial = 1-device grower and the rest are shard_map'ped over a mesh,
+        see parallel/learner.py)."""
+        from .parallel.learner import resolve_tree_learner
+        cfg = self.config
+        kind = resolve_tree_learner(cfg.tree_learner or "serial")
+        if kind == "serial":
+            self._mesh = None
+            self._train_bins = self._dd.bins_fm
+            self._learner_cache_key = None
+            return
+        try:
+            n_dev = len(jax.devices())
+        except RuntimeError:
+            n_dev = 1
+        shards = cfg.num_machines if (cfg.num_machines or 0) > 1 else n_dev
+        if shards > n_dev:
+            log.warning(f"num_machines={shards} exceeds visible devices "
+                        f"({n_dev}); using {n_dev}")
+            shards = n_dev
+        if shards <= 1:
+            log.warning(f"tree_learner={kind} requested but only one device "
+                        "is visible; using the serial learner")
+            self._mesh = None
+            self._train_bins = self._dd.bins_fm
+            self._learner_cache_key = None
+            return
+        # reset_parameter (lr schedules) calls this every iteration — reuse
+        # the compiled grower and placed bins when nothing changed
+        key = (self._grower_spec, kind, shards)
+        if getattr(self, "_learner_cache_key", None) == key:
+            return
+        from .parallel import get_mesh
+        from .parallel.learner import make_distributed_grower, \
+            place_training_data
+        self._mesh = get_mesh(shards)
+        self._train_bins = place_training_data(
+            np.asarray(self._dd.bins_fm), self._mesh, kind)
+        self._grower = make_distributed_grower(
+            self._grower_spec, self._mesh, kind,
+            self._dd.num_feature, self._dd.num_data)
+        self._learner_cache_key = key
+        log.info(f"tree_learner={kind}: training sharded over "
+                 f"{shards} device(s)")
 
     def _zero_score(self, dd: _DeviceData) -> jax.Array:
         K = self.num_tree_per_iteration
@@ -438,7 +488,7 @@ class Booster:
             gk = grad if K == 1 else grad[:, k]
             hk = hess if K == 1 else hess[:, k]
             allowed = self._feature_mask(it, k)
-            dev = self._grower(dd.bins_fm, gk.astype(jnp.float32),
+            dev = self._grower(self._train_bins, gk.astype(jnp.float32),
                                hk.astype(jnp.float32), sw,
                                self._feat, allowed)
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
@@ -575,6 +625,7 @@ class Booster:
     def _bulk_eligible(self) -> bool:
         cfg = self.config
         return (self._fobj is None and self.objective_ is not None
+                and getattr(self, "_mesh", None) is None
                 and not getattr(self.objective_, "needs_rng", False)
                 and getattr(self.objective_, "renew_percentile", None) is None
                 and self._boost_mode == "gbdt"
@@ -1111,6 +1162,7 @@ class Booster:
             max_delta_step=self.config.max_delta_step)
         self._grower = make_grower(self._grower_spec)
         self._build_feat()
+        self._setup_tree_learner()
         return self
 
     def __copy__(self):

@@ -98,19 +98,56 @@ def _split_to_arrays(s: SplitResult):
             s.is_cat, s.cat_mask)
 
 
+def _merge_split_across_shards(s: SplitResult, axis_name: str,
+                               n_shards: int) -> SplitResult:
+    """SplitInfo Allreduce(max) over the mesh (ref: network.cpp
+    `Network::Allreduce` with `SplitInfo::MaxReducer`).
+
+    Each shard proposes the best split of ITS feature block; the winner is
+    the max gain with a deterministic tie-break on lowest shard index (the
+    blocks are disjoint, so ties are between distinct features — the
+    reference breaks these on smaller feature index, which lowest-shard +
+    first-wins-within-shard reproduces for block feature order).  The
+    winner's whole payload is broadcast with a masked psum — O(MB) bytes,
+    the TPU analog of allreducing the packed SplitInfo struct."""
+    me = jax.lax.axis_index(axis_name)
+    best_gain = jax.lax.pmax(s.gain, axis_name)
+    cand = jnp.where(s.gain >= best_gain, me, n_shards)
+    winner = jax.lax.pmin(cand, axis_name)
+    sel = me == winner
+
+    def pick(x):
+        masked = jnp.where(sel, x, jnp.zeros_like(x))
+        if masked.dtype == jnp.bool_:
+            return jax.lax.psum(masked.astype(jnp.int32), axis_name) > 0
+        return jax.lax.psum(masked, axis_name)
+
+    return jax.tree_util.tree_map(pick, s)
+
+
 @functools.lru_cache(maxsize=64)
-def make_grower(spec: GrowerSpec, axis_name: str = None):
+def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
+                n_shards: int = 1):
     """Build (and cache) the jitted grow function for a static spec.
 
-    With `axis_name`, the grower becomes the DATA-PARALLEL tree learner
-    (ref: src/treelearner/data_parallel_tree_learner.cpp): rows are sharded
-    over the named mesh axis, each shard histograms its local rows, and the
-    histograms are `psum`med over ICI — the TPU equivalent of
-    `Network::ReduceScatter` + per-feature split finding + the SplitInfo
-    `Allreduce(max)` (every shard then computes the identical argmax from the
-    identical summed histogram, trading redundant O(F·MB) compute for zero
-    extra collectives; split application is shard-local, no row exchange,
-    exactly like the reference).  Call it under `jax.shard_map`.
+    With `axis_name`, the grower becomes a DISTRIBUTED tree learner; call it
+    under `jax.shard_map`.  `mode` picks the parallelism strategy (the TPU
+    re-design of the reference's TreeLearner factory cross product,
+    ref: src/treelearner/tree_learner.cpp `TreeLearner::CreateTreeLearner`):
+
+    - "data" (ref: data_parallel_tree_learner.cpp): rows sharded over the
+      axis, each shard histograms its local rows, full [F, MB, 3] histograms
+      are `psum`med, every shard finds the identical best split (replicated
+      compute, zero extra collectives).  No divisibility requirements.
+    - "data_rs" (same reference, closer comm pattern): histograms are
+      `psum_scatter`ed over the feature axis — the literal TPU analog of
+      `Network::ReduceScatter` — so each shard scans only its F/S feature
+      block for splits, then the winning `SplitInfo` is allreduce-maxed.
+      Requires F % n_shards == 0 (callers pad features).
+    - "feature" (ref: feature_parallel_tree_learner.cpp): every shard holds
+      ALL rows (bins replicated), searches only its feature block, and the
+      winning SplitInfo is allreduce-maxed; split application is local on
+      every shard since all rows are present.  Requires F % n_shards == 0.
     """
     L = spec.num_leaves
     MB = spec.max_bin
@@ -129,6 +166,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
         return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
                            spec.max_delta_step)
 
+    block = axis_name is not None and mode in ("data_rs", "feature")
+
     def grow(bins_fm: Array,       # [F, N] uint8/16 feature-major
              grad: Array,          # [N] f32
              hess: Array,          # [N] f32
@@ -143,20 +182,56 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
         if mono is None:
             mono = jnp.zeros((F,), jnp.int32)
 
+        if block:
+            # this shard owns feature block [offset, offset + Fb) for split
+            # finding; partition still uses the full (global) feature space
+            if F % n_shards != 0:
+                raise ValueError(
+                    f"{mode} learner requires features ({F}) divisible by "
+                    f"shards ({n_shards}); pad features first")
+            Fb = F // n_shards
+            offset = jax.lax.axis_index(axis_name) * Fb
+
+            def bslice(x):
+                return jax.lax.dynamic_slice_in_dim(x, offset, Fb, axis=0)
+
+            bfeat = {k: bslice(v) for k, v in feat.items() if k != "mono"}
+            bmono = bslice(mono)
+            # feature mode histograms only this shard's columns (bins are
+            # replicated); data_rs histograms all columns of its row shard
+            hist_bins = bslice(bins_fm) if mode == "feature" else bins_fm
+        else:
+            bfeat, bmono, hist_bins = feat, mono, bins_fm
+
         def hist_of(mask_rows):
             if spec.hist_impl == "pallas":
                 from .pallas_hist import pallas_histogram
-                h = pallas_histogram(bins_fm, payload, mask_rows, MB)
+                h = pallas_histogram(hist_bins, payload, mask_rows, MB)
             else:
-                h = leaf_histogram(bins_fm, payload, mask_rows, MB)
+                h = leaf_histogram(hist_bins, payload, mask_rows, MB)
             if axis_name is not None:
-                h = jax.lax.psum(h, axis_name)
+                if mode == "data":
+                    h = jax.lax.psum(h, axis_name)
+                elif mode == "data_rs":
+                    # ref: Network::ReduceScatter of histogram buffers —
+                    # each shard receives the summed block it will scan
+                    h = jax.lax.psum_scatter(h, axis_name,
+                                             scatter_dimension=0, tiled=True)
             return h
 
         def split_of(hist, g, h, c, node_allowed, lb, ub):
-            return find(hist, g, h, c, feat["nb"], feat["missing"],
-                        feat["default"], node_allowed, feat["is_cat"],
-                        mono=mono, out_lb=lb, out_ub=ub)
+            if block:
+                node_allowed = jax.lax.dynamic_slice_in_dim(
+                    node_allowed, offset, Fb, axis=0)
+            s = find(hist, g, h, c, bfeat["nb"], bfeat["missing"],
+                     bfeat["default"], node_allowed, bfeat["is_cat"],
+                     mono=bmono, out_lb=lb, out_ub=ub)
+            if block:
+                s = s._replace(feature=jnp.where(s.feature >= 0,
+                                                 s.feature + offset,
+                                                 s.feature))
+                s = _merge_split_across_shards(s, axis_name, n_shards)
+            return s
 
         # ---- root ----
         root_mask = jnp.ones((N,), dtype=bool)
@@ -164,15 +239,17 @@ def make_grower(spec: GrowerSpec, axis_name: str = None):
         root_g = payload[:, 0].sum()
         root_h = payload[:, 1].sum()
         root_c = payload[:, 2].sum()
-        if axis_name is not None:
+        if axis_name is not None and mode != "feature":
             # ref: DataParallelTreeLearner::BeforeTrain root-stat Allreduce
+            # (feature mode holds all rows on every shard — already global)
             root_g = jax.lax.psum(root_g, axis_name)
             root_h = jax.lax.psum(root_h, axis_name)
             root_c = jax.lax.psum(root_c, axis_name)
         s0 = split_of(hist0, root_g, root_h, root_c, allowed,
                       jnp.float32(-INF), jnp.float32(INF))
 
-        hist = jnp.zeros((L, F, MB, 3), dtype=jnp.float32).at[0].set(hist0)
+        hist = jnp.zeros((L,) + hist0.shape, dtype=jnp.float32)\
+            .at[0].set(hist0)
         leaf_best = [jnp.zeros((L,) + a.shape, dtype=a.dtype)
                      .at[0].set(a) for a in _split_to_arrays(s0)]
         leaf_best[0] = jnp.full((L,), NEG_INF, dtype=jnp.float32).at[0]\

@@ -1,0 +1,129 @@
+"""TreeLearner factory — public-API distributed training dispatch.
+
+TPU re-design of the reference's learner factory
+(ref: src/treelearner/tree_learner.cpp `TreeLearner::CreateTreeLearner`,
+cross product of {serial, feature, data, voting} x {cpu, gpu, cuda}): given
+`tree_learner=data|feature|voting` (and >1 visible device), Booster training
+routes through a `jax.shard_map`ped grower over a 1-D device mesh instead of
+the serial single-chip grower.  The factory returns a grower with the SAME
+signature as the serial one, so the boosting loop (booster.py `__boost`)
+is oblivious to the device topology — the reference achieves the same with
+virtual dispatch, we do it with jit + sharding.
+
+Strategy mapping (SURVEY §2.7):
+ - data    → rows sharded, histogram `psum_scatter` over the feature axis,
+             per-shard split finding on its block, SplitInfo allreduce-max
+             (ref: data_parallel_tree_learner.cpp).
+ - feature → bins replicated, per-shard feature-block search, SplitInfo
+             allreduce-max, shard-local split apply
+             (ref: feature_parallel_tree_learner.cpp).
+ - voting  → data-parallel with top-k vote (ref:
+             voting_parallel_tree_learner.cpp); currently served by the
+             data strategy (full reduce over ICI is cheap at in-scope
+             feature counts) — a warning documents the fallback.
+
+Row counts need not divide the shard count: rows are padded with
+weight-0 entries inside the jitted wrapper (the fixed-shape analog of the
+reference's `pre_partition`ed per-rank files), features are padded with
+never-allowed columns to a multiple of the shard count.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.grow import DeviceTree, GrowerSpec, make_grower
+from ..utils import log
+
+TREE_LEARNER_ALIASES = {
+    "serial": "serial",
+    "feature": "feature", "feature_parallel": "feature",
+    "data": "data", "data_parallel": "data",
+    "voting": "voting", "voting_parallel": "voting",
+}
+
+
+def resolve_tree_learner(name: str) -> str:
+    """Canonicalize the tree_learner param (ref: config.cpp
+    `Config::GetTreeLearnerType`)."""
+    kind = TREE_LEARNER_ALIASES.get(str(name).lower())
+    if kind is None:
+        raise ValueError(f"Unknown tree learner type {name}")
+    return kind
+
+
+def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
+                            num_feature: int, num_data: int):
+    """Grower with the serial signature, running SPMD over `mesh`.
+
+    Expects `bins_fm` already padded + placed by `place_training_data`
+    ([f_pad, n_pad] — the one-time cost); pads the per-iteration [N]
+    vectors itself.  Returns `grow(bins_fm, grad [N], hess [N], sw [N],
+    feat, allowed) -> DeviceTree` with `leaf_id` of length N.
+    """
+    axis = mesh.axis_names[0]
+    S = int(mesh.shape[axis])
+    mode = {"data": "data_rs", "voting": "data_rs", "feature": "feature"}[kind]
+    if kind == "voting":
+        log.warning("tree_learner=voting is served by the data-parallel "
+                    "strategy on TPU (full histogram reduce-scatter rides "
+                    "ICI; PV-Tree's traffic cut targets commodity ethernet)")
+    f_extra = padded_feature_count(num_feature, S) - num_feature
+    n_extra = (padded_row_count(num_data, S) - num_data) \
+        if mode != "feature" else 0
+    grow = make_grower(spec, axis_name=axis, mode=mode, n_shards=S)
+
+    row_sp = P(axis) if mode != "feature" else P(None)
+    tree_specs = DeviceTree(
+        n_splits=P(), split_leaf=P(), split_feature=P(), threshold_bin=P(),
+        default_left=P(), split_is_cat=P(), split_cat_mask=P(),
+        split_gain=P(), internal_g=P(), internal_h=P(), internal_cnt=P(),
+        leaf_value=P(), leaf_g=P(), leaf_h=P(), leaf_cnt=P(),
+        leaf_id=row_sp)
+    in_specs = (P(None, axis) if mode != "feature" else P(None, None),
+                row_sp, row_sp, row_sp, P(None), P(None))
+    sharded = jax.shard_map(grow, mesh=mesh, in_specs=in_specs,
+                            out_specs=tree_specs, check_vma=False)
+
+    def padded(bins_fm, grad, hess, sw, feat, allowed):
+        if f_extra:
+            feat = {k: jnp.pad(v, (0, f_extra)) for k, v in feat.items()}
+            allowed = jnp.pad(allowed, (0, f_extra))  # False → never split
+        if n_extra:
+            grad = jnp.pad(grad, (0, n_extra))
+            hess = jnp.pad(hess, (0, n_extra))
+            sw = jnp.pad(sw, (0, n_extra))  # weight 0 → inert rows
+        dev = sharded(bins_fm, grad, hess, sw, feat, allowed)
+        if n_extra:
+            dev = dev._replace(leaf_id=dev.leaf_id[:num_data])
+        return dev
+
+    return jax.jit(padded)
+
+
+def padded_feature_count(num_feature: int, shards: int) -> int:
+    return -(-num_feature // shards) * shards
+
+
+def padded_row_count(num_data: int, shards: int) -> int:
+    return -(-num_data // shards) * shards
+
+
+def place_training_data(bins_fm, mesh: Mesh, kind: str):
+    """Pad the bin matrix to mesh-divisible shape and place it: rows
+    sharded for data/voting, replicated for feature (ref: the reference's
+    per-rank pre-partitioned files / full per-rank copies).  One-time cost;
+    the per-iteration jit then never re-transfers the big array."""
+    import numpy as np
+    axis = mesh.axis_names[0]
+    S = int(mesh.shape[axis])
+    f, n = bins_fm.shape
+    f_pad = padded_feature_count(f, S)
+    n_pad = padded_row_count(n, S) if kind != "feature" else n
+    if (f_pad, n_pad) != (f, n):
+        out = np.zeros((f_pad, n_pad), dtype=np.asarray(bins_fm).dtype)
+        out[:f, :n] = np.asarray(bins_fm)
+        bins_fm = out
+    sp = P(None, axis) if kind != "feature" else P(None, None)
+    return jax.device_put(bins_fm, NamedSharding(mesh, sp))

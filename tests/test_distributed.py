@@ -116,6 +116,42 @@ class TestShardedGrower:
                            + (1 - y) * np.log(1 - p + 1e-9))
         assert logloss < 0.45  # learned something across 8 shards
 
+    def test_public_api_tree_learner_parity(self):
+        """`lgb.train({"tree_learner": ...})` must actually shard and grow
+        the same trees as the serial learner (ref: the reference's
+        tests/distributed/_test_distributed.py N-worker vs single-process
+        parity).  Row/feature counts deliberately do NOT divide 8."""
+        X, y = make_data(1100, f=7, seed=11)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "learning_rate": 0.1,
+                  "verbosity": -1}
+        serial = lgb.train({**params, "tree_learner": "serial"},
+                           lgb.Dataset(X, label=y), num_boost_round=5)
+        preds_ref = serial.predict(X, raw_score=True)
+        for kind in ("data", "feature", "voting_parallel"):
+            dist = lgb.train({**params, "tree_learner": kind},
+                             lgb.Dataset(X, label=y), num_boost_round=5)
+            assert getattr(dist, "_mesh", None) is not None, \
+                f"{kind}: mesh was not set up"
+            for ts, td in zip(serial.trees, dist.trees):
+                np.testing.assert_array_equal(
+                    ts.split_feature[:ts.num_internal()],
+                    td.split_feature[:td.num_internal()])
+                np.testing.assert_array_equal(
+                    ts.threshold_bin[:ts.num_internal()],
+                    td.threshold_bin[:td.num_internal()])
+            np.testing.assert_allclose(dist.predict(X, raw_score=True),
+                                       preds_ref, rtol=2e-4, atol=2e-5)
+
+    def test_num_machines_limits_shards(self):
+        X, y = make_data(512, f=4, seed=5)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "tree_learner": "data", "num_machines": 2,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        assert bst._mesh is not None
+        assert bst._mesh.shape["data"] == 2
+
     def test_fractional_weights_not_squared(self):
         """Row weights must enter the histogram exactly once (g·w, h·w, w) —
         a rank-weighted run must match an unsharded grower given the same

@@ -230,17 +230,19 @@ class Booster:
         self._valid_dd: List[_DeviceData] = []
         self._valid_scores: List[jax.Array] = []
 
+        self._grad_key0 = jax.random.PRNGKey(
+            self.config.objective_seed % (2 ** 31))
         if self.objective_ is not None:
             lbl = self._dd.label
             wgt = self._dd.weight
             if getattr(self.objective_, "needs_rng", False):
                 def _grad(score, key):
                     return self.objective_.grad_hess(score, lbl, wgt, key=key)
+                # per-iteration key = fold_in(key0, it) — the SAME derivation
+                # the fused chunk trainer uses, so both paths are identical
                 self._grad_rng_fn = jax.jit(_grad)
                 self._grad_fn = lambda s: self._grad_rng_fn(
-                    s, jax.random.PRNGKey(
-                        (self.config.objective_seed + self.cur_iter)
-                        % (2 ** 31)))
+                    s, jax.random.fold_in(self._grad_key0, self.cur_iter))
             else:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
@@ -532,29 +534,31 @@ class Booster:
         """Refit leaf values as the alpha-percentile of in-leaf residuals
         (ref: regression_objective.hpp `RenewTreeOutput` — exact leaf
         optimum for L1/quantile/MAPE which their grad/hess only approximate).
-        Returns the shrunken per-slot leaf values as a device array and
-        rewrites the host tree in place."""
-        from .objectives import _weighted_percentile
-        label = self.train_set.get_label().astype(np.float64)
-        score = np.asarray(self._train_score, dtype=np.float64)
-        residual = label - score
-        leaf_id = np.asarray(dev.leaf_id)
-        bag = np.asarray(sw, dtype=np.float64)
-        weight = self.train_set.get_weight()
-        w = bag if weight is None else bag * weight.astype(np.float64)
+        Runs entirely on device via one global (leaf, residual) sort
+        (ops/renew.py) — the reference's per-leaf host loop has no business
+        on a remote accelerator.  Returns the shrunken per-slot leaf values
+        and rewrites the host tree in place."""
+        import functools
+        from .ops.renew import renew_leaf_values
+        dd = self._dd
+        weighted = dd.weight is not None or self.config.objective == "mape"
+        base_w = dd.weight if dd.weight is not None else self._ones
         if self.config.objective == "mape":
-            w = w / np.maximum(1.0, np.abs(label))
-        new_vals = np.zeros(self.config.num_leaves, dtype=np.float64)
-        for leaf in range(tree.num_leaves):
-            rows = (leaf_id == leaf) & (bag > 0)
-            if not rows.any():
-                new_vals[leaf] = tree.leaf_value[leaf] / lr
-                continue
-            new_vals[leaf] = _weighted_percentile(
-                residual[rows], w[rows] if weight is not None or
-                self.config.objective == "mape" else None, alpha)
-        tree.leaf_value = new_vals[:tree.num_leaves] * lr
-        return jnp.asarray((new_vals * lr).astype(np.float32))
+            # ref: MAPE label_weight_ = 1/max(1, |label|)
+            base_w = base_w / jnp.maximum(1.0, jnp.abs(dd.label))
+        key = (self.config.num_leaves, float(alpha), weighted)
+        if getattr(self, "_renew_key", None) != key:
+            self._renew_jit = jax.jit(functools.partial(
+                renew_leaf_values, num_leaves=key[0], alpha=key[1],
+                weighted=weighted))
+            self._renew_key = key
+        new_vals = self._renew_jit(dev.leaf_value,
+                                   dd.label - self._train_score,
+                                   base_w, sw, dev.leaf_id)
+        scaled = new_vals * lr
+        tree.leaf_value = np.asarray(jax.device_get(scaled),
+                                     dtype=np.float64)[:tree.num_leaves]
+        return scaled
 
     def _apply_tree_to_score(self, score, tree: Tree, dd: _DeviceData, k: int,
                              bias_included: bool, record=None):
@@ -619,19 +623,170 @@ class Booster:
         self.cur_iter -= 1
         return self
 
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Refit the existing model's leaf values on new data, keeping every
+        tree's structure (ref: basic.py `Booster.refit` → LGBM_BoosterRefit
+        → gbdt.cpp `GBDT::RefitTree` → serial_tree_learner.cpp
+        `SerialTreeLearner::FitByExistingTree`): route the new rows through
+        each tree, recompute leaf outputs from the new data's grad/hess via
+        the closed form, and blend `decay_rate*old + (1-decay_rate)*new`.
+        Trees are processed in boosting order with scores updated as it
+        goes, so later trees see the refit of earlier ones — exactly the
+        reference's loop.  Returns a NEW Booster."""
+        if self.objective_ is None:
+            raise LightGBMError("Cannot refit due to null objective function")
+        new_bst = Booster(model_str=self.model_to_string(num_iteration=-1),
+                          params={**{k: v for k, v in self.params.items()
+                                     if not callable(v)}, "verbosity": -1})
+        X = _to_2d_float(data)
+        y = np.asarray(label, dtype=np.float64).reshape(-1)
+        n = X.shape[0]
+        if len(y) != n:
+            raise LightGBMError("Length of label is not same with #data")
+        weight = kwargs.get("weight")
+        group = kwargs.get("group")
+        qb = None
+        if group is not None:
+            qb = np.concatenate([[0], np.cumsum(np.asarray(group,
+                                                           np.int64))])
+        obj = new_bst.objective_
+        obj.init_meta(y, np.asarray(weight, np.float64)
+                      if weight is not None else None, qb)
+        cfg = self.config
+        K = self.num_tree_per_iteration
+        lr = 1.0 if getattr(self, "_average_output", False) \
+            else cfg.learning_rate
+
+        def host_leaf_output(g, h):
+            # mirror ops/split.py leaf_output in f64
+            t = np.sign(g) * np.maximum(np.abs(g) - cfg.lambda_l1, 0.0)
+            denom = h + cfg.lambda_l2
+            out = np.where(denom > 0, -t / np.where(denom > 0, denom, 1.0),
+                           0.0)
+            if cfg.max_delta_step > 0:
+                out = np.clip(out, -cfg.max_delta_step, cfg.max_delta_step)
+            return out
+
+        label_j = jnp.asarray(y.astype(np.float32))
+        w_j = jnp.asarray(np.asarray(weight, np.float32)) \
+            if weight is not None else None
+        score = np.zeros(n if K == 1 else (n, K), np.float32)
+        is_rf = bool(getattr(self, "_average_output", False))
+        key0 = jax.random.PRNGKey(cfg.objective_seed % (2 ** 31))
+        for it in range(len(new_bst.trees) // K):
+            # RF gradients are taken at the constant base score, never the
+            # accumulated tree sum (ref: rf.hpp RF::Boosting)
+            grad_at = jnp.asarray(np.zeros_like(score) if is_rf else score)
+            if getattr(obj, "needs_rng", False):
+                g, h = obj.grad_hess(grad_at, label_j, w_j,
+                                     key=jax.random.fold_in(key0, it))
+            else:
+                g, h = obj.grad_hess(grad_at, label_j, w_j)
+            g = np.asarray(jax.device_get(g), np.float64)
+            h = np.asarray(jax.device_get(h), np.float64)
+            for k in range(K):
+                t = new_bst.trees[it * K + k]
+                gk = g if K == 1 else g[:, k]
+                hk = h if K == 1 else h[:, k]
+                li = t.predict_leaf_index(X)
+                nl = t.num_leaves
+                sg = np.bincount(li, weights=gk, minlength=nl)
+                sh = np.bincount(li, weights=hk, minlength=nl)
+                cnt = np.bincount(li, minlength=nl)
+                new_out = host_leaf_output(sg, sh) * lr
+                old = np.asarray(t.leaf_value, np.float64)
+                # leaves no new row reaches keep their old output
+                mixed = np.where(cnt > 0, decay_rate * old
+                                 + (1.0 - decay_rate) * new_out, old)
+                t.leaf_value = mixed
+                contrib = mixed[li].astype(np.float32)
+                if K == 1:
+                    score = score + contrib
+                else:
+                    score[:, k] += contrib
+        return new_bst
+
     # ------------------------------------------------- fused bulk training
     _BULK_CHUNK = 16
 
-    def _bulk_eligible(self) -> bool:
+    def _bulk_eligible(self, with_eval: bool = False) -> bool:
+        """Can training run as compiled device-side chunks?
+
+        DART is excluded by design: its per-iteration drop/renormalize
+        rescales ALREADY-DECODED host trees, which is inherently a host
+        round-trip (ref: dart.hpp `DART::Normalize`)."""
         cfg = self.config
-        return (self._fobj is None and self.objective_ is not None
-                and getattr(self, "_mesh", None) is None
-                and not getattr(self.objective_, "needs_rng", False)
-                and getattr(self.objective_, "renew_percentile", None) is None
-                and self._boost_mode == "gbdt"
-                and not self._valid_dd
-                and cfg.pos_bagging_fraction >= 1.0
-                and cfg.neg_bagging_fraction >= 1.0)
+        ok = (self._fobj is None and self.objective_ is not None
+              and getattr(self, "_mesh", None) is None
+              and self._boost_mode in ("gbdt", "rf")
+              and cfg.pos_bagging_fraction >= 1.0
+              and cfg.neg_bagging_fraction >= 1.0)
+        if not ok:
+            return False
+        if not with_eval and self._valid_dd:
+            return False
+        return True
+
+    def _make_bulk_spec(self, n_valid: int = 0, emit_train: bool = False):
+        from .ops.fused import BulkSpec
+        cfg = self.config
+        rp = getattr(self.objective_, "renew_percentile", None)
+        return BulkSpec(
+            grower=self._grower_spec, chunk=self._BULK_CHUNK,
+            num_class=self.num_tree_per_iteration,
+            learning_rate=cfg.learning_rate,
+            bagging_fraction=cfg.bagging_fraction,
+            bagging_freq=cfg.bagging_freq,
+            use_goss=self._use_goss
+            and cfg.top_rate + cfg.other_rate < 1.0,
+            top_rate=cfg.top_rate,
+            other_rate=cfg.other_rate,
+            goss_start_iter=int(1.0 / cfg.learning_rate),
+            feature_fraction=cfg.feature_fraction,
+            rf=self._boost_mode == "rf",
+            needs_rng=getattr(self.objective_, "needs_rng", False),
+            n_valid=n_valid, emit_train_scores=emit_train,
+            renew_alpha=float(rp) if rp is not None else -1.0,
+            renew_weighted=(self._dd.weight is not None
+                            or cfg.objective == "mape"))
+
+    def _bulk_trainer(self, spec):
+        from .ops.fused import make_bulk_trainer
+        if getattr(self, "_bulk_spec", None) != spec:
+            grad = self._grad_rng_fn if spec.needs_rng else self._grad_fn
+            renew_args = None
+            if spec.renew_alpha >= 0.0:
+                base_w = self._dd.weight if self._dd.weight is not None \
+                    else self._ones
+                if self.config.objective == "mape":
+                    base_w = base_w / jnp.maximum(1.0,
+                                                  jnp.abs(self._dd.label))
+                renew_args = (self._dd.label, base_w)
+            self._bulk_trainer_cache = make_bulk_trainer(spec, grad,
+                                                         renew_args)
+            self._bulk_spec = spec
+        return self._bulk_trainer_cache
+
+    def _run_chunk(self, spec):
+        """Run ONE compiled chunk; returns (finished, per-iter train scores
+        or None, per-valid list of per-iter scores)."""
+        trainer = self._bulk_trainer(spec)
+        dd = self._dd
+        valid_bins = tuple(v.bins_fm for v in self._valid_dd[:spec.n_valid])
+        score, vfinal, stacked, v_iter, t_iter = trainer(
+            self._train_score, tuple(self._valid_scores[:spec.n_valid]),
+            jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
+            self._grad_key0, dd.bins_fm, self._feat,
+            jnp.asarray(dd.base_allowed), valid_bins)
+        self._train_score = score
+        if spec.n_valid:
+            self._valid_scores[:spec.n_valid] = list(vfinal)
+        finished = self._decode_stacked(stacked)
+        t_np = np.asarray(jax.device_get(t_iter)) if spec.emit_train_scores \
+            else None
+        v_np = [np.asarray(jax.device_get(v)) for v in v_iter]
+        return finished, t_np, v_np
 
     def update_many(self, n_rounds: int) -> bool:
         """Run `n_rounds` boosting iterations, fusing them into compiled
@@ -641,48 +796,42 @@ class Booster:
         finished = False
         remaining = n_rounds
         if self._bulk_eligible() and remaining >= self._BULK_CHUNK:
-            from .ops.fused import BulkSpec, make_bulk_trainer
-            cfg = self.config
             self._boost_from_average()
-            spec = BulkSpec(
-                grower=self._grower_spec, chunk=self._BULK_CHUNK,
-                num_class=self.num_tree_per_iteration,
-                learning_rate=cfg.learning_rate,
-                bagging_fraction=cfg.bagging_fraction,
-                bagging_freq=cfg.bagging_freq,
-                use_goss=self._use_goss
-                and cfg.top_rate + cfg.other_rate < 1.0,
-                top_rate=cfg.top_rate,
-                other_rate=cfg.other_rate,
-                goss_start_iter=int(1.0 / cfg.learning_rate),
-                feature_fraction=cfg.feature_fraction)
-            trainer = self._bulk_trainer_cache = getattr(
-                self, "_bulk_trainer_cache", None)
-            if trainer is None or \
-                    getattr(self, "_bulk_spec", None) != spec:
-                trainer = make_bulk_trainer(spec, self._grad_fn)
-                self._bulk_trainer_cache = trainer
-                self._bulk_spec = spec
-            dd = self._dd
-            base = jnp.asarray(dd.base_allowed)
+            spec = self._make_bulk_spec()
             while remaining >= self._BULK_CHUNK:
-                score, stacked = trainer(
-                    self._train_score, jnp.int32(self.cur_iter),
-                    self._rng_key0, self._ff_key0, dd.bins_fm, self._feat,
-                    base)
-                self._train_score = score
-                finished = self._decode_stacked(stacked)
+                finished, _, _ = self._run_chunk(spec)
                 remaining -= self._BULK_CHUNK
         for _ in range(remaining):
             finished = self.update()
         return finished
+
+    def update_chunk_eval(self, want_train_scores: bool):
+        """One fused chunk WITH per-iteration train/valid score emission —
+        the engine evaluates metrics/callbacks from the emitted scores, so
+        eval-driven training (early stopping) syncs once per chunk.
+        Returns (finished, train_scores [C, ...] | None,
+        [valid_scores [C, ...]])."""
+        self._boost_from_average()
+        spec = self._make_bulk_spec(n_valid=len(self._valid_dd),
+                                    emit_train=want_train_scores)
+        return self._run_chunk(spec)
+
+    def eval_with_scores(self, score_np: np.ndarray, data, name: str,
+                         feval, it_count: int):
+        """Evaluate metrics on an emitted per-iteration score snapshot
+        (chunked-eval path; mirrors `_eval_score` + `_eval_one`)."""
+        s = np.asarray(score_np, dtype=np.float64)
+        if self._average_output and it_count > 0:
+            s = s / it_count
+        return self._eval_one(s, data, name, feval)
 
     def _decode_stacked(self, stacked) -> bool:
         """Decode a chunk of stacked device trees into host Tree objects —
         ONE device→host sync for the whole chunk."""
         host = jax.device_get(stacked)
         K = self.num_tree_per_iteration
-        lr = self.config.learning_rate
+        # RF trees carry no shrinkage (must match the in-chunk score math)
+        lr = 1.0 if self._boost_mode == "rf" else self.config.learning_rate
         chunk = host.n_splits.shape[0]
         all_const = True
         for c in range(chunk):
@@ -853,8 +1002,11 @@ class Booster:
         return s
 
     def eval_train(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        # ref: basic.py Booster.eval_train reports under _train_data_name
         return self._eval_one(self._eval_score(self._train_score),
-                              self.train_set, "training", feval)
+                              self.train_set,
+                              getattr(self, "_train_data_name", "training"),
+                              feval)
 
     def eval_valid(self, feval=None) -> List[Tuple[str, str, float, bool]]:
         out = []

@@ -153,8 +153,20 @@ def run(argv: List[str]) -> int:
         return 0
 
     if task == "refit":
-        raise LightGBMError("task=refit: use Booster.refit from Python "
-                            "(CLI refit lands with the refit milestone)")
+        # ref: application.cpp task=refit (input_model + data → output_model)
+        if not config.input_model:
+            raise LightGBMError("task=refit requires input_model=...")
+        if not config.data:
+            raise LightGBMError("task=refit requires data=...")
+        booster = Booster(model_file=config.input_model,
+                          params=dict(params))
+        X, y = load_data_file(config.data, config)
+        refit_bst = booster.refit(X, y,
+                                  decay_rate=config.refit_decay_rate)
+        out = config.output_model or "LightGBM_model.txt"
+        refit_bst.save_model(out)
+        log.info(f"Finished refit; model saved to {out}")
+        return 0
     raise LightGBMError(f"Unknown task: {task}")
 
 

@@ -95,7 +95,55 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.best_iteration = booster.current_iteration()
         return booster
 
-    for i in range(begin_iteration, end_iteration):
+    want_train_eval = _eval_train_requested(params)
+    # eval-driven training also fuses: the chunk trainer emits per-iteration
+    # train/valid score snapshots, metrics + callbacks run host-side from
+    # those, and the host syncs once per chunk instead of per iteration.
+    # before_iteration callbacks (reset_parameter) mutate the booster
+    # mid-chunk and force the per-iteration path.
+    chunk = booster._BULK_CHUNK
+    use_chunked = (not callbacks_before
+                   and booster._bulk_eligible(with_eval=True)
+                   and num_boost_round >= chunk)
+
+    def eval_at(i, t_scores, v_scores, j):
+        out = []
+        if want_train_eval:
+            out.extend(booster.eval_with_scores(
+                t_scores[j], booster.train_set,
+                getattr(booster, "_train_data_name", "training"),
+                feval, i + 1))
+        for vi, (name, ds) in enumerate(zip(booster.name_valid_sets,
+                                            booster.valid_sets)):
+            out.extend(booster.eval_with_scores(
+                v_scores[vi][j], ds, name, feval, i + 1))
+        return out
+
+    i = begin_iteration
+    stopped = False
+    while i < end_iteration and not stopped:
+        if use_chunked and end_iteration - i >= chunk:
+            _, t_scores, v_scores = booster.update_chunk_eval(want_train_eval)
+            try:
+                for j in range(chunk):
+                    evaluation_result_list = eval_at(i + j, t_scores,
+                                                     v_scores, j)
+                    for cb in callbacks_after:
+                        cb(callback_mod.CallbackEnv(
+                            model=booster, params=params, iteration=i + j,
+                            begin_iteration=begin_iteration,
+                            end_iteration=end_iteration,
+                            evaluation_result_list=evaluation_result_list))
+            except callback_mod.EarlyStopException as es:
+                booster.best_iteration = es.best_iteration + 1
+                evaluation_result_list = es.best_score
+                # the chunk overshot the stopping point — roll back to where
+                # per-iteration training would have stopped
+                while booster.current_iteration() > i + j + 1:
+                    booster.rollback_one_iter()
+                stopped = True
+            i += chunk
+            continue
         for cb in callbacks_before:
             cb(callback_mod.CallbackEnv(
                 model=booster, params=params, iteration=i,
@@ -104,8 +152,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         booster.update()
 
         evaluation_result_list = []
-        if booster.valid_sets or _eval_train_requested(params):
-            if _eval_train_requested(params):
+        if booster.valid_sets or want_train_eval:
+            if want_train_eval:
                 evaluation_result_list.extend(booster.eval_train(feval))
             evaluation_result_list.extend(booster.eval_valid(feval))
         try:
@@ -118,7 +166,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
         except callback_mod.EarlyStopException as es:
             booster.best_iteration = es.best_iteration + 1
             evaluation_result_list = es.best_score
-            break
+            stopped = True
+        i += 1
     booster.best_score = {}
     for item in evaluation_result_list:
         booster.best_score.setdefault(item[0], {})[item[1]] = item[2]

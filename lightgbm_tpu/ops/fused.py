@@ -91,21 +91,55 @@ class BulkSpec(NamedTuple):
     other_rate: float
     goss_start_iter: int
     feature_fraction: float
+    rf: bool = False          # RF mode: no shrinkage, grads at base score
+    needs_rng: bool = False   # objective draws per-iteration randomness
+    n_valid: int = 0          # valid sets scored inside the chunk
+    emit_train_scores: bool = False  # emit per-iteration train scores
+    renew_alpha: float = -1.0  # >=0: L1-family leaf percentile refit
+    renew_weighted: bool = False
 
 
-def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable):
+def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
     """Build the jitted chunk trainer.
 
-    grad_fn(score) -> (grad, hess), closed over label/weight device arrays
-    ([N] or [N, K] to match score).
+    grad_fn(score) -> (grad, hess) (or grad_fn(score, key) when
+    spec.needs_rng), closed over label/weight device arrays ([N] or [N, K]
+    to match score).
+
+    With `n_valid > 0` the chunk ALSO carries validation scores: each grown
+    tree is replayed over the valid bin matrices on device
+    (ops/predict.py `replay_leaf_ids`) and the post-iteration scores are
+    emitted per iteration, so `lgb.train` with eval/early-stopping syncs the
+    host once per chunk instead of once per iteration — the reference has no
+    counterpart (its per-iteration `ScoreUpdater::AddScore` on valid data is
+    cheap over PCIe, ruinous over a remote-TPU tunnel).
+
+    Returns train_chunk(score, vscores, it0, key0, ff_key0, grad_key0,
+    bins_fm, feat, base_allowed, valid_bins) ->
+    (final_score, final_vscores, stacked_trees,
+     per_iter_vscores, per_iter_tscores).
     """
+    from .predict import replay_leaf_ids
+
     grow = make_grower(spec.grower)
     K = spec.num_class
-    lr = spec.learning_rate
+    lr = 1.0 if spec.rf else spec.learning_rate
+    if spec.renew_alpha >= 0.0:
+        # L1/quantile/MAPE per-leaf percentile refit on device
+        # (ref: RenewTreeOutput; renew_args = (label [N], base weight [N]))
+        from .renew import renew_leaf_values
+        renew_label, renew_w = renew_args
 
-    def chunk_step(carry, it, *, bins_fm, feat, base_allowed, key0, ff_key0):
-        score = carry
-        grad, hess = grad_fn(score)
+    def chunk_step(carry, it, *, bins_fm, feat, base_allowed, key0, ff_key0,
+                   grad_key0, valid_bins):
+        score, vscores = carry
+        # RF trees are independent: gradients at the constant base score
+        # (ref: rf.hpp RF::Boosting)
+        grad_at = jnp.zeros_like(score) if spec.rf else score
+        if spec.needs_rng:
+            grad, hess = grad_fn(grad_at, jax.random.fold_in(grad_key0, it))
+        else:
+            grad, hess = grad_fn(grad_at)
         n = bins_fm.shape[1]
         if spec.use_goss:
             sw = goss_weights(it, key0, grad, hess, n,
@@ -120,6 +154,7 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable):
             sw = jnp.ones((n,), jnp.float32)
         trees = []
         new_score = score
+        new_vscores = list(vscores)
         for k in range(K):
             gk = grad if K == 1 else grad[:, k]
             hk = hess if K == 1 else hess[:, k]
@@ -127,23 +162,48 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable):
                                    feature_fraction=spec.feature_fraction)
             dev = grow(bins_fm, gk.astype(jnp.float32),
                        hk.astype(jnp.float32), sw, feat, allowed)
+            if spec.renew_alpha >= 0.0:
+                renewed = renew_leaf_values(
+                    dev.leaf_value, renew_label - score, renew_w, sw,
+                    dev.leaf_id, spec.grower.num_leaves,
+                    spec.renew_alpha, spec.renew_weighted)
+                # stump trees keep the closed-form output — the per-iteration
+                # path gates renew on num_leaves > 1 (ref: RenewTreeOutput
+                # is only invoked for trees that actually split)
+                dev = dev._replace(leaf_value=jnp.where(
+                    dev.n_splits > 0, renewed, dev.leaf_value))
             contrib = dev.leaf_value[dev.leaf_id] * lr
             if K == 1:
                 new_score = new_score + contrib
             else:
                 new_score = new_score.at[:, k].add(contrib)
+            for vi, vbins in enumerate(valid_bins):
+                vlid = replay_leaf_ids(dev, vbins, feat["nb"],
+                                       feat["missing"])
+                vcontrib = dev.leaf_value[vlid] * lr
+                if K == 1:
+                    new_vscores[vi] = new_vscores[vi] + vcontrib
+                else:
+                    new_vscores[vi] = new_vscores[vi].at[:, k].add(vcontrib)
             # leaf_id is per-row train state — not part of the model output
             trees.append(dev._replace(leaf_id=jnp.zeros((0,), jnp.int32)))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees) \
             if K > 1 else trees[0]
-        return new_score, stacked
+        t_emit = new_score if spec.emit_train_scores \
+            else jnp.zeros((0,), jnp.float32)
+        return (new_score, tuple(new_vscores)), \
+            (stacked, tuple(new_vscores), t_emit)
 
     @jax.jit
-    def train_chunk(score, it0, key0, ff_key0, bins_fm, feat, base_allowed):
+    def train_chunk(score, vscores, it0, key0, ff_key0, grad_key0,
+                    bins_fm, feat, base_allowed, valid_bins):
         step = functools.partial(
             chunk_step, bins_fm=bins_fm, feat=feat,
-            base_allowed=base_allowed, key0=key0, ff_key0=ff_key0)
+            base_allowed=base_allowed, key0=key0, ff_key0=ff_key0,
+            grad_key0=grad_key0, valid_bins=valid_bins)
         its = it0 + jnp.arange(spec.chunk)
-        return jax.lax.scan(step, score, its)
+        (fs, fvs), (stacked, v_iter, t_iter) = \
+            jax.lax.scan(step, (score, tuple(vscores)), its)
+        return fs, fvs, stacked, v_iter, t_iter
 
     return train_chunk

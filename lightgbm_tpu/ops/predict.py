@@ -61,6 +61,41 @@ def add_tree_score(score: Array, leaf_idx: Array, leaf_values: Array) -> Array:
     return score + leaf_values[leaf_idx]
 
 
+def replay_leaf_ids(tree, bins_fm: Array, feat_nb: Array,
+                    feat_missing: Array) -> Array:
+    """Route rows of a binned dataset through a DeviceTree by replaying its
+    recorded splits in growth order — no host Tree decode needed, so valid
+    sets can be scored INSIDE a compiled chunk (ref: ScoreUpdater::AddScore
+    on validation data, done per-iteration host-side in the reference).
+
+    Split i sends rows of leaf `split_leaf[i]` that go right to leaf slot
+    i+1 (the DeviceTree child encoding, see ops/grow.py `DeviceTree`).
+
+    Args:
+      tree: DeviceTree (leaf_id field unused).
+      bins_fm: [F, N] bin matrix of the rows to route (any dataset binned
+        with the same mappers).
+    Returns: [N] i32 leaf slots.
+    """
+    n = bins_fm.shape[1]
+    n_steps = tree.split_leaf.shape[0]
+
+    def body(lid, i):
+        f = tree.split_feature[i]
+        fbins = bins_fm[f].astype(jnp.int32)
+        is_nan = (feat_missing[f] == 2) & (fbins == feat_nb[f] - 1)
+        go_num = jnp.where(is_nan, tree.default_left[i],
+                           fbins <= tree.threshold_bin[i])
+        go_left = jnp.where(tree.split_is_cat[i],
+                            tree.split_cat_mask[i][fbins], go_num)
+        active = (lid == tree.split_leaf[i]) & (i < tree.n_splits)
+        return jnp.where(active & ~go_left, i + 1, lid), None
+
+    lid, _ = jax.lax.scan(body, jnp.zeros((n,), jnp.int32),
+                          jnp.arange(n_steps, dtype=jnp.int32))
+    return lid
+
+
 def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
                  node_left: Array, node_right: Array, leaf_value: Array,
                  X: Array) -> Array:

@@ -1,0 +1,93 @@
+"""Per-leaf percentile refit of leaf outputs, fully on device.
+
+TPU-native re-design of the reference's `RenewTreeOutput`
+(ref: regression_objective.hpp `RegressionL1loss::RenewTreeOutput` /
+`RegressionQuantileloss::RenewTreeOutput` with `PercentileFun` /
+`WeightedPercentileFun`; dispatched from serial_tree_learner.cpp).
+
+The reference loops leaves on the host and sorts each leaf's residuals
+(OpenMP per leaf).  Here ONE global sort by (leaf, residual) orders every
+leaf's segment at once; per-leaf percentiles come from vectorized gathers at
+segment offsets — no host loop, no dynamic shapes, so the refit can live
+inside the fused training chunk (ops/fused.py).
+
+Numerical parity: matches objectives._weighted_percentile bit-for-bit up to
+f32-vs-f64 accumulation (unweighted: interpolated `alpha*(cnt-1)` position;
+weighted: first index where `cumw - w/2 >= alpha * W`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def leaf_percentile(residual: Array, weight: Array, in_bag: Array,
+                    leaf_id: Array, num_leaves: int, alpha: float,
+                    weighted: bool) -> Array:
+    """Per-leaf (weighted) alpha-percentile of residuals.
+
+    Args:
+      residual: [N] f32 — label minus current score.
+      weight:   [N] f32 — row weights (dataset weight x objective weight);
+                ignored when `weighted` is False.
+      in_bag:   [N] bool — bagging/GOSS participation (weight > 0).
+      leaf_id:  [N] i32 — row→leaf assignment from the grower.
+      num_leaves: static leaf-slot count L.
+    Returns: ([L] f32 percentile values, [L] f32 in-bag counts) — empty
+      leaves get value 0 (caller keeps the grower's closed-form output).
+    """
+    n = residual.shape[0]
+    L = num_leaves
+    # out-of-bag rows are pushed to a sentinel segment L past every leaf
+    seg = jnp.where(in_bag, leaf_id, L)
+    # one global sort orders every leaf's residual segment at once
+    order = jnp.lexsort((residual, seg))
+    r_s = residual[order]
+    seg_s = seg[order]
+
+    ones = in_bag.astype(jnp.float32)
+    cnt = jax.ops.segment_sum(ones, seg, num_segments=L)          # [L]
+    start = jnp.cumsum(cnt) - cnt                                  # [L]
+    start_i = start.astype(jnp.int32)
+
+    if not weighted:
+        pos = alpha * jnp.maximum(cnt - 1.0, 0.0)
+        lo = jnp.floor(pos)
+        hi = jnp.minimum(lo + 1.0, jnp.maximum(cnt - 1.0, 0.0))
+        frac = (pos - lo).astype(jnp.float32)
+        v_lo = r_s[jnp.clip(start_i + lo.astype(jnp.int32), 0, n - 1)]
+        v_hi = r_s[jnp.clip(start_i + hi.astype(jnp.int32), 0, n - 1)]
+        val = v_lo * (1.0 - frac) + v_hi * frac
+        return jnp.where(cnt > 0, val, 0.0), cnt
+
+    w_eff = jnp.where(in_bag, weight, 0.0)
+    w_s = w_eff[order]
+    cumw = jnp.cumsum(w_s)
+    # cumw - w/2 is globally nondecreasing, so one searchsorted finds every
+    # leaf's crossing point: target_l = alpha*W_l + (total weight before l)
+    half = cumw - 0.5 * w_s
+    w_leaf = jax.ops.segment_sum(w_eff, seg, num_segments=L)       # [L]
+    w_before = jnp.cumsum(w_leaf) - w_leaf
+    target = alpha * w_leaf + w_before
+    idx = jnp.searchsorted(half, target)
+    end_i = start_i + jnp.maximum(cnt.astype(jnp.int32), 1) - 1
+    idx = jnp.clip(idx, start_i, end_i)
+    val = r_s[jnp.clip(idx, 0, n - 1)]
+    return jnp.where(cnt > 0, val, 0.0), cnt
+
+
+def renew_leaf_values(dev_leaf_value: Array, residual: Array, weight: Array,
+                      sample_weight: Array, leaf_id: Array, num_leaves: int,
+                      alpha: float, weighted: bool) -> Array:
+    """Replace grower leaf outputs with per-leaf residual percentiles
+    (pre-shrinkage), keeping the closed-form value for empty leaves —
+    exactly the reference's fallback (leaves every row of which was
+    sampled out keep their gradient-approximate output)."""
+    # weighted percentile weight = bagging/GOSS weight x row weight,
+    # mirroring booster._renew_tree_output's host contract (w = bag * weight)
+    val, cnt = leaf_percentile(residual, weight * sample_weight,
+                               sample_weight > 0, leaf_id, num_leaves,
+                               alpha, weighted)
+    return jnp.where(cnt > 0, val, dev_leaf_value)

@@ -100,6 +100,8 @@ class Dataset:
         self.bin_data: Optional[np.ndarray] = None  # [N, F] uint8/16, device or host
         self.bin_mappers: Optional[List[BinMapper]] = None
         self.num_total_bin: int = 0
+        self.efb = None                        # BundleSpec (utils/efb.py)
+        self.bundle_data: Optional[np.ndarray] = None  # [N, G] when bundled
         self._feature_names: Optional[List[str]] = None
         self._num_data: Optional[int] = None
         self._num_feature: Optional[int] = None
@@ -183,6 +185,24 @@ class Dataset:
 
         self.bin_data = self._apply_bins(raw, self.bin_mappers)
         self.num_total_bin = sum(m.num_bin for m in self.bin_mappers)
+        # EFB (ref: dataset.cpp FindGroups/FastFeatureBundling): valid sets
+        # inherit the training set's bundling so bin semantics line up
+        if self.reference is not None:
+            self.efb = getattr(self.reference, "efb", None)
+        elif cfg.enable_bundle:
+            from .utils.efb import find_bundles
+            self.efb = find_bundles(self.bin_data, self.bin_mappers,
+                                    cfg.max_conflict_rate,
+                                    cfg.data_random_seed)
+            if self.efb is not None:
+                log.info(f"EFB: bundled {self._num_feature} features into "
+                         f"{self.efb.n_cols} columns "
+                         f"({len(self.efb.bundles)} multi-feature bundles)")
+        if self.efb is not None and self.reference is None:
+            # valid sets (reference != None) are only traversed, never
+            # histogrammed — skip the O(N·G) bundled build for them
+            from .utils.efb import build_bundled
+            self.bundle_data = build_bundled(self.bin_data, self.efb)
         self._set_all_fields()
         self._handle_constructed = True
         if self.free_raw_data:
@@ -233,6 +253,9 @@ class Dataset:
         idx = np.asarray(self.used_indices, dtype=np.int64)
         self.bin_mappers = ref.bin_mappers
         self.bin_data = np.asarray(ref.bin_data)[idx]
+        self.efb = getattr(ref, "efb", None)
+        if self.efb is not None and ref.bundle_data is not None:
+            self.bundle_data = np.asarray(ref.bundle_data)[idx]
         self._categorical_indices = ref._categorical_indices
         self._feature_names = ref._feature_names
         self._num_data = len(idx)
@@ -418,6 +441,8 @@ class Dataset:
             else np.array([]),
             feature_names=json.dumps(self._feature_names),
             categorical=np.asarray(self._categorical_indices, dtype=np.int64),
+            efb=json.dumps(self.efb.to_dict()) if self.efb is not None
+            else "",
         )
 
     @classmethod
@@ -436,6 +461,10 @@ class Dataset:
             ds._weight_arr = z["weight"]
         if len(z["query"]):
             ds._query_boundaries = z["query"]
+        if "efb" in z and str(z["efb"]):
+            from .utils.efb import BundleSpec, build_bundled
+            ds.efb = BundleSpec.from_dict(json.loads(str(z["efb"])))
+            ds.bundle_data = build_bundled(ds.bin_data, ds.efb)
         ds._handle_constructed = True
         return ds
 
@@ -459,4 +488,6 @@ class Dataset:
             [i + self._num_feature for i in other._categorical_indices])
         self._num_feature += other._num_feature
         self.num_total_bin += other.num_total_bin
+        self.efb = None          # bundling no longer covers the new columns
+        self.bundle_data = None
         return self

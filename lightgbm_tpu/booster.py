@@ -43,11 +43,23 @@ __all__ = ["Booster"]
 class _DeviceData:
     """Device-resident view of a constructed Dataset."""
 
-    def __init__(self, ds: Dataset):
+    def __init__(self, ds: Dataset, for_train: bool = True):
         ds.construct()
         bins = np.asarray(ds.bin_data)
         self.num_data, self.num_feature = bins.shape
         self.bins_fm = jnp.asarray(np.ascontiguousarray(bins.T))  # [F, N]
+        # EFB: the grower trains on the bundled [G, N] matrix; the original
+        # [F, N] stays for tree traversal.  Valid sets are only traversed,
+        # so their bundled matrix is neither built nor uploaded.
+        self.efb = getattr(ds, "efb", None)
+        self.bundle_fm = None
+        if self.efb is not None and for_train:
+            bd = ds.bundle_data
+            if bd is None:  # e.g. train continuation on a referenced Dataset
+                from .utils.efb import build_bundled
+                bd = ds.bundle_data = build_bundled(bins, self.efb)
+            self.bundle_fm = jnp.asarray(
+                np.ascontiguousarray(np.asarray(bd).T))
         mappers = ds.bin_mappers
         self.feat_nb = jnp.asarray(
             np.array([m.num_bin for m in mappers], dtype=np.int32))
@@ -140,6 +152,28 @@ class Booster:
             raise TypeError("Need at least one training dataset or model "
                             "file or model string to create Booster instance")
 
+    # params accepted by the config layer but not (yet) acted on by this
+    # build — users must hear about it instead of silently losing the knob
+    # (ref: config.cpp Config::CheckParamConflict warns-and-corrects; an
+    # accepted-and-ignored param is a correctness trap).  Entries are
+    # removed as the features land.
+    _INERT_PARAMS = ("linear_tree", "use_quantized_grad", "extra_trees",
+                     "cegb_tradeoff", "cegb_penalty_split",
+                     "cegb_penalty_feature_lazy",
+                     "cegb_penalty_feature_coupled")
+
+    def _warn_inert_params(self) -> None:
+        from .utils.config import _PARAMS, canonical_param_name
+        seen = {canonical_param_name(k) for k in self.params}
+        for name in self._INERT_PARAMS:
+            if name not in seen:
+                continue
+            default = _PARAMS[name][0]
+            if getattr(self.config, name) != default:
+                log.warning(f"Parameter {name} is accepted but not yet "
+                            "implemented in lightgbm_tpu — it has NO effect "
+                            "on this run")
+
     # ------------------------------------------------------------- training
     def _init_train(self, train_set: Dataset) -> None:
         # objective may be passed as a callable in params (v4 custom-objective
@@ -150,6 +184,7 @@ class Booster:
             self._fobj = obj
             self.params["objective"] = "none"
         self.config = Config(self.params)
+        self._warn_inert_params()
         train_set.params = {**(train_set.params or {}), **{
             k: v for k, v in self.params.items()
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
@@ -213,6 +248,10 @@ class Booster:
             max_cat_threshold=self.config.max_cat_threshold,
             max_cat_to_onehot=self.config.max_cat_to_onehot,
             hist_impl=self._resolve_hist_impl(),
+            bundled=self._dd.efb is not None,
+            bundle_max_bin=self._dd.efb.max_bin
+            if self._dd.efb is not None else 0,
+            hist_pool_slots=self._hist_pool_slots(),
         )
         self._grower = make_grower(self._grower_spec)
         self._build_feat()
@@ -248,6 +287,21 @@ class Booster:
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
 
+    def _hist_pool_slots(self) -> int:
+        """Size the per-leaf histogram cache from `histogram_pool_size` MB
+        (ref: config.h histogram_pool_size → feature_histogram.hpp
+        `HistogramPool`).  0 = unbounded (one slot per leaf)."""
+        pool_mb = self.config.histogram_pool_size
+        if pool_mb is None or pool_mb <= 0:
+            return 0
+        efb = self._dd.efb
+        cols = efb.n_cols if efb is not None else self._dd.num_feature
+        bins = efb.max_bin if efb is not None else self._dd.max_bin
+        slot_bytes = max(cols * bins * 3 * 4, 1)
+        slots = int(pool_mb * 2 ** 20 // slot_bytes)
+        slots = max(2, slots)
+        return slots if slots < self.config.num_leaves else 0
+
     def _resolve_hist_impl(self) -> str:
         """Pick the histogram implementation: the Pallas kernel on real TPU
         backends (gated on a tiny compile-and-compare probe so a Mosaic
@@ -281,6 +335,12 @@ class Booster:
         self._feat = dict(nb=self._dd.feat_nb, missing=self._dd.feat_missing,
                           default=self._dd.feat_default,
                           is_cat=self._dd.is_cat, mono=jnp.asarray(mono))
+        if self._dd.efb is not None:
+            efb = self._dd.efb
+            self._feat.update(
+                bundle_col=jnp.asarray(efb.col_of_feature),
+                bundle_off=jnp.asarray(efb.off_of_feature),
+                bundle_identity=jnp.asarray(efb.identity))
 
     def _setup_tree_learner(self) -> None:
         """Resolve `tree_learner` (+ device count) into the grower used for
@@ -291,10 +351,14 @@ class Booster:
         see parallel/learner.py)."""
         from .parallel.learner import resolve_tree_learner
         cfg = self.config
-        kind = resolve_tree_learner(cfg.tree_learner or "serial")
+        kind = resolve_tree_learner(cfg.tree_learner or "serial",
+                                    bundled=self._dd.efb is not None)
+        # EFB: training reads the bundled matrix (see _DeviceData)
+        train_src = self._dd.bundle_fm if self._dd.efb is not None \
+            else self._dd.bins_fm
         if kind == "serial":
             self._mesh = None
-            self._train_bins = self._dd.bins_fm
+            self._train_bins = train_src
             self._learner_cache_key = None
             return
         try:
@@ -310,7 +374,7 @@ class Booster:
             log.warning(f"tree_learner={kind} requested but only one device "
                         "is visible; using the serial learner")
             self._mesh = None
-            self._train_bins = self._dd.bins_fm
+            self._train_bins = train_src
             self._learner_cache_key = None
             return
         # reset_parameter (lr schedules) calls this every iteration — reuse
@@ -323,7 +387,7 @@ class Booster:
             place_training_data
         self._mesh = get_mesh(shards)
         self._train_bins = place_training_data(
-            np.asarray(self._dd.bins_fm), self._mesh, kind)
+            np.asarray(train_src), self._mesh, kind)
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
             self._dd.num_feature, self._dd.num_data)
@@ -348,7 +412,7 @@ class Booster:
             pass  # constructed against the right reference below
         if data.reference is None:
             data.reference = self.train_set
-        dd = _DeviceData(data)
+        dd = _DeviceData(data, for_train=False)
         self.valid_sets.append(data)
         self.name_valid_sets.append(name)
         self._valid_dd.append(dd)
@@ -541,11 +605,7 @@ class Booster:
         import functools
         from .ops.renew import renew_leaf_values
         dd = self._dd
-        weighted = dd.weight is not None or self.config.objective == "mape"
-        base_w = dd.weight if dd.weight is not None else self._ones
-        if self.config.objective == "mape":
-            # ref: MAPE label_weight_ = 1/max(1, |label|)
-            base_w = base_w / jnp.maximum(1.0, jnp.abs(dd.label))
+        weighted, base_w = self._renew_base()
         key = (self.config.num_leaves, float(alpha), weighted)
         if getattr(self, "_renew_key", None) != key:
             self._renew_jit = jax.jit(functools.partial(
@@ -748,8 +808,20 @@ class Booster:
             needs_rng=getattr(self.objective_, "needs_rng", False),
             n_valid=n_valid, emit_train_scores=emit_train,
             renew_alpha=float(rp) if rp is not None else -1.0,
-            renew_weighted=(self._dd.weight is not None
-                            or cfg.objective == "mape"))
+            renew_weighted=self._renew_base()[0])
+
+    def _renew_base(self):
+        """(weighted, base row weight) for the L1-family percentile refit —
+        the single source of truth shared by the per-iteration path
+        (_renew_tree_output) and the fused chunk (_bulk_trainer)."""
+        weighted = self._dd.weight is not None \
+            or self.config.objective == "mape"
+        base_w = self._dd.weight if self._dd.weight is not None \
+            else self._ones
+        if self.config.objective == "mape":
+            # ref: MAPE label_weight_ = 1/max(1, |label|)
+            base_w = base_w / jnp.maximum(1.0, jnp.abs(self._dd.label))
+        return weighted, base_w
 
     def _bulk_trainer(self, spec):
         from .ops.fused import make_bulk_trainer
@@ -757,12 +829,7 @@ class Booster:
             grad = self._grad_rng_fn if spec.needs_rng else self._grad_fn
             renew_args = None
             if spec.renew_alpha >= 0.0:
-                base_w = self._dd.weight if self._dd.weight is not None \
-                    else self._ones
-                if self.config.objective == "mape":
-                    base_w = base_w / jnp.maximum(1.0,
-                                                  jnp.abs(self._dd.label))
-                renew_args = (self._dd.label, base_w)
+                renew_args = (self._dd.label, self._renew_base()[1])
             self._bulk_trainer_cache = make_bulk_trainer(spec, grad,
                                                          renew_args)
             self._bulk_spec = spec
@@ -777,7 +844,7 @@ class Booster:
         score, vfinal, stacked, v_iter, t_iter = trainer(
             self._train_score, tuple(self._valid_scores[:spec.n_valid]),
             jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
-            self._grad_key0, dd.bins_fm, self._feat,
+            self._grad_key0, self._train_bins, self._feat,
             jnp.asarray(dd.base_allowed), valid_bins)
         self._train_score = score
         if spec.n_valid:

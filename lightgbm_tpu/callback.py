@@ -53,6 +53,9 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
             log.info(f"[{env.iteration + 1}]\t{result}")
 
     _callback.order = 10  # type: ignore
+    # reads only env.evaluation_result_list/iteration — safe to drive from
+    # chunked-eval score snapshots (engine.py use_chunked gate)
+    _callback.chunk_safe = True  # type: ignore
     return _callback
 
 
@@ -78,6 +81,7 @@ def record_evaluation(eval_result: Dict[str, Dict[str, List[float]]]) -> Callabl
             eval_result[data_name][eval_name].append(result)
 
     _callback.order = 20  # type: ignore
+    _callback.chunk_safe = True  # type: ignore
     return _callback
 
 
@@ -208,4 +212,5 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             _final_iteration_check(env, eval_name_splitted, i)
 
     _callback.order = 30  # type: ignore
+    _callback.chunk_safe = True  # type: ignore
     return _callback

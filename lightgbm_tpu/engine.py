@@ -100,9 +100,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # train/valid score snapshots, metrics + callbacks run host-side from
     # those, and the host syncs once per chunk instead of per iteration.
     # before_iteration callbacks (reset_parameter) mutate the booster
-    # mid-chunk and force the per-iteration path.
+    # mid-chunk and force the per-iteration path; so do after-iteration
+    # callbacks not marked chunk_safe — a user callback inspecting
+    # env.model (e.g. per-iteration checkpointing) must see the model as of
+    # env.iteration, not the chunk's end state.
     chunk = booster._BULK_CHUNK
     use_chunked = (not callbacks_before
+                   and all(getattr(cb, "chunk_safe", False)
+                           for cb in callbacks_after)
                    and booster._bulk_eligible(with_eval=True)
                    and num_boost_round >= chunk)
 

@@ -62,6 +62,15 @@ class GrowerSpec(NamedTuple):
     max_cat_threshold: int = 32
     max_cat_to_onehot: int = 4
     hist_impl: str = "segment_sum"  # or "pallas" (ops/pallas_hist.py)
+    # EFB (ref: dataset.cpp FindGroups / feature_group.h): bins_fm holds
+    # BUNDLE columns [G, N]; histograms are built per bundle and expanded
+    # to the per-feature [F, MB] grid at split time (utils/efb.py)
+    bundled: bool = False
+    bundle_max_bin: int = 0
+    # bounded per-leaf histogram cache (ref: feature_histogram.hpp
+    # `HistogramPool` LRU, sized by histogram_pool_size MB); 0 = one slot
+    # per leaf (no eviction, no recompute — the fastest mode when it fits)
+    hist_pool_slots: int = 0
 
 
 class DeviceTree(NamedTuple):
@@ -167,20 +176,53 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                            spec.max_delta_step)
 
     block = axis_name is not None and mode in ("data_rs", "feature")
+    if spec.bundled and block:
+        raise ValueError("EFB bundling requires mode='data' for "
+                         "distributed growers (bundle columns do not align "
+                         "with per-feature blocks)")
+    # histogram bin-axis size: bundle columns can be wider than any single
+    # feature's bin count
+    HB = spec.bundle_max_bin if spec.bundled else spec.max_bin
 
-    def grow(bins_fm: Array,       # [F, N] uint8/16 feature-major
+    def grow(bins_fm: Array,       # [F, N] (or [G, N] bundled) feature-major
              grad: Array,          # [N] f32
              hess: Array,          # [N] f32
              sample_weight: Array,  # [N] f32 bagging/GOSS weights (0 = out)
              feat: Dict[str, Array],  # per-feature metadata pytree (above)
              allowed: Array,       # [F] bool (trivial/colsample masked out)
              ) -> DeviceTree:
-        F, N = bins_fm.shape
+        N = bins_fm.shape[1]
+        F = feat["nb"].shape[0]
         payload = jnp.stack([grad * sample_weight, hess * sample_weight,
                              sample_weight], axis=1)  # [N, 3]
         mono = feat.get("mono")
         if mono is None:
             mono = jnp.zeros((F,), jnp.int32)
+
+        if spec.bundled:
+            bcol = feat["bundle_col"]
+            boff = feat["bundle_off"]
+            bident = feat["bundle_identity"]
+            b_ar_mb = jnp.arange(MB, dtype=jnp.int32)
+            src_bins = boff[:, None] + b_ar_mb[None, :] - 1        # [F, MB]
+            valid_b = (b_ar_mb[None, :] >= 1) \
+                & (b_ar_mb[None, :] < feat["nb"][:, None])
+
+            def expand_bundled(histg, pg, ph, pc):
+                """[G, HB, 3] bundle histogram → per-feature [F, MB, 3]
+                view: member bins are a gather; the default bin 0 is
+                parent − Σ(nonzero bins) — the sparse-bin identity the
+                reference exploits the same way (dense_bin vs sparse_bin
+                zero handling)."""
+                gath = histg[bcol[:, None],
+                             jnp.clip(src_bins, 0, HB - 1)]        # [F,MB,3]
+                hist = jnp.where(valid_b[..., None], gath, 0.0)
+                rest = hist.sum(axis=1)                            # [F, 3]
+                parent = jnp.stack([pg, ph, pc]).astype(jnp.float32)
+                zero_row = jnp.where(bident[:, None],
+                                     histg[bcol, 0, :],
+                                     parent[None, :] - rest)
+                return hist.at[:, 0, :].set(zero_row)
 
         if block:
             # this shard owns feature block [offset, offset + Fb) for split
@@ -206,9 +248,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         def hist_of(mask_rows):
             if spec.hist_impl == "pallas":
                 from .pallas_hist import pallas_histogram
-                h = pallas_histogram(hist_bins, payload, mask_rows, MB)
+                h = pallas_histogram(hist_bins, payload, mask_rows, HB)
             else:
-                h = leaf_histogram(hist_bins, payload, mask_rows, MB)
+                h = leaf_histogram(hist_bins, payload, mask_rows, HB)
             if axis_name is not None:
                 if mode == "data":
                     h = jax.lax.psum(h, axis_name)
@@ -220,6 +262,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             return h
 
         def split_of(hist, g, h, c, node_allowed, lb, ub):
+            if spec.bundled:
+                hist = expand_bundled(hist, g, h, c)
             if block:
                 node_allowed = jax.lax.dynamic_slice_in_dim(
                     node_allowed, offset, Fb, axis=0)
@@ -248,7 +292,13 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         s0 = split_of(hist0, root_g, root_h, root_c, allowed,
                       jnp.float32(-INF), jnp.float32(INF))
 
-        hist = jnp.zeros((L,) + hist0.shape, dtype=jnp.float32)\
+        # per-leaf histogram storage: one slot per leaf by default, or a
+        # bounded LRU pool (ref: feature_histogram.hpp `HistogramPool`) —
+        # a pool miss recomputes the parent histogram from its rows, trading
+        # FLOPs for carry memory exactly like the reference's cache miss
+        pooled = 0 < spec.hist_pool_slots < L
+        P = max(2, spec.hist_pool_slots) if pooled else L
+        hist = jnp.zeros((P,) + hist0.shape, dtype=jnp.float32)\
             .at[0].set(hist0)
         leaf_best = [jnp.zeros((L,) + a.shape, dtype=a.dtype)
                      .at[0].set(a) for a in _split_to_arrays(s0)]
@@ -285,6 +335,11 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             leaf_ub=jnp.full((L,), INF, jnp.float32),
             leaf_depth=leaf_depth, nodes=nodes,
         )
+        if pooled:
+            # owner[p] = leaf whose histogram lives in slot p (-1 empty);
+            # used[p] = step of last touch (-1 sorts empty slots first)
+            state["owner"] = jnp.full((P,), -1, jnp.int32).at[0].set(0)
+            state["used"] = jnp.full((P,), -1, jnp.int32).at[0].set(0)
 
         def cond(st):
             return (st["step"] < L - 1) & (jnp.max(st["leaf_gain"]) > 0.0)
@@ -300,7 +355,17 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             node_mask = st["leaf_catmask"][best]
 
             # ---- partition: dense leaf_id update (no row movement) ----
-            fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)  # [N]
+            if spec.bundled:
+                # decode the split feature's original bin from its bundle
+                # column: off..off+nb-2 ↔ original bins 1..nb-1, else 0
+                col = feat["bundle_col"][f]
+                off = feat["bundle_off"][f]
+                raw_col = jnp.take(bins_fm, col, axis=0).astype(jnp.int32)
+                in_range = (raw_col >= off) & \
+                    (raw_col < off + feat["nb"][f] - 1)
+                fbins = jnp.where(in_range, raw_col - off + 1, 0)
+            else:
+                fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)
             is_nan_bin = (feat["missing"][f] == 2) & \
                 (fbins == feat["nb"][f] - 1)
             go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
@@ -345,11 +410,32 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             left_smaller = lc <= rc
             small_leaf = jnp.where(left_smaller, best, new)
             small_hist = hist_of(leaf_id == small_leaf)
-            parent_hist = st["hist"][best]
+            if pooled:
+                match = st["owner"] == best
+                hit = match.any()
+                pslot = jnp.argmax(match).astype(jnp.int32)
+                # pool miss → recompute the parent histogram from its rows
+                # (pre-split membership), the reference's cache-miss path
+                parent_hist = jax.lax.cond(
+                    hit, lambda _: st["hist"][pslot],
+                    lambda _: hist_of(in_leaf), None)
+            else:
+                parent_hist = st["hist"][best]
             large_hist = parent_hist - small_hist
             lhist = jnp.where(left_smaller, small_hist, large_hist)
             rhist = jnp.where(left_smaller, large_hist, small_hist)
-            hist = st["hist"].at[best].set(lhist).at[new].set(rhist)
+            if pooled:
+                # place both children, evicting least-recently-used slots
+                slot_l = jnp.where(hit, pslot,
+                                   jnp.argmin(st["used"]).astype(jnp.int32))
+                used1 = st["used"].at[slot_l].set(step + 1)
+                slot_r = jnp.argmin(used1).astype(jnp.int32)
+                hist = st["hist"].at[slot_l].set(lhist).at[slot_r].set(rhist)
+                pool_owner = st["owner"].at[slot_l].set(best)\
+                    .at[slot_r].set(new)
+                pool_used = used1.at[slot_r].set(step + 1)
+            else:
+                hist = st["hist"].at[best].set(lhist).at[new].set(rhist)
 
             # ---- find best splits for the two children ----
             depth = st["leaf_depth"][best] + 1
@@ -362,7 +448,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 return arr.at[best].set(a).at[new].set(b)
 
             la, ra = _split_to_arrays(ls), _split_to_arrays(rs)
+            extra = {"owner": pool_owner, "used": pool_used} if pooled else {}
             return dict(
+                **extra,
                 step=step + 1, nl=new + 1, leaf_id=leaf_id, hist=hist,
                 leaf_gain=put2(st["leaf_gain"], la[0], ra[0]),
                 leaf_feat=put2(st["leaf_feat"], la[1], ra[1]),

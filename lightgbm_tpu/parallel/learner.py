@@ -44,12 +44,18 @@ TREE_LEARNER_ALIASES = {
 }
 
 
-def resolve_tree_learner(name: str) -> str:
+def resolve_tree_learner(name: str, bundled: bool = False) -> str:
     """Canonicalize the tree_learner param (ref: config.cpp
-    `Config::GetTreeLearnerType`)."""
+    `Config::GetTreeLearnerType`).  With EFB bundling, feature-parallel is
+    downgraded to data-parallel HERE so data placement and grower padding
+    agree on the strategy (bundle columns don't align with feature blocks)."""
     kind = TREE_LEARNER_ALIASES.get(str(name).lower())
     if kind is None:
         raise ValueError(f"Unknown tree learner type {name}")
+    if bundled and kind == "feature":
+        log.warning("tree_learner=feature with EFB bundling falls back "
+                    "to the data-parallel strategy")
+        kind = "data"
     return kind
 
 
@@ -69,7 +75,16 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         log.warning("tree_learner=voting is served by the data-parallel "
                     "strategy on TPU (full histogram reduce-scatter rides "
                     "ICI; PV-Tree's traffic cut targets commodity ethernet)")
-    f_extra = padded_feature_count(num_feature, S) - num_feature
+    if spec.bundled:
+        # bundle columns don't align with per-feature blocks — use the
+        # full-histogram psum strategy (still row-sharded).  feature kind
+        # was already downgraded by resolve_tree_learner, so placement and
+        # padding agree.
+        assert kind != "feature", \
+            "feature kind must be downgraded before placement (EFB)"
+        mode = "data"
+    f_extra = (padded_feature_count(num_feature, S) - num_feature) \
+        if mode in ("data_rs", "feature") else 0
     n_extra = (padded_row_count(num_data, S) - num_data) \
         if mode != "feature" else 0
     grow = make_grower(spec, axis_name=axis, mode=mode, n_shards=S)

@@ -104,6 +104,7 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "data_random_seed": (1, "int", ("data_seed",)),
     "is_enable_sparse": (True, "bool", ("is_sparse", "enable_sparse", "sparse")),
     "enable_bundle": (True, "bool", ("is_enable_bundle", "bundle")),
+    "max_conflict_rate": (0.0, "float", ()),
     "use_missing": (True, "bool", ()),
     "zero_as_missing": (False, "bool", ()),
     "feature_pre_filter": (True, "bool", ()),

@@ -1,0 +1,174 @@
+"""Exclusive Feature Bundling (EFB).
+
+TPU-native re-design of the reference's feature bundling
+(ref: src/io/dataset.cpp `Dataset::FindGroups` [greedy conflict-bounded
+graph coloring over nonzero-row overlap] and `FastFeatureBundling`;
+include/LightGBM/feature_group.h `FeatureGroup` offset-bin storage).
+
+Mutually-(almost-)exclusive sparse features share ONE bundle column:
+bundle bin 0 means "every member at its default (zero) bin"; member j with
+original bin b in [1, nb_j) stores offset_j + b - 1.  Histogram work then
+scales with the bundle count G instead of the raw feature count F — the
+reference's key trick for Criteo-class one-hot data, and on TPU it also
+shrinks the [G, MB, 3] histogram grid and the [G, N] bin matrix in HBM.
+
+Differences from the reference, by design:
+ - conflict counting uses dense boolean row masks (numpy vector ops) on a
+   row sample instead of per-feature nonzero index lists;
+ - a bundle's total bin budget is capped at 255 so the bundled matrix
+   stays uint8 (the reference lets groups grow wider; we prefer more
+   bundles over a wider dtype — HBM bandwidth is the scarce resource);
+ - only features whose default (zero) bin is bin 0 are bundled — others
+   keep their own column (same effect as the reference's sparse-only rule).
+"""
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional
+
+import numpy as np
+
+MAX_BUNDLE_BINS = 255      # keep bundled columns uint8
+MAX_SEARCH_BUNDLES = 100   # ref: FindGroups max_search_group
+CONFLICT_SAMPLE_ROWS = 50_000
+
+
+class BundleSpec(NamedTuple):
+    """Static description of a bundling (shared train → valid/subset)."""
+    col_of_feature: np.ndarray   # [F] i32 — bundle column of each feature
+    off_of_feature: np.ndarray   # [F] i32 — bin offset inside the column
+    identity: np.ndarray         # [F] bool — feature is alone in its column
+    n_cols: int                  # G
+    col_num_bin: np.ndarray      # [G] i32 — bins per bundle column
+    bundles: tuple               # tuple of tuples of feature indices
+
+    @property
+    def max_bin(self) -> int:
+        return int(self.col_num_bin.max()) if self.n_cols else 1
+
+    def to_dict(self) -> dict:
+        return {"col_of_feature": self.col_of_feature.tolist(),
+                "off_of_feature": self.off_of_feature.tolist(),
+                "identity": self.identity.tolist(),
+                "n_cols": self.n_cols,
+                "col_num_bin": self.col_num_bin.tolist(),
+                "bundles": [list(b) for b in self.bundles]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "BundleSpec":
+        return cls(np.asarray(d["col_of_feature"], np.int32),
+                   np.asarray(d["off_of_feature"], np.int32),
+                   np.asarray(d["identity"], bool),
+                   int(d["n_cols"]),
+                   np.asarray(d["col_num_bin"], np.int32),
+                   tuple(tuple(b) for b in d["bundles"]))
+
+
+def find_bundles(bin_nf: np.ndarray, mappers, max_conflict_rate: float,
+                 seed: int = 0) -> Optional[BundleSpec]:
+    """Greedy conflict-bounded bundling (ref: Dataset::FindGroups).
+
+    Returns None when bundling would not reduce the column count.
+    """
+    n, f = bin_nf.shape
+    if f < 2:
+        return None
+    # row sample for conflict counting (the reference counts conflicts on
+    # its bin_construct sample as well)
+    if n > CONFLICT_SAMPLE_ROWS:
+        rng = np.random.RandomState(seed)
+        rows = np.sort(rng.choice(n, CONFLICT_SAMPLE_ROWS, replace=False))
+        sample = bin_nf[rows]
+    else:
+        sample = bin_nf
+    ns = sample.shape[0]
+    budget = int(max_conflict_rate * ns)
+
+    nb = np.array([m.num_bin for m in mappers], np.int64)
+    eligible = np.array(
+        [(m.default_bin == 0) and (not m.is_trivial) and m.num_bin >= 2
+         and m.num_bin <= MAX_BUNDLE_BINS for m in mappers])
+    nz = sample != 0                                   # [ns, F] nonzero mask
+    nz_cnt = nz.sum(axis=0)
+    # dense features cannot share a column under any reasonable budget —
+    # skip the search for them (cheap pre-filter, not in the reference)
+    eligible &= nz_cnt <= max(budget, int(0.5 * ns))
+
+    order = np.argsort(-nz_cnt)                        # most-used first
+    bundles: List[List[int]] = []
+    bundle_used: List[np.ndarray] = []                 # [ns] bool per bundle
+    bundle_conflicts: List[int] = []
+    bundle_bins: List[int] = []
+    singleton: List[int] = []
+    for j in order:
+        if not eligible[j]:
+            singleton.append(int(j))
+            continue
+        col = nz[:, j]
+        placed = False
+        for gi in range(min(len(bundles), MAX_SEARCH_BUNDLES)):
+            if bundle_bins[gi] + nb[j] - 1 > MAX_BUNDLE_BINS:
+                continue
+            cnt = int(np.count_nonzero(col & bundle_used[gi]))
+            if bundle_conflicts[gi] + cnt <= budget:
+                bundles[gi].append(int(j))
+                bundle_used[gi] |= col
+                bundle_conflicts[gi] += cnt
+                bundle_bins[gi] += int(nb[j]) - 1
+                placed = True
+                break
+        if not placed:
+            bundles.append([int(j)])
+            bundle_used.append(col.copy())
+            bundle_conflicts.append(0)
+            bundle_bins.append(1 + int(nb[j]) - 1)
+    # flatten single-member bundles into singletons
+    real_bundles = [b for b in bundles if len(b) > 1]
+    singleton += [b[0] for b in bundles if len(b) == 1]
+    if not real_bundles:
+        return None
+    G = len(real_bundles) + len(singleton)
+    if G >= f:
+        return None
+
+    col_of = np.zeros(f, np.int32)
+    off_of = np.zeros(f, np.int32)
+    identity = np.zeros(f, bool)
+    col_nb = np.zeros(G, np.int32)
+    gi = 0
+    for b in real_bundles:
+        off = 1
+        for j in sorted(b):
+            col_of[j] = gi
+            off_of[j] = off
+            off += int(nb[j]) - 1
+        col_nb[gi] = off
+        gi += 1
+    for j in sorted(singleton):
+        col_of[j] = gi
+        off_of[j] = 1          # identity map: bin b (>=1) stores as b
+        identity[j] = True
+        col_nb[gi] = int(nb[j])
+        gi += 1
+    return BundleSpec(col_of, off_of, identity, G, col_nb,
+                      tuple(tuple(sorted(b)) for b in real_bundles))
+
+
+def build_bundled(bin_nf: np.ndarray, spec: BundleSpec) -> np.ndarray:
+    """Produce the bundled [N, G] matrix (ref: FastFeatureBundling).
+
+    Conflicting rows (two members nonzero) keep the LAST member's value in
+    feature-index order — the reference similarly lets one value win.
+    """
+    n, f = bin_nf.shape
+    dtype = np.uint8 if spec.col_num_bin.max() <= 256 else np.uint16
+    out = np.zeros((n, spec.n_cols), dtype=dtype)
+    for j in range(f):
+        g = spec.col_of_feature[j]
+        col = bin_nf[:, j].astype(np.int64)
+        if spec.identity[j]:
+            out[:, g] = col.astype(dtype)
+        else:
+            nzr = col != 0
+            out[nzr, g] = (col[nzr] + spec.off_of_feature[j] - 1)\
+                .astype(dtype)
+    return out

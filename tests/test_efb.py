@@ -1,0 +1,141 @@
+"""EFB — Exclusive Feature Bundling (ref: dataset.cpp FindGroups /
+FastFeatureBundling).  Bundling must shrink the histogram column count on
+sparse one-hot data and train IDENTICAL models (structure + predictions) to
+the unbundled path."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.efb import build_bundled, find_bundles
+
+
+def make_onehot_data(n=3000, n_cat=40, n_dense=3, seed=0):
+    """n_cat-way one-hot (mutually exclusive) + a few dense columns."""
+    rng = np.random.RandomState(seed)
+    cat = rng.randint(0, n_cat, n)
+    X = np.zeros((n, n_cat + n_dense))
+    X[np.arange(n), cat] = 1.0
+    X[:, n_cat:] = rng.randn(n, n_dense)
+    y = ((cat % 3 == 0).astype(float) * 2.0 + X[:, n_cat]
+         + 0.3 * rng.randn(n) > 0.8).astype(np.float64)
+    return X, y
+
+
+class TestFindBundles:
+    def test_onehot_bundles_into_one_column(self):
+        X, y = make_onehot_data()
+        ds = lgb.Dataset(X, label=y, params={"enable_bundle": False})
+        ds.construct()
+        spec = find_bundles(np.asarray(ds.bin_data), ds.bin_mappers, 0.0)
+        assert spec is not None
+        # 40 mutually-exclusive one-hots collapse into one bundle column
+        assert spec.n_cols <= 1 + 3 + 1  # bundle + dense singletons
+        assert any(len(b) >= 30 for b in spec.bundles)
+        bundled = build_bundled(np.asarray(ds.bin_data), spec)
+        assert bundled.shape == (len(y), spec.n_cols)
+
+    def test_dense_data_returns_none(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(1000, 8)
+        ds = lgb.Dataset(X, label=(X[:, 0] > 0).astype(float),
+                         params={"enable_bundle": False})
+        ds.construct()
+        assert find_bundles(np.asarray(ds.bin_data), ds.bin_mappers,
+                            0.0) is None
+
+    def test_bundled_bins_roundtrip(self):
+        """Decoding bundle values must recover every original bin."""
+        X, y = make_onehot_data(n=800, n_cat=12)
+        ds = lgb.Dataset(X, label=y, params={"enable_bundle": False})
+        ds.construct()
+        bins = np.asarray(ds.bin_data)
+        spec = find_bundles(bins, ds.bin_mappers, 0.0)
+        bundled = build_bundled(bins, spec)
+        nb = np.array([m.num_bin for m in ds.bin_mappers])
+        for j in range(bins.shape[1]):
+            g, off = spec.col_of_feature[j], spec.off_of_feature[j]
+            raw = bundled[:, g].astype(np.int64)
+            in_range = (raw >= off) & (raw < off + nb[j] - 1)
+            dec = np.where(in_range, raw - off + 1, 0)
+            np.testing.assert_array_equal(dec, bins[:, j])
+
+
+class TestEFBTraining:
+    def test_training_parity_with_and_without_bundling(self):
+        X, y = make_onehot_data()
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                  "min_data_in_leaf": 20}
+        b_on = lgb.train({**params, "enable_bundle": True},
+                         lgb.Dataset(X, label=y), num_boost_round=10)
+        b_off = lgb.train({**params, "enable_bundle": False},
+                          lgb.Dataset(X, label=y), num_boost_round=10)
+        assert b_on.train_set.efb is not None, "bundling did not trigger"
+        for t_on, t_off in zip(b_on.trees, b_off.trees):
+            np.testing.assert_array_equal(
+                t_on.split_feature[:t_on.num_internal()],
+                t_off.split_feature[:t_off.num_internal()])
+            np.testing.assert_array_equal(
+                t_on.threshold_bin[:t_on.num_internal()],
+                t_off.threshold_bin[:t_off.num_internal()])
+        np.testing.assert_allclose(b_on.predict(X), b_off.predict(X),
+                                   rtol=2e-4, atol=2e-6)
+
+    def test_bundled_with_valid_and_early_stopping(self):
+        X, y = make_onehot_data(seed=2)
+        Xv, yv = make_onehot_data(n=800, seed=3)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "metric": "auc", "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=40,
+                        valid_sets=[lgb.Dataset(Xv, label=yv)],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert bst.best_iteration > 0
+        p = bst.predict(Xv)
+        auc_dir = np.mean(p[yv > 0]) > np.mean(p[yv == 0])
+        assert auc_dir
+
+    def test_bundled_distributed(self):
+        """EFB + tree_learner=data (falls back to full-psum strategy)."""
+        X, y = make_onehot_data(n=1600, seed=4)
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+        serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                           num_boost_round=5)
+        dist = lgb.train({**params, "tree_learner": "data"},
+                         lgb.Dataset(X, label=y), num_boost_round=5)
+        assert dist._mesh is not None
+        np.testing.assert_allclose(dist.predict(X, raw_score=True),
+                                   serial.predict(X, raw_score=True),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_bundled_feature_parallel_downgrades(self):
+        """EFB + tree_learner=feature falls back to data-parallel without
+        crashing on non-divisible row counts (regression: placement and
+        padding used to disagree on the strategy)."""
+        X, y = make_onehot_data(n=1501, seed=7)  # 1501 % 8 != 0
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "tree_learner": "feature", "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert bst.train_set.efb is not None
+        assert bst._mesh is not None
+        assert bst.num_trees() == 3
+
+    def test_save_load_binary_keeps_bundles(self, tmp_path):
+        X, y = make_onehot_data(n=600, seed=5)
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+        assert ds.efb is not None
+        p = str(tmp_path / "d.bin")
+        ds.save_binary(p)
+        ds2 = lgb.Dataset.load_binary(p)
+        assert ds2.efb is not None
+        assert ds2.efb.n_cols == ds.efb.n_cols
+        np.testing.assert_array_equal(ds2.bundle_data, ds.bundle_data)
+
+    def test_subset_inherits_bundles(self):
+        X, y = make_onehot_data(n=900, seed=6)
+        ds = lgb.Dataset(X, label=y)
+        ds.construct()
+        sub = ds.subset(np.arange(300))
+        sub.construct()
+        assert sub.efb is ds.efb
+        np.testing.assert_array_equal(np.asarray(sub.bundle_data),
+                                      np.asarray(ds.bundle_data)[:300])

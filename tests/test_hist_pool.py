@@ -1,0 +1,68 @@
+"""Bounded histogram pool (ref: feature_histogram.hpp `HistogramPool` LRU,
+sized by histogram_pool_size MB).  A pool miss recomputes the parent
+histogram, so pooled training must produce IDENTICAL trees to unpooled —
+only memory/compute trade differs."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=3000, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 - X[:, 1] + np.sin(X[:, 2] * 2) + 0.2 * rng.randn(n)
+    return X, y
+
+
+class TestHistogramPool:
+    def test_pooled_matches_unpooled(self):
+        X, y = make_data()
+        params = {"objective": "regression", "num_leaves": 31,
+                  "min_data_in_leaf": 10, "verbosity": -1}
+        base = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                         num_boost_round=8)
+        # tiny pool: 10 feats x ~64 bins x 3 x 4B ≈ 7.5KB/slot; 0.02 MB ≈
+        # 2-3 slots → constant eviction + recompute
+        pooled = lgb.train({**params, "histogram_pool_size": 0.02},
+                           lgb.Dataset(X, label=y), num_boost_round=8)
+        assert pooled._grower_spec.hist_pool_slots > 0, "pool not active"
+        assert pooled._grower_spec.hist_pool_slots < 31
+        for tb, tp in zip(base.trees, pooled.trees):
+            np.testing.assert_array_equal(
+                tb.split_feature[:tb.num_internal()],
+                tp.split_feature[:tp.num_internal()])
+            np.testing.assert_array_equal(
+                tb.threshold_bin[:tb.num_internal()],
+                tp.threshold_bin[:tp.num_internal()])
+        np.testing.assert_allclose(pooled.predict(X), base.predict(X),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_pool_slots_sizing(self):
+        X, y = make_data(500)
+        bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                         "histogram_pool_size": 0.05, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        s = bst._grower_spec.hist_pool_slots
+        assert 2 <= s < 63
+
+    def test_large_pool_disables_lru(self):
+        X, y = make_data(500)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "histogram_pool_size": 1024, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        assert bst._grower_spec.hist_pool_slots == 0  # fits → no pool
+
+    def test_epsilon_shaped_many_features(self):
+        """Wide data (Epsilon-shaped, scaled down) with a bounded pool:
+        the carry stays bounded and training still works."""
+        rng = np.random.RandomState(7)
+        n, f = 2000, 400
+        X = rng.randn(n, f)
+        y = X[:, :5].sum(axis=1) + 0.3 * rng.randn(n)
+        bst = lgb.train({"objective": "regression", "num_leaves": 63,
+                         "histogram_pool_size": 2.0, "max_bin": 63,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert 0 < bst._grower_spec.hist_pool_slots < 63
+        mse = float(np.mean((bst.predict(X) - y) ** 2))
+        assert mse < float(np.var(y))

@@ -1,0 +1,32 @@
+"""Accepted-but-inert params must warn, never silently no-op
+(ref: config.cpp Config::CheckParamConflict warns-and-corrects)."""
+import logging
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _train(params, caplog):
+    rng = np.random.RandomState(0)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(float)
+    with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+        lgb.train({"objective": "binary", "verbosity": 1, "num_leaves": 4,
+                   **params}, lgb.Dataset(X, label=y), num_boost_round=1)
+    return caplog.text
+
+
+def test_inert_param_warns(caplog):
+    text = _train({"linear_tree": True}, caplog)
+    assert "linear_tree" in text and "NO effect" in text
+
+
+def test_default_value_does_not_warn(caplog):
+    text = _train({"linear_tree": False}, caplog)
+    assert "NO effect" not in text
+
+
+def test_unset_param_does_not_warn(caplog):
+    text = _train({}, caplog)
+    assert "NO effect" not in text

@@ -5,12 +5,13 @@ JAX/XLA/Pallas compute path, `jax.sharding` data-parallel tree learning over
 ICI/DCN, with the LightGBM Python API reproduced verbatim
 (`Dataset` / `Booster` / `train` / `cv` / sklearn estimators).
 """
-from .basic import Dataset, LightGBMError  # noqa: F401
+from .basic import Dataset, LightGBMError, Sequence  # noqa: F401
 from .utils.log import register_logger  # noqa: F401
 
 __version__ = "0.1.0"
 
-__all__ = ["Dataset", "LightGBMError", "register_logger", "__version__"]
+__all__ = ["Dataset", "LightGBMError", "Sequence", "register_logger",
+           "__version__"]
 
 # Booster/engine/callback/sklearn land in later milestones of this round;
 # each import is made unconditional as soon as the module exists.

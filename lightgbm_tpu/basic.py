@@ -25,11 +25,57 @@ from .utils.binning import (BIN_TYPE_CATEGORICAL, BIN_TYPE_NUMERICAL, BinMapper,
 from .utils.config import Config
 from .utils.log import LightGBMError
 
-__all__ = ["Dataset", "LightGBMError"]
+__all__ = ["Dataset", "LightGBMError", "Sequence"]
+
+
+class Sequence:
+    """Generic random-access data source for two-pass ingest
+    (ref: python-package/lightgbm/basic.py `Sequence` — subclass with
+    `__len__` and `__getitem__` returning a row or a batch of rows).
+    `Dataset` accepts a Sequence or a list of Sequences as `data`."""
+
+    batch_size = 4096
+
+    def __len__(self) -> int:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _sequence_to_array(seqs) -> np.ndarray:
+    if isinstance(seqs, Sequence):
+        seqs = [seqs]
+    parts = []
+    for s in seqs:
+        n = len(s)
+        step = max(int(getattr(s, "batch_size", 4096)), 1)
+        for lo in range(0, n, step):
+            batch = np.asarray(s[slice(lo, min(lo + step, n))],
+                               dtype=np.float64)
+            if batch.ndim == 1:  # a single row
+                batch = batch.reshape(1, -1)
+            parts.append(batch)
+    if not parts:
+        raise LightGBMError("Cannot construct Dataset from empty Sequence")
+    return np.concatenate(parts, axis=0)
+
+
+def _is_sparse(data: Any) -> bool:
+    return hasattr(data, "tocsr") and hasattr(data, "toarray")
 
 
 def _to_2d_float(data: Any) -> np.ndarray:
-    """Coerce input matrix to 2D float64 numpy, handling pandas."""
+    """Coerce input matrix to 2D float64 numpy, handling pandas, scipy
+    sparse (ref: LGBM_DatasetCreateFromCSR/CSC — densified here; the
+    sparsity win comes from EFB bundling after binning, utils/efb.py), and
+    Sequence ingest."""
+    if isinstance(data, Sequence) or (
+            isinstance(data, list) and data
+            and isinstance(data[0], Sequence)):
+        return _sequence_to_array(data)
+    if _is_sparse(data):
+        return np.asarray(data.toarray(), dtype=np.float64)
     if hasattr(data, "values") and hasattr(data, "dtypes"):  # pandas DataFrame
         arr = data.to_numpy(dtype=np.float64, na_value=np.nan)
     else:

@@ -157,7 +157,7 @@ class Booster:
     # (ref: config.cpp Config::CheckParamConflict warns-and-corrects; an
     # accepted-and-ignored param is a correctness trap).  Entries are
     # removed as the features land.
-    _INERT_PARAMS = ("linear_tree", "use_quantized_grad", "extra_trees",
+    _INERT_PARAMS = ("linear_tree", "extra_trees",
                      "cegb_tradeoff", "cegb_penalty_split",
                      "cegb_penalty_feature_lazy",
                      "cegb_penalty_feature_coupled")
@@ -189,7 +189,8 @@ class Booster:
             k: v for k, v in self.params.items()
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
                      "use_missing", "zero_as_missing", "data_random_seed",
-                     "max_bin_by_feature", "feature_pre_filter")}}
+                     "max_bin_by_feature", "feature_pre_filter",
+                     "enable_bundle", "max_conflict_rate")}}
         self.train_set = train_set
         self._dd = _DeviceData(train_set)
         self.objective_: Optional[ObjectiveFunction] = \
@@ -233,6 +234,7 @@ class Booster:
             self.config.boost_from_average = False
         self._average_output = self._boost_mode == "rf"
 
+        self._ic_groups = self._parse_ic_groups()
         self._grower_spec = GrowerSpec(
             num_leaves=self.config.num_leaves,
             max_depth=self.config.max_depth,
@@ -252,15 +254,21 @@ class Booster:
             bundle_max_bin=self._dd.efb.max_bin
             if self._dd.efb is not None else 0,
             hist_pool_slots=self._hist_pool_slots(),
+            path_smooth=self.config.path_smooth,
+            feature_fraction_bynode=self.config.feature_fraction_bynode,
+            n_ic_groups=0 if self._ic_groups is None
+            else self._ic_groups.shape[0],
+            forced_splits=self._parse_forced_splits(),
+            num_features_hint=self._dd.num_feature,
         )
-        self._grower = make_grower(self._grower_spec)
-        self._build_feat()
-        self._setup_tree_learner()
-        self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
         self._ff_key0 = jax.random.PRNGKey(
             self.config.feature_fraction_seed % (2 ** 31))
+        self._grower = make_grower(self._grower_spec)
+        self._build_feat()
+        self._setup_tree_learner()
+        self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
 
         K = self.num_tree_per_iteration
         self._init_scores = [0.0] * K
@@ -286,6 +294,60 @@ class Booster:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
+
+    def _parse_ic_groups(self) -> Optional[np.ndarray]:
+        """Parse interaction_constraints into [K, F] group masks
+        (ref: config.h interaction_constraints "[0,1,2],[2,3]";
+        col_sampler.hpp filters per-branch)."""
+        raw = self.config.interaction_constraints
+        if raw is None or raw == "" or raw == []:
+            return None
+        if isinstance(raw, str):
+            try:
+                groups = json.loads(raw)
+            except json.JSONDecodeError:
+                groups = json.loads(f"[{raw}]")
+        else:
+            groups = [list(g) for g in raw]
+        F = self._dd.num_feature
+        mask = np.zeros((len(groups), F), dtype=bool)
+        for k, g in enumerate(groups):
+            for j in g:
+                if not 0 <= int(j) < F:
+                    raise LightGBMError(
+                        f"interaction_constraints feature index {j} out of "
+                        f"range [0, {F})")
+                mask[k, int(j)] = True
+        return mask
+
+    def _parse_forced_splits(self) -> tuple:
+        """Flatten the forced-splits JSON (ref: serial_tree_learner.cpp
+        `ForceSplits`; forcedsplits_filename nested
+        {feature, threshold, left, right}) into BFS-order
+        (leaf_slot, feature, threshold_bin) tuples matching the grower's
+        child encoding (right child of step s = leaf s+1)."""
+        fn = self.config.forcedsplits_filename
+        if not fn:
+            return ()
+        with open(fn) as f:
+            root = json.load(f)
+        if not root:
+            return ()
+        mappers = self.train_set.bin_mappers
+        out = []
+        queue = [(root, 0)]
+        while queue and len(out) < self.config.num_leaves - 1:
+            node, leaf = queue.pop(0)
+            j = int(node["feature"])
+            thr = float(node["threshold"])
+            b = mappers[j].value_to_bin(thr)
+            out.append((leaf, j, int(b)))
+            step = len(out) - 1
+            if node.get("left"):
+                queue.append((node["left"], leaf))
+            if node.get("right"):
+                queue.append((node["right"], step + 1))
+        return tuple(out)
 
     def _hist_pool_slots(self) -> int:
         """Size the per-leaf histogram cache from `histogram_pool_size` MB
@@ -341,6 +403,11 @@ class Booster:
                 bundle_col=jnp.asarray(efb.col_of_feature),
                 bundle_off=jnp.asarray(efb.off_of_feature),
                 bundle_identity=jnp.asarray(efb.identity))
+        if self._ic_groups is not None:
+            self._feat["ic_groups"] = jnp.asarray(self._ic_groups)
+        if self.config.feature_fraction_bynode < 1.0:
+            # per-tree key injected at grow time (__boost / chunk_step)
+            self._feat["ff_key"] = self._ff_key0
 
     def _setup_tree_learner(self) -> None:
         """Resolve `tree_learner` (+ device count) into the grower used for
@@ -542,6 +609,14 @@ class Booster:
         cfg = self.config
         K = self.num_tree_per_iteration
         it = self.cur_iter
+        if cfg.use_quantized_grad and cfg.num_grad_quant_bins > 0:
+            # ref: v4 quantized training (cuda_gradient_discretizer.cu);
+            # same key derivation as the fused chunk so paths agree
+            from .ops.fused import quantize_gradients
+            qkey = jax.random.fold_in(self._rng_key0, it * 2 + 1) \
+                if cfg.stochastic_rounding else None
+            grad, hess = quantize_gradients(grad, hess,
+                                            cfg.num_grad_quant_bins, qkey)
         if self._use_goss:
             sw = self._goss_weights(it, grad, hess)
         else:
@@ -554,9 +629,16 @@ class Booster:
             gk = grad if K == 1 else grad[:, k]
             hk = hess if K == 1 else hess[:, k]
             allowed = self._feature_mask(it, k)
+            feat = self._feat
+            if "ff_key" in feat:
+                # fresh per-node sampling stream for each tree
+                # (ref: ColSampler per-tree reseed); same derivation as
+                # ops/fused.py chunk_step
+                feat = {**feat, "ff_key": jax.random.fold_in(
+                    jax.random.fold_in(self._ff_key0, 2 ** 20 + it), k)}
             dev = self._grower(self._train_bins, gk.astype(jnp.float32),
                                hk.astype(jnp.float32), sw,
-                               self._feat, allowed)
+                               feat, allowed)
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
             if tree.num_leaves > 1:
                 all_const = False
@@ -808,7 +890,10 @@ class Booster:
             needs_rng=getattr(self.objective_, "needs_rng", False),
             n_valid=n_valid, emit_train_scores=emit_train,
             renew_alpha=float(rp) if rp is not None else -1.0,
-            renew_weighted=self._renew_base()[0])
+            renew_weighted=self._renew_base()[0],
+            quant_bins=cfg.num_grad_quant_bins
+            if cfg.use_quantized_grad else 0,
+            quant_stochastic=cfg.stochastic_rounding)
 
     def _renew_base(self):
         """(weighted, base row weight) for the L1-family percentile refit —
@@ -1124,8 +1209,44 @@ class Booster:
         if pred_contrib:
             return self._predict_contrib(X, trees)
         raw = np.zeros((n, K), dtype=np.float64)
-        for i, t in enumerate(trees):
-            raw[:, i % K] += t.predict(X)
+        # per-row prediction early stop (ref: prediction_early_stop.cpp —
+        # binary: 2|score| >= margin; multiclass: top1-top2 >= margin,
+        # checked every pred_early_stop_freq tree groups)
+        def _b(v):  # params reloaded from model text are strings
+            return str(v).lower() in ("true", "1") if isinstance(v, str) \
+                else bool(v)
+
+        es = _b(kwargs.get("pred_early_stop",
+                           self.params.get("pred_early_stop", False)))
+        obj_name = getattr(getattr(self, "config", None), "objective", "")
+        es = es and (obj_name == "binary" or K > 1)
+        if es and len(trees):
+            freq = int(kwargs.get(
+                "pred_early_stop_freq",
+                self.params.get("pred_early_stop_freq", 10)))
+            margin = float(kwargs.get(
+                "pred_early_stop_margin",
+                self.params.get("pred_early_stop_margin", 10.0)))
+            active = np.ones(n, dtype=bool)
+            all_active = True  # avoid masked copies until a row is decided
+            for i, t in enumerate(trees):
+                if all_active:
+                    raw[:, i % K] += t.predict(X)
+                else:
+                    if not active.any():
+                        break
+                    raw[active, i % K] += t.predict(X[active])
+                if (i + 1) % (max(freq, 1) * K) == 0:
+                    if K == 1:
+                        decided = 2.0 * np.abs(raw[:, 0]) >= margin
+                    else:
+                        part = np.partition(raw, K - 2, axis=1)
+                        decided = (part[:, K - 1] - part[:, K - 2]) >= margin
+                    active &= ~decided
+                    all_active = bool(active.all())
+        else:
+            for i, t in enumerate(trees):
+                raw[:, i % K] += t.predict(X)
         if getattr(self, "_average_output", False) and len(trees) >= K:
             raw /= max(len(trees) // K, 1)
         if K == 1:
@@ -1242,6 +1363,20 @@ class Booster:
             if ":" in tok:
                 k, v = tok.split(":")
                 obj_params[k] = v
+        # parameters section round-trips (ref: GBDT::SaveModelToString
+        # writes the config block; LoadModelFromString restores it) — this
+        # keeps save→load→save byte-stable
+        in_params = False
+        for ln in lines:
+            ln = ln.strip()
+            if ln == "parameters:":
+                in_params = True
+                continue
+            if ln == "end of parameters":
+                break
+            if in_params and ln.startswith("[") and ":" in ln:
+                k, v = ln[1:-1].split(":", 1)
+                self.params.setdefault(k.strip(), v.strip())
         params = dict(self.params)
         params["objective"] = obj_str[0] if obj_str else "regression"
         params.update(obj_params)

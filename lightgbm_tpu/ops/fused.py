@@ -65,6 +65,35 @@ def goss_weights(it, key0: Array, grad: Array, hess: Array, n: int, *,
     return jnp.where(it >= goss_start_iter, w, jnp.ones((n,), jnp.float32))
 
 
+def quantize_gradients(grad: Array, hess: Array, n_bins: int,
+                       key: Array = None):
+    """Gradient discretization (ref: cuda_gradient_discretizer.cu /
+    v4 quantized training `use_quantized_grad`): gradients snap to
+    `n_bins` signed levels, hessians to `n_bins` unsigned levels, with
+    optional stochastic rounding (key != None).
+
+    TPU note: the quantize→dequantize round trip reproduces the
+    reference's int-histogram MODEL semantics exactly (f32 sums of scaled
+    small ints are exact well past 2^24 rows/bin); the further int16
+    accumulation perf win belongs to the Pallas histogram kernel.
+    """
+    half = max(n_bins // 2, 1)
+    s_g = jnp.max(jnp.abs(grad)) / half
+    s_h = jnp.max(jnp.abs(hess)) / max(n_bins, 1)
+    s_g = jnp.where(s_g > 0, s_g, 1.0)
+    s_h = jnp.where(s_h > 0, s_h, 1.0)
+    vg = grad / s_g
+    vh = hess / s_h
+    if key is not None:
+        kg, kh = jax.random.split(key)
+        gq = jnp.floor(vg + jax.random.uniform(kg, grad.shape))
+        hq = jnp.floor(vh + jax.random.uniform(kh, hess.shape))
+    else:
+        gq = jnp.round(vg)
+        hq = jnp.round(vh)
+    return gq * s_g, hq * s_h
+
+
 def feature_mask(it, k: int, key0: Array, base_allowed: Array, *,
                  feature_fraction: float) -> Array:
     """Per-tree column mask (ref: col_sampler.hpp `ColSampler::ResetByTree`)."""
@@ -97,6 +126,8 @@ class BulkSpec(NamedTuple):
     emit_train_scores: bool = False  # emit per-iteration train scores
     renew_alpha: float = -1.0  # >=0: L1-family leaf percentile refit
     renew_weighted: bool = False
+    quant_bins: int = 0        # >0: gradient discretization levels
+    quant_stochastic: bool = True
 
 
 def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
@@ -140,6 +171,12 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
             grad, hess = grad_fn(grad_at, jax.random.fold_in(grad_key0, it))
         else:
             grad, hess = grad_fn(grad_at)
+        if spec.quant_bins:
+            # odd stream ids — bagging/GOSS use even fold_in ids on key0
+            qkey = jax.random.fold_in(key0, it * 2 + 1) \
+                if spec.quant_stochastic else None
+            grad, hess = quantize_gradients(grad, hess, spec.quant_bins,
+                                            qkey)
         n = bins_fm.shape[1]
         if spec.use_goss:
             sw = goss_weights(it, key0, grad, hess, n,
@@ -160,8 +197,13 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
             hk = hess if K == 1 else hess[:, k]
             allowed = feature_mask(it, k, ff_key0, base_allowed,
                                    feature_fraction=spec.feature_fraction)
+            tree_feat = feat
+            if spec.grower.feature_fraction_bynode < 1.0:
+                # same per-tree stream derivation as booster.__boost
+                tree_feat = {**feat, "ff_key": jax.random.fold_in(
+                    jax.random.fold_in(ff_key0, 2 ** 20 + it), k)}
             dev = grow(bins_fm, gk.astype(jnp.float32),
-                       hk.astype(jnp.float32), sw, feat, allowed)
+                       hk.astype(jnp.float32), sw, tree_feat, allowed)
             if spec.renew_alpha >= 0.0:
                 renewed = renew_leaf_values(
                     dev.leaf_value, renew_label - score, renew_w, sw,

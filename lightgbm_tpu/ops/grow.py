@@ -39,7 +39,8 @@ import jax
 import jax.numpy as jnp
 
 from .histogram import leaf_histogram
-from .split import NEG_INF, SplitResult, find_best_split, leaf_output
+from .split import NEG_INF, SplitResult, find_best_split, leaf_output, \
+    smooth_output
 
 Array = jax.Array
 
@@ -71,6 +72,21 @@ class GrowerSpec(NamedTuple):
     # `HistogramPool` LRU, sized by histogram_pool_size MB); 0 = one slot
     # per leaf (no eviction, no recompute — the fastest mode when it fits)
     hist_pool_slots: int = 0
+    # path smoothing strength (ref: feature_histogram.hpp USE_SMOOTHING)
+    path_smooth: float = 0.0
+    # per-node column sampling (ref: col_sampler.hpp `GetByNode`); the RNG
+    # key rides in feat["ff_key"]
+    feature_fraction_bynode: float = 1.0
+    # interaction constraints (ref: col_sampler.hpp interaction filtering):
+    # number of groups; the [K, F] group masks ride in feat["ic_groups"]
+    n_ic_groups: int = 0
+    # forced splits (ref: serial_tree_learner.cpp `ForceSplits`): BFS-order
+    # tuple of (leaf_slot, feature, threshold_bin) applied before best-gain
+    # growth
+    forced_splits: tuple = ()
+    # REAL feature count when the feat arrays are padded for distributed
+    # block modes (0 = no padding); keeps bynode sampling exact
+    num_features_hint: int = 0
 
 
 class DeviceTree(NamedTuple):
@@ -169,7 +185,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         max_delta_step=spec.max_delta_step,
         cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
         max_cat_threshold=spec.max_cat_threshold,
-        max_cat_to_onehot=spec.max_cat_to_onehot)
+        max_cat_to_onehot=spec.max_cat_to_onehot,
+        path_smooth=spec.path_smooth)
 
     def clamp_output(g, h):
         return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
@@ -237,7 +254,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             def bslice(x):
                 return jax.lax.dynamic_slice_in_dim(x, offset, Fb, axis=0)
 
-            bfeat = {k: bslice(v) for k, v in feat.items() if k != "mono"}
+            bfeat = {k: bslice(feat[k])
+                     for k in ("nb", "missing", "default", "is_cat")}
             bmono = bslice(mono)
             # feature mode histograms only this shard's columns (bins are
             # replicated); data_rs histograms all columns of its row shard
@@ -246,36 +264,78 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             bfeat, bmono, hist_bins = feat, mono, bins_fm
 
         def hist_of(mask_rows):
-            if spec.hist_impl == "pallas":
-                from .pallas_hist import pallas_histogram
-                h = pallas_histogram(hist_bins, payload, mask_rows, HB)
-            else:
-                h = leaf_histogram(hist_bins, payload, mask_rows, HB)
-            if axis_name is not None:
-                if mode == "data":
-                    h = jax.lax.psum(h, axis_name)
-                elif mode == "data_rs":
-                    # ref: Network::ReduceScatter of histogram buffers —
-                    # each shard receives the summed block it will scan
-                    h = jax.lax.psum_scatter(h, axis_name,
-                                             scatter_dimension=0, tiled=True)
+            # named scopes feed XProf/Perfetto timelines (SURVEY §5: the
+            # reference only has USE_TIMETAG chrono counters)
+            with jax.named_scope("histogram"):
+                if spec.hist_impl == "pallas":
+                    from .pallas_hist import pallas_histogram
+                    h = pallas_histogram(hist_bins, payload, mask_rows, HB)
+                else:
+                    h = leaf_histogram(hist_bins, payload, mask_rows, HB)
+                if axis_name is not None:
+                    if mode == "data":
+                        h = jax.lax.psum(h, axis_name)
+                    elif mode == "data_rs":
+                        # ref: Network::ReduceScatter of histogram buffers —
+                        # each shard receives the summed block it will scan
+                        h = jax.lax.psum_scatter(h, axis_name,
+                                                 scatter_dimension=0,
+                                                 tiled=True)
             return h
 
-        def split_of(hist, g, h, c, node_allowed, lb, ub):
+        def split_of(hist, g, h, c, node_allowed, lb, ub, p_out,
+                     cand_mask=None):
+            with jax.named_scope("find_split"):
+                return _split_of(hist, g, h, c, node_allowed, lb, ub,
+                                 p_out, cand_mask)
+
+        def _split_of(hist, g, h, c, node_allowed, lb, ub, p_out,
+                      cand_mask=None):
             if spec.bundled:
                 hist = expand_bundled(hist, g, h, c)
             if block:
                 node_allowed = jax.lax.dynamic_slice_in_dim(
                     node_allowed, offset, Fb, axis=0)
+                if cand_mask is not None:
+                    cand_mask = jax.lax.dynamic_slice_in_dim(
+                        cand_mask, offset, Fb, axis=0)
             s = find(hist, g, h, c, bfeat["nb"], bfeat["missing"],
                      bfeat["default"], node_allowed, bfeat["is_cat"],
-                     mono=bmono, out_lb=lb, out_ub=ub)
+                     mono=bmono, out_lb=lb, out_ub=ub,
+                     parent_output=p_out, cand_mask=cand_mask)
             if block:
                 s = s._replace(feature=jnp.where(s.feature >= 0,
                                                  s.feature + offset,
                                                  s.feature))
                 s = _merge_split_across_shards(s, axis_name, n_shards)
             return s
+
+        # per-node column sampling (ref: col_sampler.hpp GetByNode); node
+        # index derives the key so every node draws a fresh subset.  The
+        # permutation runs over the REAL feature count so padded dummy
+        # columns (distributed modes) don't dilute the sample.
+        if spec.feature_fraction_bynode < 1.0:
+            f_real = spec.num_features_hint or F
+            n_pick = max(1, int(spec.feature_fraction_bynode * f_real
+                                + 1e-9))
+
+            def bynode_mask(node_idx):
+                key = jax.random.fold_in(feat["ff_key"], node_idx)
+                perm = jax.random.permutation(key, f_real)
+                return jnp.zeros((F,), bool).at[perm[:n_pick]].set(True)
+        else:
+            def bynode_mask(node_idx):
+                return jnp.ones((F,), bool)
+
+        # forced splits (BFS order), applied before best-gain growth
+        n_forced = len(spec.forced_splits)
+        if n_forced:
+            forced_leaf = jnp.array([s[0] for s in spec.forced_splits],
+                                    jnp.int32)
+            forced_feat = jnp.array([s[1] for s in spec.forced_splits],
+                                    jnp.int32)
+            forced_bin = jnp.array([s[2] for s in spec.forced_splits],
+                                   jnp.int32)
 
         # ---- root ----
         root_mask = jnp.ones((N,), dtype=bool)
@@ -289,8 +349,13 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             root_g = jax.lax.psum(root_g, axis_name)
             root_h = jax.lax.psum(root_h, axis_name)
             root_c = jax.lax.psum(root_c, axis_name)
-        s0 = split_of(hist0, root_g, root_h, root_c, allowed,
-                      jnp.float32(-INF), jnp.float32(INF))
+        root_out = clamp_output(root_g, root_h)
+        if spec.n_ic_groups:
+            # only features inside some constraint group may ever split
+            allowed = allowed & jnp.any(feat["ic_groups"], axis=0)
+        s0 = split_of(hist0, root_g, root_h, root_c,
+                      allowed & bynode_mask(0),
+                      jnp.float32(-INF), jnp.float32(INF), root_out)
 
         # per-leaf histogram storage: one slot per leaf by default, or a
         # bounded LRU pool (ref: feature_histogram.hpp `HistogramPool`) —
@@ -333,6 +398,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             leaf_g=leaf_g, leaf_h=leaf_h, leaf_c=leaf_c,
             leaf_lb=jnp.full((L,), -INF, jnp.float32),
             leaf_ub=jnp.full((L,), INF, jnp.float32),
+            # each leaf's final (smoothed + clamped) output; children
+            # smooth toward their parent's entry (ref: USE_SMOOTHING)
+            leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
             leaf_depth=leaf_depth, nodes=nodes,
         )
         if pooled:
@@ -340,19 +408,92 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             # used[p] = step of last touch (-1 sorts empty slots first)
             state["owner"] = jnp.full((P,), -1, jnp.int32).at[0].set(0)
             state["used"] = jnp.full((P,), -1, jnp.int32).at[0].set(0)
+        if spec.n_ic_groups:
+            # features used on each leaf's root path (ref: col_sampler.hpp
+            # interaction-constraint filtering)
+            state["leaf_used"] = jnp.zeros((L, F), bool)
 
         def cond(st):
-            return (st["step"] < L - 1) & (jnp.max(st["leaf_gain"]) > 0.0)
+            go = (jnp.max(st["leaf_gain"]) > 0.0)
+            if n_forced:
+                # forced_n shrinks to `step` if a forced split proves
+                # infeasible — abandoning the rest of the forced prefix
+                go = go | (st["step"] < st["forced_n"])
+            return (st["step"] < L - 1) & go
 
         def body(st):
-            best = jnp.argmax(st["leaf_gain"]).astype(jnp.int32)
-            new = st["nl"]
             step = st["step"]
-            f = st["leaf_feat"][best]
-            t = st["leaf_thr"][best]
-            dl = st["leaf_dl"][best]
-            node_cat = st["leaf_iscat"][best]
-            node_mask = st["leaf_catmask"][best]
+            new = st["nl"]
+            free_best = jnp.argmax(st["leaf_gain"]).astype(jnp.int32)
+
+            def fetch_hist(leaf, leaf_mask):
+                """Parent histogram of `leaf` (pool miss → recompute from
+                its rows, the reference's cache-miss path)."""
+                if pooled:
+                    match = st["owner"] == leaf
+                    hit = match.any()
+                    pslot = jnp.argmax(match).astype(jnp.int32)
+                    ph = jax.lax.cond(
+                        hit, lambda _: st["hist"][pslot],
+                        lambda _: hist_of(leaf_mask), None)
+                    return ph, hit, pslot
+                return st["hist"][leaf], jnp.bool_(True), leaf
+
+            # ---- forced split (if any): evaluate the designated
+            # (feature, bin) on ITS leaf's histogram ----
+            if n_forced:
+                idx = jnp.clip(step, 0, n_forced - 1)
+                active_forced = step < st["forced_n"]
+
+                def eval_forced(_):
+                    fl = forced_leaf[idx]
+                    ph, _, _ = fetch_hist(fl, st["leaf_id"] == fl)
+                    cand = jnp.zeros((F, MB), bool)\
+                        .at[forced_feat[idx], forced_bin[idx]].set(True)
+                    # forced splits bypass column sampling (ref:
+                    # SerialTreeLearner::ForceSplits runs before the
+                    # ColSampler-gated search) — force-allow the feature
+                    fs = split_of(ph, st["leaf_g"][fl], st["leaf_h"][fl],
+                                  st["leaf_c"][fl],
+                                  allowed.at[forced_feat[idx]].set(True),
+                                  st["leaf_lb"][fl], st["leaf_ub"][fl],
+                                  st["leaf_out"][fl], cand_mask=cand)
+                    return _split_to_arrays(fs)
+
+                def no_forced(_):
+                    return (jnp.float32(NEG_INF), jnp.int32(-1),
+                            jnp.int32(0), jnp.bool_(False),
+                            jnp.float32(0), jnp.float32(0), jnp.float32(0),
+                            jnp.float32(0), jnp.float32(0), jnp.float32(0),
+                            jnp.bool_(False), jnp.zeros((MB,), bool))
+
+                fa = jax.lax.cond(active_forced, eval_forced, no_forced,
+                                  None)
+                forced_ok = active_forced & jnp.isfinite(fa[0])
+                best = jnp.where(forced_ok, forced_leaf[idx], free_best)
+                # infeasible forced split → abandon the remaining prefix
+                # (its BFS leaf numbering no longer matches the tree)
+                forced_n = jnp.where(active_forced & ~forced_ok,
+                                     step, st["forced_n"])
+            else:
+                best = free_best
+            in_leaf = st["leaf_id"] == best
+
+            parent_hist, hit, pslot = fetch_hist(best, in_leaf)
+
+            stored = (st["leaf_gain"][best], st["leaf_feat"][best],
+                      st["leaf_thr"][best], st["leaf_dl"][best],
+                      st["leaf_lg"][best], st["leaf_lh"][best],
+                      st["leaf_lc"][best], st["leaf_rg"][best],
+                      st["leaf_rh"][best], st["leaf_rc"][best],
+                      st["leaf_iscat"][best], st["leaf_catmask"][best])
+            if n_forced:
+                chosen = tuple(jnp.where(forced_ok, a, b)
+                               for a, b in zip(fa, stored))
+            else:
+                chosen = stored
+            (gain_s, f, t, dl, lg, lh, lc, rg, rh, rc, node_cat,
+             node_mask) = chosen
 
             # ---- partition: dense leaf_id update (no row movement) ----
             if spec.bundled:
@@ -370,7 +511,6 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 (fbins == feat["nb"][f] - 1)
             go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
             go_left = jnp.where(node_cat, node_mask[fbins], go_left_num)
-            in_leaf = st["leaf_id"] == best
             leaf_id = jnp.where(in_leaf & ~go_left, new, st["leaf_id"])
 
             # ---- record the internal node ----
@@ -382,45 +522,36 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 default_left=nodes["default_left"].at[step].set(dl),
                 split_is_cat=nodes["split_is_cat"].at[step].set(node_cat),
                 split_cat_mask=nodes["split_cat_mask"].at[step].set(node_mask),
-                split_gain=nodes["split_gain"].at[step].set(
-                    st["leaf_gain"][best]),
+                split_gain=nodes["split_gain"].at[step].set(gain_s),
                 internal_g=nodes["internal_g"].at[step].set(st["leaf_g"][best]),
                 internal_h=nodes["internal_h"].at[step].set(st["leaf_h"][best]),
                 internal_cnt=nodes["internal_cnt"].at[step].set(
                     st["leaf_c"][best]),
             )
 
-            lg, lh, lc = st["leaf_lg"][best], st["leaf_lh"][best], \
-                st["leaf_lc"][best]
-            rg, rh, rc = st["leaf_rg"][best], st["leaf_rh"][best], \
-                st["leaf_rc"][best]
-
-            # ---- monotone bounds for the children (ref: "basic" method) ----
+            # ---- child outputs: smoothing → monotone clamp ----
             lb, ub = st["leaf_lb"][best], st["leaf_ub"][best]
+            parent_out = st["leaf_out"][best]
             mc_f = jnp.where(node_cat, 0, mono[f])
-            l_out = jnp.clip(clamp_output(lg, lh), lb, ub)
-            r_out = jnp.clip(clamp_output(rg, rh), lb, ub)
+            l_sm = smooth_output(clamp_output(lg, lh), lc, parent_out,
+                                 spec.path_smooth)
+            r_sm = smooth_output(clamp_output(rg, rh), rc, parent_out,
+                                 spec.path_smooth)
+            l_out = jnp.clip(l_sm, lb, ub)
+            r_out = jnp.clip(r_sm, lb, ub)
             mid = 0.5 * (l_out + r_out)
             l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
             r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
             l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
             r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
+            # the children's own (final) outputs, clamped to THEIR bounds
+            l_fin = jnp.clip(l_sm, l_lb, l_ub)
+            r_fin = jnp.clip(r_sm, r_lb, r_ub)
 
             # ---- histogram: smaller child scanned, larger by subtraction ----
             left_smaller = lc <= rc
             small_leaf = jnp.where(left_smaller, best, new)
             small_hist = hist_of(leaf_id == small_leaf)
-            if pooled:
-                match = st["owner"] == best
-                hit = match.any()
-                pslot = jnp.argmax(match).astype(jnp.int32)
-                # pool miss → recompute the parent histogram from its rows
-                # (pre-split membership), the reference's cache-miss path
-                parent_hist = jax.lax.cond(
-                    hit, lambda _: st["hist"][pslot],
-                    lambda _: hist_of(in_leaf), None)
-            else:
-                parent_hist = st["hist"][best]
             large_hist = parent_hist - small_hist
             lhist = jnp.where(left_smaller, small_hist, large_hist)
             rhist = jnp.where(left_smaller, large_hist, small_hist)
@@ -441,17 +572,32 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             depth = st["leaf_depth"][best] + 1
             deep_ok = (spec.max_depth <= 0) | (depth < spec.max_depth)
             child_allowed = allowed & deep_ok
-            ls = split_of(lhist, lg, lh, lc, child_allowed, l_lb, l_ub)
-            rs = split_of(rhist, rg, rh, rc, child_allowed, r_lb, r_ub)
+            extra = {"owner": pool_owner, "used": pool_used} if pooled else {}
+            if spec.n_ic_groups:
+                # both children share the path's used-feature set; allowed =
+                # union of constraint groups that contain the whole path
+                child_used = st["leaf_used"][best].at[f].set(True)
+                groups = feat["ic_groups"]
+                ok_k = ~jnp.any(child_used[None, :] & ~groups, axis=1)
+                child_allowed = child_allowed & \
+                    jnp.any(groups & ok_k[:, None], axis=0)
+                extra["leaf_used"] = st["leaf_used"].at[best]\
+                    .set(child_used).at[new].set(child_used)
+            ls = split_of(lhist, lg, lh, lc,
+                          child_allowed & bynode_mask(2 * step + 1),
+                          l_lb, l_ub, l_fin)
+            rs = split_of(rhist, rg, rh, rc,
+                          child_allowed & bynode_mask(2 * step + 2),
+                          r_lb, r_ub, r_fin)
 
             def put2(arr, a, b):
                 return arr.at[best].set(a).at[new].set(b)
 
             la, ra = _split_to_arrays(ls), _split_to_arrays(rs)
-            extra = {"owner": pool_owner, "used": pool_used} if pooled else {}
-            return dict(
+            new_state = dict(
                 **extra,
                 step=step + 1, nl=new + 1, leaf_id=leaf_id, hist=hist,
+                leaf_out=put2(st["leaf_out"], l_fin, r_fin),
                 leaf_gain=put2(st["leaf_gain"], la[0], ra[0]),
                 leaf_feat=put2(st["leaf_feat"], la[1], ra[1]),
                 leaf_thr=put2(st["leaf_thr"], la[2], ra[2]),
@@ -472,19 +618,30 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 leaf_depth=put2(st["leaf_depth"], depth, depth),
                 nodes=nodes,
             )
+            if n_forced:
+                # if neither the forced split nor the free best is
+                # applicable (both infeasible), keep the state untouched —
+                # the shrunken forced_n makes cond() exit the loop
+                new_state["forced_n"] = forced_n
+                apply_ok = forced_ok | (gain_s > 0.0)
+                fallback = {**st, "forced_n": forced_n}
+                new_state = jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(apply_ok, a, b),
+                    new_state, fallback)
+            return new_state
 
+        if n_forced:
+            state["forced_n"] = jnp.int32(n_forced)
         st = jax.lax.while_loop(cond, body, state)
 
         n_splits = st["step"]
-        # leaf outputs from final per-leaf sums (slots >= nl are zeroed),
-        # clamped to the monotone bounds accumulated on the way down
+        # each leaf's final output was fixed at its creation (smoothing +
+        # monotone clamp applied there); slots >= nl stay zero
         slot = jnp.arange(L)
         active = slot < st["nl"]
-        values = jnp.clip(clamp_output(st["leaf_g"], st["leaf_h"]),
-                          st["leaf_lb"], st["leaf_ub"])
         # single-leaf tree predicts 0 (ref: GBDT logs "no more leaves that
         # meet the split requirements" and the tree contributes nothing)
-        values = jnp.where(active & (st["nl"] > 1), values, 0.0)
+        values = jnp.where(active & (st["nl"] > 1), st["leaf_out"], 0.0)
 
         return DeviceTree(
             n_splits=n_splits,

@@ -40,12 +40,18 @@ def leaf_histogram(bins_fm: Array, payload: Array, row_mask: Array,
     Returns: [F, MB, 3] float32.
     """
     d = jnp.where(row_mask[:, None], payload, 0.0)
+    cols = bins_fm.astype(jnp.int32)
 
-    def per_feature(col: Array) -> Array:
-        return jax.ops.segment_sum(d, col.astype(jnp.int32),
-                                   num_segments=max_bin)
+    # one segment-sum sweep per channel, channels unrolled in PYTHON: any
+    # batched-channel formulation makes XLA place the 3-sized channel dim
+    # minor-most in the broadcast operand, where TPU tiled layout pads it
+    # to 128 lanes — a 40x HBM blow-up ([F, N, 3] -> [F, N, 128])
+    def per_channel(vals: Array) -> Array:           # vals [N]
+        def per_feature(col: Array) -> Array:
+            return jax.ops.segment_sum(vals, col, num_segments=max_bin)
+        return jax.vmap(per_feature)(cols)           # [F, MB]
 
-    return jax.vmap(per_feature)(bins_fm)
+    return jnp.stack([per_channel(d[:, c]) for c in range(3)], axis=-1)
 
 
 def root_histogram(bins_fm: Array, payload: Array, max_bin: int) -> Array:

@@ -43,11 +43,19 @@ ROW_TILE = 2048
 LO = 16  # hilo decomposition: bin = LO*hi + lo
 
 
+# the payload side must NOT be truncated to bf16 by the MXU (histogram
+# sums need full f32 — the reference even uses f64 accumulators); Mosaic
+# rejects per-operand precision, so HIGHEST applies to both (the one-hot
+# side is exact in any precision anyway)
+_PREC = jax.lax.Precision.HIGHEST
+
+
 def _hist_kernel(bins_ref, p3_ref, out_ref, *, mb: int, impl: str):
     """One (feature-block x row-tile) grid cell.
 
     bins_ref: [F_t, N_t] uint8; p3_ref: [3, N_t] f32 (pre-masked);
-    out_ref:  [F_t, 3, MB] f32 accumulator (revisited across row tiles).
+    out_ref:  [F_t, 3, MB] f32 ("onehot") or [F_t, 3, MB//LO, LO] ("hilo")
+    accumulator, revisited across row tiles.
     """
     r = pl.program_id(1)  # row-tile index (fast axis)
 
@@ -66,7 +74,7 @@ def _hist_kernel(bins_ref, p3_ref, out_ref, *, mb: int, impl: str):
             # [3, N_t] @ [N_t, MB] -> [3, MB]
             out_ref[f] += jax.lax.dot_general(
                 p3, onehot, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
+                preferred_element_type=jnp.float32, precision=_PREC)
     else:  # hilo
         hi_n = mb // LO
         lo_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, LO), 1)
@@ -75,12 +83,15 @@ def _hist_kernel(bins_ref, p3_ref, out_ref, *, mb: int, impl: str):
             b = bins_ref[f, :].astype(jnp.int32)     # [N_t]
             oh_lo = ((b % LO)[:, None] == lo_ids).astype(jnp.float32)
             oh_hi = ((b // LO)[None, :] == hi_ids).astype(jnp.float32)
-            # A[c, hi, n] = p3[c, n] * oh_hi[hi, n]
-            a = (p3[:, None, :] * oh_hi[None, :, :]).reshape(3 * hi_n, n_t)
-            part = jax.lax.dot_general(               # [3*hi_n, LO]
-                a, oh_lo, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32)
-            out_ref[f] += part.reshape(3, hi_n * LO)
+            # per channel: A[hi, n] = p3[c, n] * oh_hi[hi, n];
+            # A @ oh_lo -> [hi_n, LO], written WITHOUT any vector reshape
+            # (Mosaic rejects (3*hi_n, LO) -> (3, mb) register reshapes)
+            for c in range(3):
+                a = oh_hi * p3[c][None, :]            # [hi_n, N_t]
+                part = jax.lax.dot_general(           # [hi_n, LO]
+                    a, oh_lo, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32, precision=_PREC)
+                out_ref[f, c] += part
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "impl", "row_tile",
@@ -119,6 +130,17 @@ def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
     n_rt = (n + n_pad) // row_tile
     n_ft = (f + f_pad) // feat_tile
 
+    if impl == "hilo":
+        # 4-D accumulator [F, 3, MB//LO, LO]; collapsed to [F, 3, MB] by
+        # XLA after the kernel (free), so Mosaic never reshapes registers
+        hi_n = mb // LO
+        out_specs = pl.BlockSpec((feat_tile, 3, hi_n, LO),
+                                 lambda j, r: (j, 0, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((f + f_pad, 3, hi_n, LO),
+                                         jnp.float32)
+    else:
+        out_specs = pl.BlockSpec((feat_tile, 3, mb), lambda j, r: (j, 0, 0))
+        out_shape = jax.ShapeDtypeStruct((f + f_pad, 3, mb), jnp.float32)
     out = pl.pallas_call(
         functools.partial(_hist_kernel, mb=mb, impl=impl),
         grid=(n_ft, n_rt),  # row tiles iterate fastest -> out revisited
@@ -127,10 +149,12 @@ def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
                          lambda j, r: (j, r)),
             pl.BlockSpec((3, row_tile), lambda j, r: (0, r)),
         ],
-        out_specs=pl.BlockSpec((feat_tile, 3, mb), lambda j, r: (j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f + f_pad, 3, mb), jnp.float32),
+        out_specs=out_specs,
+        out_shape=out_shape,
         interpret=interpret,
     )(bins_fm, p3)
+    if impl == "hilo":
+        out = out.reshape(f + f_pad, 3, mb)
     return out[:f].transpose(0, 2, 1)  # [F, MB, 3]
 
 

@@ -81,6 +81,17 @@ def leaf_output(g: Array, h: Array, l1: float, l2: float,
     return out
 
 
+def smooth_output(out: Array, cnt: Array, parent_out: Array,
+                  path_smooth: float) -> Array:
+    """Path smoothing: shrink a node's output toward its parent's
+    (ref: feature_histogram.hpp `CalculateSplittedLeafOutput` under
+    USE_SMOOTHING — `w * n/(n+λ_path) + w_parent * λ_path/(n+λ_path)`)."""
+    if path_smooth <= 0.0:
+        return out
+    frac = cnt / (cnt + path_smooth)
+    return out * frac + parent_out * (1.0 - frac)
+
+
 def find_best_split(hist: Array,
                     parent_g: Array, parent_h: Array, parent_c: Array,
                     feat_nb: Array, feat_missing: Array, feat_default: Array,
@@ -92,7 +103,10 @@ def find_best_split(hist: Array,
                     max_cat_threshold: int, max_cat_to_onehot: int,
                     max_delta_step: float = 0.0,
                     mono: Array = None, out_lb: Array = None,
-                    out_ub: Array = None) -> SplitResult:
+                    out_ub: Array = None,
+                    path_smooth: float = 0.0,
+                    parent_output: Array = None,
+                    cand_mask: Array = None) -> SplitResult:
     """Best split over all features of one leaf (numerical + categorical).
 
     `mono` [F] in {-1, 0, +1} plus scalar leaf output bounds [out_lb, out_ub]
@@ -104,6 +118,10 @@ def find_best_split(hist: Array,
     given-output form `-(2·ThresholdL1(g)·w + (h+λ₂)·w²)` — which equals the
     closed form when no clamping binds, so unconstrained training is
     bit-identical to passing mono=0.
+
+    `path_smooth` > 0 shrinks candidate child outputs toward
+    `parent_output` (ref: USE_SMOOTHING paths in feature_histogram.hpp).
+    `cand_mask` [F, MB] restricts the candidate grid (forced splits).
     """
     F, MB, _ = hist.shape
     bin_ar = jnp.arange(MB, dtype=jnp.int32)
@@ -116,6 +134,7 @@ def find_best_split(hist: Array,
         mono = jnp.zeros((F,), jnp.int32)
     lb = jnp.float32(-jnp.inf) if out_lb is None else out_lb
     ub = jnp.float32(jnp.inf) if out_ub is None else out_ub
+    p_out = jnp.float32(0.0) if parent_output is None else parent_output
 
     def constraints_ok(left, right):
         return ((left[..., 2] >= min_data_in_leaf)
@@ -147,17 +166,22 @@ def find_best_split(hist: Array,
     valid_t = (bin_ar[None, :] <= t_max[:, None]) & num_ok[:, None]
 
     shift_num = leaf_gain(parent_g, parent_h, l1, l2) + min_gain_to_split
-    # any active constraint (finite bounds / nonzero mono) switches the
-    # candidate to clamped-output gain; otherwise closed form (identical)
+    # any active constraint (finite bounds / nonzero mono / smoothing)
+    # switches the candidate to given-output gain; otherwise closed form
     constrained = (jnp.isfinite(lb) | jnp.isfinite(ub)
                    | (mono[:, None] != 0))                       # [F, 1]
+    if path_smooth > 0.0:
+        constrained = jnp.ones_like(constrained)
 
     def num_gain(left, right, valid):
         plain = split_gain(left, right, l2, shift_num)
-        l_out = jnp.clip(leaf_output(left[..., 0], left[..., 1], l1, l2,
-                                     max_delta_step), lb, ub)
-        r_out = jnp.clip(leaf_output(right[..., 0], right[..., 1], l1, l2,
-                                     max_delta_step), lb, ub)
+        l_out = jnp.clip(smooth_output(
+            leaf_output(left[..., 0], left[..., 1], l1, l2, max_delta_step),
+            left[..., 2], p_out, path_smooth), lb, ub)
+        r_out = jnp.clip(smooth_output(
+            leaf_output(right[..., 0], right[..., 1], l1, l2,
+                        max_delta_step),
+            right[..., 2], p_out, path_smooth), lb, ub)
         cg = (gain_given_output(left, l_out, l2)
               + gain_given_output(right, r_out, l2)) - shift_num
         viol = (((mono[:, None] > 0) & (l_out > r_out))
@@ -181,14 +205,18 @@ def find_best_split(hist: Array,
     # and treated as 0 (the reference rejects it at config time)
     l2c = l2 + cat_l2
     shift_cat = leaf_gain(parent_g, parent_h, l1, l2c) + min_gain_to_split
-    cat_bounded = jnp.isfinite(lb) | jnp.isfinite(ub)
+    cat_bounded = jnp.isfinite(lb) | jnp.isfinite(ub) | (path_smooth > 0.0)
 
     def cat_gain(left, right, valid):
         plain = split_gain(left, right, l2c, shift_cat)
-        l_out = jnp.clip(leaf_output(left[..., 0], left[..., 1], l1, l2c,
-                                     max_delta_step), lb, ub)
-        r_out = jnp.clip(leaf_output(right[..., 0], right[..., 1], l1, l2c,
-                                     max_delta_step), lb, ub)
+        l_out = jnp.clip(smooth_output(
+            leaf_output(left[..., 0], left[..., 1], l1, l2c,
+                        max_delta_step),
+            left[..., 2], p_out, path_smooth), lb, ub)
+        r_out = jnp.clip(smooth_output(
+            leaf_output(right[..., 0], right[..., 1], l1, l2c,
+                        max_delta_step),
+            right[..., 2], p_out, path_smooth), lb, ub)
         cg = (gain_given_output(left, l_out, l2c)
               + gain_given_output(right, r_out, l2c)) - shift_cat
         g = jnp.where(cat_bounded, cg, plain)
@@ -228,6 +256,9 @@ def find_best_split(hist: Array,
 
     # ------------------------------------------------------------- decide
     gains = jnp.stack([gain0, gain1, gain2, gain3, gain4])       # [5, F, MB]
+    if cand_mask is not None:
+        # forced splits: only the designated (feature, bin) cell competes
+        gains = jnp.where(cand_mask[None, :, :], gains, NEG_INF)
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]

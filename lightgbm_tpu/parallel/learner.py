@@ -103,7 +103,13 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
 
     def padded(bins_fm, grad, hess, sw, feat, allowed):
         if f_extra:
-            feat = {k: jnp.pad(v, (0, f_extra)) for k, v in feat.items()}
+            # pad the per-feature [F] arrays; ic_groups is [K, F] (axis 1),
+            # ff_key is an RNG key (no feature axis)
+            feat = {k: (v if k == "ff_key"
+                        else jnp.pad(v, ((0, 0), (0, f_extra)))
+                        if k == "ic_groups"
+                        else jnp.pad(v, (0, f_extra)))
+                    for k, v in feat.items()}
             allowed = jnp.pad(allowed, (0, f_extra))  # False → never split
         if n_extra:
             grad = jnp.pad(grad, (0, n_extra))

@@ -180,7 +180,12 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "stochastic_rounding": (True, "bool", ()),
     # ---- TPU-specific (new; no reference counterpart) ----
     "tpu_row_tile": (0, "int", ()),          # 0 = auto
-    "tpu_use_pallas": (True, "bool", ()),    # use pallas histogram kernel when available
+    # opt-in: measured on v5e (2026-07-30), XLA's native scatter
+    # (segment_sum) runs the Higgs-shape histogram at ~416 GB/s (~51% of
+    # HBM peak) while the matmul-formulated Pallas kernel is MXU-bound at
+    # 3 output rows (~2% utilization) and ~190x slower; the kernel stays
+    # correctness-tested as the CUDA-kernel-parity artifact
+    "tpu_use_pallas": (False, "bool", ()),
     "tpu_num_shards": (0, "int", ()),        # 0 = all visible devices
     "saved_feature_importance_type": (0, "int", ()),
     "snapshot_freq": (-1, "int", ("save_period",)),

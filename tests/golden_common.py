@@ -1,0 +1,71 @@
+"""Shared fixtures for the golden parity suite (SURVEY §4 adopt-items 1/3:
+frozen expected models for fixed seeds — our stand-in for recorded
+stock-LightGBM outputs while the reference mount is empty; regenerate with
+`python tests/gen_golden.py` ONLY when a deliberate behavior change lands,
+and say why in the commit message)."""
+import numpy as np
+
+GOLDEN_CASES = {
+    "binary": dict(
+        params={"objective": "binary", "num_leaves": 15,
+                "learning_rate": 0.1, "min_data_in_leaf": 20,
+                "verbosity": -1},
+        n=2000, f=6, seed=42, rounds=10, classification=True),
+    "regression_l2": dict(
+        params={"objective": "regression", "num_leaves": 31,
+                "lambda_l2": 1.0, "verbosity": -1},
+        n=2000, f=6, seed=43, rounds=10, classification=False),
+    "multiclass": dict(
+        params={"objective": "multiclass", "num_class": 3,
+                "num_leaves": 7, "verbosity": -1},
+        n=1500, f=5, seed=44, rounds=5, classification=True,
+        n_class=3),
+    "goss_bagging": dict(
+        params={"objective": "binary", "boosting": "goss",
+                "num_leaves": 15, "verbosity": -1},
+        n=2000, f=6, seed=45, rounds=10, classification=True),
+    "categorical": dict(
+        params={"objective": "regression", "num_leaves": 15,
+                "verbosity": -1},
+        n=2000, f=5, seed=46, rounds=5, classification=False,
+        categorical=[0]),
+}
+
+
+def make_case_data(case):
+    rng = np.random.RandomState(case["seed"])
+    n, f = case["n"], case["f"]
+    X = rng.randn(n, f)
+    if case.get("categorical"):
+        for j in case["categorical"]:
+            X[:, j] = rng.randint(0, 8, n)
+    score = X[:, 1] - 0.5 * X[:, 2] + 0.3 * rng.randn(n)
+    if case.get("categorical"):
+        score = score + (X[:, 0] % 3 == 0) * 1.5
+    if case.get("classification"):
+        k = case.get("n_class", 2)
+        if k == 2:
+            y = (score > 0).astype(np.float64)
+        else:
+            y = np.clip(np.digitize(score, [-0.5, 0.5]), 0, k - 1)\
+                .astype(np.float64)
+    else:
+        y = score
+    return X, y
+
+
+def model_fingerprint(bst, X):
+    """Structure + values digest of a trained model."""
+    trees = []
+    for t in bst.trees:
+        ni = t.num_internal()
+        trees.append({
+            "split_feature": t.split_feature[:ni].tolist(),
+            "threshold_bin": np.asarray(t.threshold_bin[:ni]).tolist(),
+            "leaf_value": [round(float(v), 10)
+                           for v in t.leaf_value[:t.num_leaves]],
+        })
+    preds = bst.predict(X[:50])
+    return {"trees": trees,
+            "pred_sample": np.round(np.asarray(preds, np.float64), 8)
+            .reshape(-1).tolist()}

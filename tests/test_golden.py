@@ -1,0 +1,64 @@
+"""Golden parity suite (SURVEY §4 / VERDICT item 7): frozen expected
+models for fixed seeds + byte-level model-text round-trips.  Catches any
+unintended behavioral drift in binning, split finding, objectives, or
+model IO between rounds."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from golden_common import GOLDEN_CASES, make_case_data, model_fingerprint
+
+DATA = os.path.join(os.path.dirname(os.path.abspath(__file__)), "data")
+
+
+def _train(name):
+    case = GOLDEN_CASES[name]
+    X, y = make_case_data(case)
+    kw = {}
+    if case.get("categorical"):
+        kw["categorical_feature"] = case["categorical"]
+    bst = lgb.train(dict(case["params"]), lgb.Dataset(X, label=y, **kw),
+                    num_boost_round=case["rounds"])
+    return bst, X
+
+
+@pytest.mark.parametrize("name", sorted(GOLDEN_CASES))
+class TestGolden:
+    def test_matches_frozen_model(self, name):
+        path = os.path.join(DATA, f"golden_{name}.json")
+        with open(path) as f:
+            frozen = json.load(f)
+        bst, X = _train(name)
+        got = model_fingerprint(bst, X)
+        assert len(got["trees"]) == len(frozen["trees"])
+        for i, (tg, tf) in enumerate(zip(got["trees"], frozen["trees"])):
+            assert tg["split_feature"] == tf["split_feature"], f"tree {i}"
+            assert tg["threshold_bin"] == tf["threshold_bin"], f"tree {i}"
+            np.testing.assert_allclose(tg["leaf_value"], tf["leaf_value"],
+                                       rtol=1e-6, atol=1e-9,
+                                       err_msg=f"tree {i}")
+        np.testing.assert_allclose(got["pred_sample"], frozen["pred_sample"],
+                                   rtol=1e-6, atol=1e-8)
+
+    def test_model_text_roundtrip_bytes(self, name):
+        bst, X = _train(name)
+        s1 = bst.model_to_string(num_iteration=-1)
+        b2 = lgb.Booster(model_str=s1)
+        s2 = b2.model_to_string(num_iteration=-1)
+        assert s1 == s2, "model text round-trip is not byte-stable"
+        np.testing.assert_allclose(b2.predict(X), bst.predict(X),
+                                   rtol=1e-9)
+
+    def test_frozen_model_file_loads(self, name):
+        path = os.path.join(DATA, f"golden_{name}.model.txt")
+        bst = lgb.Booster(model_file=path)
+        _, X = _train(name)
+        p = bst.predict(X[:50])
+        with open(os.path.join(DATA, f"golden_{name}.json")) as f:
+            frozen = json.load(f)
+        np.testing.assert_allclose(np.asarray(p, np.float64).reshape(-1),
+                                   frozen["pred_sample"], rtol=1e-6,
+                                   atol=1e-8)

@@ -1,0 +1,187 @@
+"""Path smoothing, per-node column sampling, interaction constraints, and
+forced splits (ref: feature_histogram.hpp USE_SMOOTHING; col_sampler.hpp
+GetByNode + interaction filtering; serial_tree_learner.cpp ForceSplits)."""
+import json
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=3000, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 - X[:, 1] + 0.5 * X[:, 2] * X[:, 3] + 0.2 * rng.randn(n)
+    return X, y
+
+
+def _tree_paths(tree):
+    """All root→leaf feature paths of a host Tree."""
+    ni = tree.num_internal()
+    paths = []
+
+    def walk(node, used):
+        if node < 0:
+            paths.append(frozenset(used))
+            return
+        u = used | {int(tree.split_feature[node])}
+        walk(int(tree.left_child[node]), u)
+        walk(int(tree.right_child[node]), u)
+
+    if ni:
+        walk(0, set())
+    return paths
+
+
+class TestPathSmooth:
+    def test_smoothing_shrinks_toward_parent(self):
+        X, y = make_data()
+        base = lgb.train({"objective": "regression", "num_leaves": 15,
+                          "verbosity": -1}, lgb.Dataset(X, label=y),
+                         num_boost_round=5)
+        sm = lgb.train({"objective": "regression", "num_leaves": 15,
+                        "path_smooth": 100.0, "verbosity": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=5)
+        pb, ps = base.predict(X), sm.predict(X)
+        assert not np.allclose(pb, ps)
+        # heavy smoothing pulls leaf outputs toward ancestors → lower
+        # prediction variance
+        assert np.var(ps) < np.var(pb)
+
+    def test_zero_smoothing_unchanged(self):
+        X, y = make_data(seed=1)
+        a = lgb.train({"objective": "regression", "num_leaves": 7,
+                       "verbosity": -1}, lgb.Dataset(X, label=y),
+                      num_boost_round=3)
+        b = lgb.train({"objective": "regression", "num_leaves": 7,
+                       "path_smooth": 0.0, "verbosity": -1},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+        np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+
+class TestFeatureFractionByNode:
+    def test_bynode_sampling_trains_and_differs(self):
+        X, y = make_data(seed=2)
+        full = lgb.train({"objective": "regression", "num_leaves": 15,
+                          "verbosity": -1}, lgb.Dataset(X, label=y),
+                         num_boost_round=5)
+        bynode = lgb.train({"objective": "regression", "num_leaves": 15,
+                            "feature_fraction_bynode": 0.34,
+                            "verbosity": -1}, lgb.Dataset(X, label=y),
+                           num_boost_round=5)
+        assert not np.allclose(full.predict(X), bynode.predict(X))
+        mse = float(np.mean((bynode.predict(X) - y) ** 2))
+        assert mse < float(np.var(y))  # still learns
+
+    def test_bynode_chunked_matches_periter(self):
+        import lightgbm_tpu.booster as booster_mod
+        X, y = make_data(seed=3)
+        params = {"objective": "regression", "num_leaves": 15,
+                  "feature_fraction_bynode": 0.5, "verbosity": -1}
+        bc = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=16)
+        old = booster_mod.Booster._BULK_CHUNK
+        booster_mod.Booster._BULK_CHUNK = 10 ** 9
+        try:
+            bp = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                           num_boost_round=16)
+        finally:
+            booster_mod.Booster._BULK_CHUNK = old
+        np.testing.assert_allclose(bc.predict(X), bp.predict(X),
+                                   rtol=1e-6, atol=1e-8)
+
+
+class TestInteractionConstraints:
+    def test_paths_respect_groups(self):
+        X, y = make_data(seed=4)
+        groups = [[0, 1], [2, 3], [4, 5]]
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "interaction_constraints": json.dumps(groups),
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=5)
+        gsets = [frozenset(g) for g in groups]
+        for t in bst.trees:
+            for path in _tree_paths(t):
+                assert any(path <= g for g in gsets), \
+                    f"path {set(path)} violates constraints"
+
+    def test_list_param_form(self):
+        X, y = make_data(seed=5)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "interaction_constraints": [[0, 1], [2, 3, 4, 5]],
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=3)
+        assert bst.num_trees() == 3
+
+
+class TestForcedSplits:
+    def test_forced_root_and_child(self, tmp_path):
+        X, y = make_data(seed=6)
+        forced = {"feature": 4, "threshold": 0.0,
+                  "left": {"feature": 5, "threshold": 0.5}}
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            json.dump(forced, f)
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "forcedsplits_filename": fn, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        for t in bst.trees:
+            # BFS: split 0 = root on feature 4; split 1 re-splits the left
+            # child (leaf slot 0) on feature 5
+            assert t.split_feature[0] == 4
+            assert t.split_feature[1] == 5
+        # free growth resumes after the forced prefix and still learns
+        mse = float(np.mean((bst.predict(X) - y) ** 2))
+        assert mse < float(np.var(y))
+
+    def test_infeasible_forced_split_does_not_corrupt(self, tmp_path):
+        """A forced chain deeper than min_data_in_leaf allows must abandon
+        the remaining prefix, not apply a garbage split (regression)."""
+        rng = np.random.RandomState(9)
+        X = rng.randn(200, 4)
+        y = X[:, 0] + 0.1 * rng.randn(200)
+        # root forced at an extreme threshold → one child nearly empty →
+        # the child's forced split is infeasible under min_data_in_leaf
+        forced = {"feature": 1, "threshold": 3.5,
+                  "right": {"feature": 2, "threshold": 0.0,
+                            "right": {"feature": 3, "threshold": 0.0}}}
+        fn = str(tmp_path / "forced_bad.json")
+        with open(fn, "w") as f:
+            json.dump(forced, f)
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "min_data_in_leaf": 50,
+                         "forcedsplits_filename": fn, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        for t in bst.trees:
+            ni = t.num_internal()
+            assert np.all(t.split_feature[:ni] >= 0), \
+                "corrupt split with feature=-1 recorded"
+        preds = bst.predict(X)
+        assert np.all(np.isfinite(preds))
+
+    def test_forced_split_bypasses_column_sampling(self, tmp_path):
+        """Forced splits apply regardless of feature_fraction (ref:
+        ForceSplits runs before the ColSampler-gated search)."""
+        X, y = make_data(seed=10)
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            json.dump({"feature": 3, "threshold": 0.0}, f)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "feature_fraction": 0.34,
+                         "forcedsplits_filename": fn, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=12)
+        assert all(t.split_feature[0] == 3 for t in bst.trees)
+
+    def test_forced_split_with_valid_eval(self, tmp_path):
+        X, y = make_data(seed=7)
+        Xv, yv = make_data(800, seed=8)
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            json.dump({"feature": 0, "threshold": 0.0}, f)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "forcedsplits_filename": fn, "metric": "l2",
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=20,
+                        valid_sets=[lgb.Dataset(Xv, label=yv)],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert all(t.split_feature[0] == 0 for t in bst.trees)

@@ -1,0 +1,109 @@
+"""Sequence / scipy-CSR ingest and per-row prediction early stop
+(ref: basic.py `Sequence` two-pass ingest; LGBM_DatasetCreateFromCSR;
+src/boosting/prediction_early_stop.cpp)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=2000, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - X[:, 1] > 0).astype(float)
+    return X, y
+
+
+class _ArraySeq(lgb.Sequence):
+    batch_size = 128
+
+    def __init__(self, arr):
+        self.arr = arr
+
+    def __len__(self):
+        return len(self.arr)
+
+    def __getitem__(self, idx):
+        return self.arr[idx]
+
+
+class TestSequenceIngest:
+    def test_single_sequence(self):
+        X, y = make_data()
+        ds = lgb.Dataset(_ArraySeq(X), label=y)
+        ds.construct()
+        ref = lgb.Dataset(X, label=y)
+        ref.construct()
+        np.testing.assert_array_equal(np.asarray(ds.bin_data),
+                                      np.asarray(ref.bin_data))
+
+    def test_list_of_sequences_concatenates(self):
+        X, y = make_data()
+        ds = lgb.Dataset([_ArraySeq(X[:700]), _ArraySeq(X[700:])], label=y)
+        ds.construct()
+        ref = lgb.Dataset(X, label=y)
+        ref.construct()
+        np.testing.assert_array_equal(np.asarray(ds.bin_data),
+                                      np.asarray(ref.bin_data))
+
+
+class TestSparseIngest:
+    def test_csr_matches_dense(self):
+        scipy_sparse = pytest.importorskip("scipy.sparse")
+        rng = np.random.RandomState(1)
+        X = rng.randn(1000, 8)
+        X[rng.rand(*X.shape) < 0.8] = 0.0  # sparsify
+        y = (X[:, 0] + X[:, 1] > 0).astype(float)
+        sp = scipy_sparse.csr_matrix(X)
+        bst_sp = lgb.train({"objective": "binary", "num_leaves": 7,
+                            "verbosity": -1}, lgb.Dataset(sp, label=y),
+                           num_boost_round=5)
+        bst_d = lgb.train({"objective": "binary", "num_leaves": 7,
+                           "verbosity": -1}, lgb.Dataset(X, label=y),
+                          num_boost_round=5)
+        np.testing.assert_allclose(bst_sp.predict(X), bst_d.predict(X),
+                                   rtol=1e-9)
+        # sparse predict input too
+        np.testing.assert_allclose(bst_sp.predict(sp), bst_d.predict(X),
+                                   rtol=1e-9)
+
+
+class TestPredEarlyStop:
+    def test_binary_early_stop_close_to_exact(self):
+        X, y = make_data(3000)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "learning_rate": 0.3, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=60)
+        exact = bst.predict(X)
+        es = bst.predict(X, pred_early_stop=True, pred_early_stop_freq=5,
+                         pred_early_stop_margin=8.0)
+        # decided rows stop with a confident margin — class calls agree
+        np.testing.assert_array_equal(exact > 0.5, es > 0.5)
+        # tight margin → must differ from exact for at least some rows
+        # (proves the stop actually fired)
+        es_loose = bst.predict(X, pred_early_stop=True,
+                               pred_early_stop_freq=1,
+                               pred_early_stop_margin=0.5)
+        assert np.any(es_loose != exact)
+
+    def test_multiclass_early_stop(self):
+        rng = np.random.RandomState(2)
+        X = rng.randn(1500, 5)
+        y = (X[:, 0] > 0.5).astype(int) + (X[:, 1] > 0).astype(int)
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=30)
+        exact = np.argmax(bst.predict(X), axis=1)
+        es = np.argmax(bst.predict(X, pred_early_stop=True,
+                                   pred_early_stop_freq=3,
+                                   pred_early_stop_margin=6.0), axis=1)
+        assert (exact == es).mean() > 0.99
+
+    def test_regression_ignores_flag(self):
+        X, y = make_data()
+        yr = X[:, 0] * 2.0
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=yr),
+                        num_boost_round=10)
+        np.testing.assert_array_equal(
+            bst.predict(X), bst.predict(X, pred_early_stop=True))

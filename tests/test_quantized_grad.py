@@ -1,0 +1,76 @@
+"""Quantized-gradient training (ref: v4 use_quantized_grad /
+cuda_gradient_discretizer.cu): gradients snap to num_grad_quant_bins
+levels (stochastic rounding by default); model quality should stay close
+to exact training."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=4000, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (X[:, 0] - 0.7 * X[:, 1] + 0.5 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+def _auc(p, y):
+    order = np.argsort(p)
+    ranks = np.empty(len(p)); ranks[order] = np.arange(len(p))
+    pos = y > 0
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() - 1) / 2) / \
+        (pos.sum() * (~pos).sum())
+
+
+class TestQuantizedGrad:
+    def test_quality_close_to_exact(self):
+        X, y = make_data()
+        exact = lgb.train({"objective": "binary", "num_leaves": 15,
+                           "verbosity": -1}, lgb.Dataset(X, label=y),
+                          num_boost_round=30)
+        quant = lgb.train({"objective": "binary", "num_leaves": 15,
+                           "use_quantized_grad": True,
+                           "num_grad_quant_bins": 8, "verbosity": -1},
+                          lgb.Dataset(X, label=y), num_boost_round=30)
+        a_e = _auc(exact.predict(X), y)
+        a_q = _auc(quant.predict(X), y)
+        assert not np.allclose(exact.predict(X), quant.predict(X))
+        assert a_q > a_e - 0.02, (a_e, a_q)
+
+    def test_deterministic_rounding(self):
+        X, y = make_data(seed=1)
+        params = {"objective": "binary", "num_leaves": 7,
+                  "use_quantized_grad": True, "num_grad_quant_bins": 4,
+                  "stochastic_rounding": False, "verbosity": -1}
+        a = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+        b = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+        np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+    def test_chunked_matches_periter(self):
+        import lightgbm_tpu.booster as booster_mod
+        X, y = make_data(seed=2)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "use_quantized_grad": True, "num_grad_quant_bins": 16,
+                  "verbosity": -1}
+        bc = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=16)
+        old = booster_mod.Booster._BULK_CHUNK
+        booster_mod.Booster._BULK_CHUNK = 10 ** 9
+        try:
+            bp = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                           num_boost_round=16)
+        finally:
+            booster_mod.Booster._BULK_CHUNK = old
+        np.testing.assert_allclose(bc.predict(X), bp.predict(X),
+                                   rtol=1e-6, atol=1e-8)
+
+    def test_no_warning_anymore(self, caplog):
+        import logging
+        X, y = make_data(500, seed=3)
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            lgb.train({"objective": "binary", "use_quantized_grad": True,
+                       "num_leaves": 4, "verbosity": 1},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        assert "NO effect" not in caplog.text

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import copy
 import json
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
+from typing import Sequence as SequenceT
 
 import numpy as np
 
@@ -99,7 +100,7 @@ def _to_1d_float(arr: Any, name: str, dtype=np.float64) -> np.ndarray:
 
 
 def _feature_names_from(data: Any, n_features: int,
-                        given: Optional[Sequence[str]]) -> List[str]:
+                        given: Optional[SequenceT[str]]) -> List[str]:
     if given is not None and given != "auto":
         names = list(given)
         if len(names) != n_features:
@@ -122,8 +123,8 @@ class Dataset:
 
     def __init__(self, data: Any, label: Any = None, reference: "Dataset" = None,
                  weight: Any = None, group: Any = None, init_score: Any = None,
-                 feature_name: Union[str, Sequence[str]] = "auto",
-                 categorical_feature: Union[str, Sequence] = "auto",
+                 feature_name: Union[str, SequenceT[str]] = "auto",
+                 categorical_feature: Union[str, SequenceT] = "auto",
                  params: Optional[Dict[str, Any]] = None,
                  free_raw_data: bool = True, position: Any = None):
         self.data = data
@@ -251,7 +252,9 @@ class Dataset:
             self.bundle_data = build_bundled(self.bin_data, self.efb)
         self._set_all_fields()
         self._handle_constructed = True
-        if self.free_raw_data:
+        # linear trees fit leaves on RAW feature values — keep them
+        # (ref: the reference Dataset stores raw values for linear trees)
+        if self.free_raw_data and not cfg.linear_tree:
             self.data = None
         return self
 
@@ -422,7 +425,7 @@ class Dataset:
                                    None if self.feature_name == "auto"
                                    else self.feature_name)
 
-    def set_feature_name(self, feature_name: Sequence[str]) -> "Dataset":
+    def set_feature_name(self, feature_name: SequenceT[str]) -> "Dataset":
         self.feature_name = list(feature_name)
         if self._handle_constructed:
             if len(feature_name) != self._num_feature:
@@ -439,7 +442,7 @@ class Dataset:
         return self
 
     # --------------------------------------------------------------- subset
-    def subset(self, used_indices: Sequence[int],
+    def subset(self, used_indices: SequenceT[int],
                params: Optional[dict] = None) -> "Dataset":
         """Row subset sharing this dataset's bins
         (ref: basic.py `Dataset.subset` → `LGBM_DatasetGetSubset`)."""

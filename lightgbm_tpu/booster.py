@@ -52,6 +52,9 @@ class _DeviceData:
         # [F, N] stays for tree traversal.  Valid sets are only traversed,
         # so their bundled matrix is neither built nor uploaded.
         self.efb = getattr(ds, "efb", None)
+        # raw values retained for linear-tree leaf fits / scoring
+        self.raw_ref = ds.data if ds.data is not None else None
+        self._raw2d: Optional[np.ndarray] = None
         self.bundle_fm = None
         if self.efb is not None and for_train:
             bd = ds.bundle_data
@@ -80,6 +83,19 @@ class _DeviceData:
         self.weight = jnp.asarray(w.astype(np.float32)) if w is not None else None
         self.init_score = ds.get_init_score()
         self.query_boundaries = ds._query_boundaries
+
+    def get_raw(self) -> np.ndarray:
+        """Raw feature matrix (linear trees only; requires the Dataset to
+        have kept raw data — basic.py construct retains it under
+        linear_tree)."""
+        if self._raw2d is None:
+            if self.raw_ref is None:
+                raise LightGBMError(
+                    "linear_tree needs raw feature values; construct the "
+                    "Dataset with linear_tree in params (or "
+                    "free_raw_data=False)")
+            self._raw2d = _to_2d_float(self.raw_ref)
+        return self._raw2d
 
 
 def _traverse_padded(tree: Tree, num_leaves_cap: int, dd: _DeviceData,
@@ -157,10 +173,7 @@ class Booster:
     # (ref: config.cpp Config::CheckParamConflict warns-and-corrects; an
     # accepted-and-ignored param is a correctness trap).  Entries are
     # removed as the features land.
-    _INERT_PARAMS = ("linear_tree", "extra_trees",
-                     "cegb_tradeoff", "cegb_penalty_split",
-                     "cegb_penalty_feature_lazy",
-                     "cegb_penalty_feature_coupled")
+    _INERT_PARAMS = ()
 
     def _warn_inert_params(self) -> None:
         from .utils.config import _PARAMS, canonical_param_name
@@ -190,7 +203,7 @@ class Booster:
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
                      "use_missing", "zero_as_missing", "data_random_seed",
                      "max_bin_by_feature", "feature_pre_filter",
-                     "enable_bundle", "max_conflict_rate")}}
+                     "enable_bundle", "max_conflict_rate", "linear_tree")}}
         self.train_set = train_set
         self._dd = _DeviceData(train_set)
         self.objective_: Optional[ObjectiveFunction] = \
@@ -260,6 +273,14 @@ class Booster:
             else self._ic_groups.shape[0],
             forced_splits=self._parse_forced_splits(),
             num_features_hint=self._dd.num_feature,
+            cegb_tradeoff=self.config.cegb_tradeoff
+            if self._cegb_active() else 0.0,
+            cegb_penalty_split=self.config.cegb_penalty_split,
+            cegb_coupled=bool(list(
+                self.config.cegb_penalty_feature_coupled or [])),
+            cegb_lazy=bool(list(
+                self.config.cegb_penalty_feature_lazy or [])),
+            extra_trees=self.config.extra_trees,
         )
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
@@ -294,6 +315,15 @@ class Booster:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
+
+    def _cegb_active(self) -> bool:
+        """CEGB is on when any penalty is configured
+        (ref: cost_effective_gradient_boosting.hpp `IsEnable`)."""
+        cfg = self.config
+        return (cfg.cegb_tradeoff > 0.0
+                and (cfg.cegb_penalty_split > 0.0
+                     or bool(list(cfg.cegb_penalty_feature_coupled or []))
+                     or bool(list(cfg.cegb_penalty_feature_lazy or []))))
 
     def _parse_ic_groups(self) -> Optional[np.ndarray]:
         """Parse interaction_constraints into [K, F] group masks
@@ -405,9 +435,26 @@ class Booster:
                 bundle_identity=jnp.asarray(efb.identity))
         if self._ic_groups is not None:
             self._feat["ic_groups"] = jnp.asarray(self._ic_groups)
-        if self.config.feature_fraction_bynode < 1.0:
+        if self.config.feature_fraction_bynode < 1.0 \
+                or self.config.extra_trees:
             # per-tree key injected at grow time (__boost / chunk_step)
             self._feat["ff_key"] = self._ff_key0
+        if self._cegb_active():
+            F = self._dd.num_feature
+
+            def vec(v):
+                out = np.zeros(F, np.float32)
+                vals = list(v or [])
+                out[:min(len(vals), F)] = vals[:F]
+                return jnp.asarray(out)
+
+            self._feat["cegb_coupled"] = vec(
+                self.config.cegb_penalty_feature_coupled)
+            self._feat["cegb_lazy"] = vec(
+                self.config.cegb_penalty_feature_lazy)
+            # features used anywhere in the model so far (ref: CEGB
+            # feature_used_ bitmap, updated as trees land)
+            self._feat["cegb_used"] = jnp.zeros(F, bool)
 
     def _setup_tree_learner(self) -> None:
         """Resolve `tree_learner` (+ device count) into the grower used for
@@ -479,6 +526,9 @@ class Booster:
             pass  # constructed against the right reference below
         if data.reference is None:
             data.reference = self.train_set
+        if self.config.linear_tree:
+            # valid sets also need raw values for linear-leaf scoring
+            data.params = {**(data.params or {}), "linear_tree": True}
         dd = _DeviceData(data, for_train=False)
         self.valid_sets.append(data)
         self.name_valid_sets.append(name)
@@ -609,6 +659,13 @@ class Booster:
         cfg = self.config
         K = self.num_tree_per_iteration
         it = self.cur_iter
+        if self._use_goss:
+            # GOSS ranks the EXACT gradients; discretization happens after
+            # sampling, like the reference (sample_strategy before the
+            # tree learner's gradient discretizer)
+            sw = self._goss_weights(it, grad, hess)
+        else:
+            sw = self._sample_weights(it)
         if cfg.use_quantized_grad and cfg.num_grad_quant_bins > 0:
             # ref: v4 quantized training (cuda_gradient_discretizer.cu);
             # same key derivation as the fused chunk so paths agree
@@ -617,10 +674,6 @@ class Booster:
                 if cfg.stochastic_rounding else None
             grad, hess = quantize_gradients(grad, hess,
                                             cfg.num_grad_quant_bins, qkey)
-        if self._use_goss:
-            sw = self._goss_weights(it, grad, hess)
-        else:
-            sw = self._sample_weights(it)
         dd = self._dd
         lr = 1.0 if self._boost_mode == "rf" else cfg.learning_rate
         all_const = True
@@ -640,19 +693,33 @@ class Booster:
                                hk.astype(jnp.float32), sw,
                                feat, allowed)
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
+            if "cegb_used" in self._feat and tree.num_leaves > 1:
+                # coupled penalties charge a feature once per MODEL
+                used = np.array(jax.device_get(self._feat["cegb_used"]))
+                feats = np.unique(
+                    tree.split_feature[:tree.num_internal()])
+                if not used[feats].all():
+                    used[feats] = True
+                    self._feat["cegb_used"] = jnp.asarray(used)
             if tree.num_leaves > 1:
                 all_const = False
             # L1-family leaf refit (ref: ObjectiveFunction::RenewTreeOutput →
             # serial_tree_learner.cpp RenewTreeOutput; applied pre-shrinkage)
             renew_alpha = getattr(self.objective_, "renew_percentile", None) \
                 if self.objective_ is not None else None
-            if renew_alpha is not None and tree.num_leaves > 1:
-                scaled = self._renew_tree_output(tree, dev, sw,
-                                                 float(renew_alpha), lr)
+            if cfg.linear_tree and tree.num_leaves > 1:
+                # ridge-fit linear leaves on raw values (ref:
+                # linear_tree_learner.cpp `LinearTreeLearner::Train`)
+                contrib = jnp.asarray(self._fit_linear_tree(
+                    tree, dev, gk, hk, sw, lr).astype(np.float32))
             else:
-                scaled = dev.leaf_value * lr
-            # train score: final leaf_id from growth → direct gather
-            contrib = scaled[dev.leaf_id]
+                if renew_alpha is not None and tree.num_leaves > 1:
+                    scaled = self._renew_tree_output(tree, dev, sw,
+                                                     float(renew_alpha), lr)
+                else:
+                    scaled = dev.leaf_value * lr
+                # train score: final leaf_id from growth → direct gather
+                contrib = scaled[dev.leaf_id]
             if K == 1:
                 new_train = self._train_score + contrib
             else:
@@ -702,8 +769,66 @@ class Booster:
                                      dtype=np.float64)[:tree.num_leaves]
         return scaled
 
+    def _fit_linear_tree(self, tree: Tree, dev: DeviceTree, gk, hk, sw,
+                         lr: float) -> np.ndarray:
+        """Ridge-fit each leaf's linear model on the raw values of its
+        path features, hessian-weighted, and return the per-row training
+        contribution (ref: linear_tree_learner.cpp
+        `LinearTreeLearner::CalculateLinear` — per-leaf XtHX normal
+        equations with `linear_lambda` on the coefficients; rows with NaN
+        in path features keep the constant leaf output)."""
+        X = self._dd.get_raw()
+        leaf_id = np.asarray(jax.device_get(dev.leaf_id))
+        g = np.asarray(jax.device_get(gk), np.float64)
+        h = np.asarray(jax.device_get(hk), np.float64)
+        w = np.asarray(jax.device_get(sw), np.float64)
+        lam = self.config.linear_lambda
+        paths = tree.leaf_path_features()
+        tree.is_linear = True
+        tree.leaf_const = np.array(tree.leaf_value, np.float64)
+        for leaf in range(tree.num_leaves):
+            feats = paths[leaf]
+            tree.leaf_features[leaf] = []
+            tree.leaf_coeff[leaf] = []
+            if not feats:
+                continue
+            rows = np.nonzero(leaf_id == leaf)[0]
+            if not len(rows):
+                continue
+            Xl = X[np.ix_(rows, feats)]
+            ok = ~np.isnan(Xl).any(axis=1) & (w[rows] > 0)
+            fit = rows[ok]
+            if len(fit) <= len(feats) + 1:
+                continue
+            A = np.concatenate([np.ones((len(fit), 1)),
+                                X[np.ix_(fit, feats)]], axis=1)
+            hh = (h[fit] * w[fit])[:, None]
+            rhs = -(A.T @ (g[fit] * w[fit]))
+            M = A.T @ (A * hh)
+            M[np.arange(1, len(feats) + 1),
+              np.arange(1, len(feats) + 1)] += lam
+            try:
+                beta = np.linalg.solve(M, rhs)
+            except np.linalg.LinAlgError:
+                continue
+            if not np.all(np.isfinite(beta)):
+                continue
+            tree.leaf_const[leaf] = beta[0] * lr
+            tree.leaf_features[leaf] = list(feats)
+            tree.leaf_coeff[leaf] = [float(b) for b in beta[1:] * lr]
+        return tree.linear_predict(X, leaf_id)
+
     def _apply_tree_to_score(self, score, tree: Tree, dd: _DeviceData, k: int,
                              bias_included: bool, record=None):
+        if tree.is_linear and tree.num_leaves > 1:
+            X = dd.get_raw()
+            c = tree.linear_predict(X, tree.predict_leaf_index(X))
+            contrib = jnp.asarray(c.astype(np.float32))
+            if record is not None:
+                self._last_contribs.append(("valid", record, k, contrib))
+            if score.ndim == 1:
+                return score + contrib
+            return score.at[:, k].add(contrib)
         if tree.num_leaves <= 1:
             contrib = jnp.full((dd.num_data,), float(tree.leaf_value[0])
                                if bias_included else 0.0, dtype=jnp.float32)
@@ -862,6 +987,10 @@ class Booster:
         ok = (self._fobj is None and self.objective_ is not None
               and getattr(self, "_mesh", None) is None
               and self._boost_mode in ("gbdt", "rf")
+              # CEGB coupled penalties mutate per-model host state;
+              # linear-leaf ridge fits run on the host raw matrix
+              and not self._cegb_active()
+              and not cfg.linear_tree
               and cfg.pos_bagging_fraction >= 1.0
               and cfg.neg_bagging_fraction >= 1.0)
         if not ok:
@@ -1099,6 +1228,13 @@ class Booster:
         """score -= tree(bins) where the stored tree may carry a folded-in
         bias that the running score tracks separately.  Mirrors
         `_apply_tree_to_score` exactly, including the constant-tree case."""
+        if tree.is_linear and tree.num_leaves > 1:
+            X = dd.get_raw()
+            c = tree.linear_predict(X, tree.predict_leaf_index(X)) - bias
+            contrib = jnp.asarray(c.astype(np.float32))
+            if score.ndim == 1:
+                return score - contrib
+            return score.at[:, k].add(-contrib)
         if tree.num_leaves <= 1:
             const = float(tree.leaf_value[0]) - bias \
                 if len(tree.leaf_value) else 0.0

@@ -83,14 +83,25 @@ def load_data_file(path: str, config: Config
     fmt, has_header = _sniff_format(path)
     if config.header:
         has_header = True
+    from .native import parse_dense, parse_libsvm
     if fmt == "libsvm":
+        data = parse_libsvm(path)  # index base auto-detected by the probe
+        if data is not None:
+            return data[:, 1:].copy(), data[:, 0].copy()
         from sklearn.datasets import load_svmlight_file
         X, y = load_svmlight_file(path)
         return np.asarray(X.todense(), dtype=np.float64), y
-    sep = "\t" if fmt == "tsv" else ","
-    data = np.genfromtxt(path, delimiter=sep,
-                         skip_header=1 if has_header else 0,
-                         dtype=np.float64)
+    native = parse_dense(path)
+    if native is not None:
+        data, native_skipped_header = native
+        if (has_header or config.header) and not native_skipped_header:
+            # the user declared a header the numeric sniff didn't catch
+            data = data[1:]
+    else:
+        sep = "\t" if fmt == "tsv" else ","
+        data = np.genfromtxt(path, delimiter=sep,
+                             skip_header=1 if has_header else 0,
+                             dtype=np.float64)
     if data.ndim == 1:
         data = data.reshape(-1, 1)
     label_col = 0

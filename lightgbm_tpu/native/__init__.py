@@ -1,0 +1,132 @@
+"""Native data-path runtime: build + ctypes bindings with Python fallback.
+
+The reference's IO layer is C++ (parser.cpp, dataset_loader.cpp, bin.h
+ValueToBin); this package compiles the TPU build's counterpart
+(libnative.cpp) on first use with the system g++ — no pip, no pybind11 —
+and degrades to the numpy paths if no toolchain is available."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "libnative.cpp")
+_SO = os.path.join(_DIR, f"libnative-{sys.platform}.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    return _SO if r.returncode == 0 and os.path.exists(_SO) else None
+
+
+def get_lib():
+    """The loaded native library, or None (numpy fallback)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        so = _SO if (os.path.exists(_SO)
+                     and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)) \
+            else _build()
+        if so is None:
+            return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.lgbtpu_parse_dense.restype = ctypes.c_int64
+        lib.lgbtpu_parse_dense.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.lgbtpu_parse_libsvm.restype = ctypes.c_int64
+        lib.lgbtpu_parse_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.lgbtpu_values_to_bins.restype = None
+        lib.lgbtpu_values_to_bins.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def parse_dense(path: str) -> Optional[Tuple[np.ndarray, bool]]:
+    """CSV/TSV file → (float64 [rows, cols] matrix, had_header).
+    None if the native library is unavailable; raises on parse errors."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64(0)
+    cols = ctypes.c_int64(0)
+    header = ctypes.c_int32(0)
+    rc = lib.lgbtpu_parse_dense(path.encode(), None,
+                                ctypes.byref(rows), ctypes.byref(cols),
+                                ctypes.byref(header))
+    if rc != 0:
+        raise ValueError(f"native parse probe failed (rc={rc}): {path}")
+    out = np.empty((rows.value, cols.value), dtype=np.float64)
+    rc = lib.lgbtpu_parse_dense(
+        path.encode(), out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(rows), ctypes.byref(cols), ctypes.byref(header))
+    if rc != 0:
+        raise ValueError(f"native parse failed (rc={rc}): {path}")
+    return out, bool(header.value)
+
+
+def parse_libsvm(path: str) -> Optional[np.ndarray]:
+    """LibSVM file → dense float64 [rows, 1 + n_features] matrix with the
+    label in column 0 (0- or 1-based indices auto-detected by the probe
+    pass).  None if native lib unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    rows = ctypes.c_int64(0)
+    cols = ctypes.c_int64(0)
+    zero_based = ctypes.c_int32(0)
+    rc = lib.lgbtpu_parse_libsvm(path.encode(), None,
+                                 ctypes.byref(rows), ctypes.byref(cols),
+                                 ctypes.byref(zero_based))
+    if rc != 0:
+        raise ValueError(f"native libsvm probe failed (rc={rc}): {path}")
+    out = np.empty((rows.value, cols.value + 1), dtype=np.float64)
+    rc = lib.lgbtpu_parse_libsvm(
+        path.encode(), out.ctypes.data_as(ctypes.c_void_p),
+        ctypes.byref(rows), ctypes.byref(cols), ctypes.byref(zero_based))
+    if rc != 0:
+        raise ValueError(f"native libsvm parse failed (rc={rc}): {path}")
+    return out
+
+
+def values_to_bins(vals: np.ndarray, bounds: np.ndarray,
+                   missing_type: int, nan_bin: int
+                   ) -> Optional[np.ndarray]:
+    """Numerical value→bin mapping (binary search over inclusive upper
+    bounds).  None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    v = np.ascontiguousarray(vals, dtype=np.float64)
+    b = np.ascontiguousarray(bounds, dtype=np.float64)
+    out = np.empty(len(v), dtype=np.uint16)
+    lib.lgbtpu_values_to_bins(
+        v.ctypes.data_as(ctypes.c_void_p), len(v),
+        b.ctypes.data_as(ctypes.c_void_p), len(b),
+        int(missing_type), int(nan_bin),
+        out.ctypes.data_as(ctypes.c_void_p))
+    return out

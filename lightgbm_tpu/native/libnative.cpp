@@ -1,0 +1,192 @@
+// Native data-path runtime: text parsing + bin mapping hot loops.
+//
+// TPU-native counterpart of the reference's C++ IO layer
+// (ref: src/io/parser.cpp CSVParser/TSVParser/LibSVMParser +
+// Parser::CreateParser auto-detection; src/io/dataset_loader.cpp
+// LoadFromFile; include/LightGBM/bin.h BinMapper::ValueToBin).
+// The JAX compute path never touches this; it feeds construct-time work
+// (file -> dense matrix -> bins) that would otherwise run as interpreted
+// Python/numpy over text.  Exposed as a plain C ABI for ctypes (no
+// pybind11 in this image).
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <vector>
+#include <string>
+
+extern "C" {
+
+// ---------------------------------------------------------------- parsing
+// Two-call contract: pass rows=nullptr to probe (returns rows/cols), then
+// allocate rows*cols doubles and call again to fill.  Delimiter ','/'\t'
+// auto-detected from the first line; "na"/"nan"/"" -> NaN; a header line
+// (any unparsable first field) is skipped.
+// Returns 0 on success, negative on error.
+static char detect_delim(const std::string &line) {
+  size_t commas = 0, tabs = 0, spaces = 0;
+  for (char c : line) {
+    if (c == ',') commas++;
+    else if (c == '\t') tabs++;
+    else if (c == ' ') spaces++;
+  }
+  if (commas >= tabs && commas >= spaces) return ',';
+  if (tabs >= spaces) return '\t';
+  return ' ';
+}
+
+static bool parse_field(const char *s, const char *end, double *out) {
+  while (s < end && (*s == ' ' || *s == '"')) s++;
+  if (s >= end) { *out = NAN; return true; }
+  if (strncasecmp(s, "na", 2) == 0 || *s == '?') { *out = NAN; return true; }
+  char *stop = nullptr;
+  double v = strtod(s, &stop);
+  if (stop == s) return false;
+  *out = v;
+  return true;
+}
+
+int64_t lgbtpu_parse_dense(const char *path, double *out,
+                           int64_t *n_rows, int64_t *n_cols,
+                           int32_t *had_header) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  std::string line;
+  line.reserve(1 << 16);
+  char buf[1 << 16];
+  char delim = 0;
+  int64_t rows = 0, cols = 0;
+  bool probing = (out == nullptr);
+  int64_t cap = probing ? 0 : (*n_rows) * (*n_cols);
+  int64_t written = 0;
+  *had_header = 0;
+  bool first = true;
+  std::vector<double> vals;
+  while (fgets(buf, sizeof(buf), f)) {
+    line.assign(buf);
+    // handle long lines
+    while (!line.empty() && line.back() != '\n' &&
+           fgets(buf, sizeof(buf), f)) line += buf;
+    while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+      line.pop_back();
+    if (line.empty()) continue;
+    if (!delim) delim = detect_delim(line);
+    vals.clear();
+    const char *p = line.c_str();
+    const char *end = p + line.size();
+    bool ok = true;
+    while (p <= end) {
+      const char *q = p;
+      while (q < end && *q != delim) q++;
+      double v;
+      if (!parse_field(p, q, &v)) { ok = false; break; }
+      vals.push_back(v);
+      if (q >= end) break;
+      p = q + 1;
+    }
+    if (!ok) {
+      if (first) { *had_header = 1; first = false; continue; }
+      fclose(f);
+      return -2;  // malformed mid-file
+    }
+    first = false;
+    if (cols == 0) cols = (int64_t)vals.size();
+    if ((int64_t)vals.size() != cols) { fclose(f); return -3; }
+    if (!probing) {
+      if (written + cols > cap) { fclose(f); return -4; }
+      memcpy(out + written, vals.data(), cols * sizeof(double));
+    }
+    written += cols;
+    rows++;
+  }
+  fclose(f);
+  *n_rows = rows;
+  *n_cols = cols;
+  return 0;
+}
+
+// LibSVM: "label idx:val idx:val ...".  The probe pass detects the index
+// base (any idx 0 anywhere → zero-based) and writes it to *zero_based;
+// the fill pass READS *zero_based and shifts indices accordingly.  out is
+// dense row-major [rows, cols+1] with column 0 = label, absent = 0.
+int64_t lgbtpu_parse_libsvm(const char *path, double *out,
+                            int64_t *n_rows, int64_t *n_cols,
+                            int32_t *zero_based) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  char buf[1 << 16];
+  std::string line;
+  int64_t rows = 0, max_idx = -1;
+  bool probing = (out == nullptr);
+  int64_t cols = probing ? 0 : *n_cols;  // feature count (excl. label)
+  int64_t shift = (!probing && *zero_based) ? 1 : 0;
+  bool saw_zero = false;
+  while (fgets(buf, sizeof(buf), f)) {
+    line.assign(buf);
+    while (!line.empty() && line.back() != '\n' &&
+           fgets(buf, sizeof(buf), f)) line += buf;
+    if (line.find_first_not_of(" \t\r\n") == std::string::npos) continue;
+    const char *p = line.c_str();
+    char *stop = nullptr;
+    double label = strtod(p, &stop);
+    if (stop == p) { fclose(f); return -2; }
+    double *row = probing ? nullptr : out + rows * (cols + 1);
+    if (!probing) {
+      memset(row, 0, (cols + 1) * sizeof(double));
+      row[0] = label;
+    }
+    p = stop;
+    while (*p) {
+      while (*p == ' ' || *p == '\t') p++;
+      if (*p == '\0' || *p == '\n' || *p == '\r' || *p == '#') break;
+      long idx = strtol(p, &stop, 10);
+      if (stop == p || *stop != ':') { fclose(f); return -3; }
+      p = stop + 1;
+      double v = strtod(p, &stop);
+      if (stop == p) { fclose(f); return -4; }
+      p = stop;
+      if (idx == 0) saw_zero = true;
+      if (idx > max_idx) max_idx = idx;
+      if (!probing) {
+        int64_t col = idx + shift;
+        if (col >= 1 && col <= cols) row[col] = v;
+      }
+    }
+    rows++;
+  }
+  fclose(f);
+  *n_rows = rows;
+  if (probing) {
+    *zero_based = saw_zero ? 1 : 0;
+    if (max_idx < 0) *n_cols = 0;
+    else *n_cols = saw_zero ? (max_idx + 1) : max_idx;
+  }
+  return 0;
+}
+
+// ------------------------------------------------------------- bin mapping
+// Numerical value -> bin via upper-bound binary search
+// (ref: bin.h BinMapper::ValueToBin; bounds are inclusive upper bounds,
+// bounds[num_bounds-1] == +inf).  missing_type 2 routes NaN to the last
+// bin; missing_type 1 maps NaN to 0.0 first (zero bin).
+void lgbtpu_values_to_bins(const double *vals, int64_t n,
+                           const double *bounds, int32_t n_bounds,
+                           int32_t missing_type, int32_t nan_bin,
+                           uint16_t *out) {
+  for (int64_t i = 0; i < n; ++i) {
+    double v = vals[i];
+    if (std::isnan(v)) {
+      if (missing_type == 2) { out[i] = (uint16_t)nan_bin; continue; }
+      v = 0.0;
+    }
+    int32_t lo = 0, hi = n_bounds - 1;
+    while (lo < hi) {
+      int32_t mid = (lo + hi) >> 1;
+      if (v <= bounds[mid]) hi = mid; else lo = mid + 1;
+    }
+    out[i] = (uint16_t)lo;
+  }
+}
+
+}  // extern "C"

@@ -171,14 +171,10 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
             grad, hess = grad_fn(grad_at, jax.random.fold_in(grad_key0, it))
         else:
             grad, hess = grad_fn(grad_at)
-        if spec.quant_bins:
-            # odd stream ids — bagging/GOSS use even fold_in ids on key0
-            qkey = jax.random.fold_in(key0, it * 2 + 1) \
-                if spec.quant_stochastic else None
-            grad, hess = quantize_gradients(grad, hess, spec.quant_bins,
-                                            qkey)
         n = bins_fm.shape[1]
         if spec.use_goss:
+            # GOSS ranks EXACT gradients; quantization follows (reference
+            # order: sample strategy, then gradient discretizer)
             sw = goss_weights(it, key0, grad, hess, n,
                               top_rate=spec.top_rate,
                               other_rate=spec.other_rate,
@@ -189,6 +185,12 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
                                  bagging_freq=spec.bagging_freq)
         else:
             sw = jnp.ones((n,), jnp.float32)
+        if spec.quant_bins:
+            # odd stream ids — bagging/GOSS use even fold_in ids on key0
+            qkey = jax.random.fold_in(key0, it * 2 + 1) \
+                if spec.quant_stochastic else None
+            grad, hess = quantize_gradients(grad, hess, spec.quant_bins,
+                                            qkey)
         trees = []
         new_score = score
         new_vscores = list(vscores)
@@ -198,7 +200,8 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
             allowed = feature_mask(it, k, ff_key0, base_allowed,
                                    feature_fraction=spec.feature_fraction)
             tree_feat = feat
-            if spec.grower.feature_fraction_bynode < 1.0:
+            if spec.grower.feature_fraction_bynode < 1.0 \
+                    or spec.grower.extra_trees:
                 # same per-tree stream derivation as booster.__boost
                 tree_feat = {**feat, "ff_key": jax.random.fold_in(
                     jax.random.fold_in(ff_key0, 2 ** 20 + it), k)}

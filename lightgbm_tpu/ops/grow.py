@@ -87,6 +87,17 @@ class GrowerSpec(NamedTuple):
     # REAL feature count when the feat arrays are padded for distributed
     # block modes (0 = no padding); keeps bynode sampling exact
     num_features_hint: int = 0
+    # CEGB (ref: cost_effective_gradient_boosting.hpp): gain penalties
+    # per candidate; per-feature penalty vectors ride in
+    # feat["cegb_coupled"] / feat["cegb_lazy"] / feat["cegb_used"]
+    cegb_tradeoff: float = 0.0   # 0 = CEGB off
+    cegb_penalty_split: float = 0.0
+    cegb_coupled: bool = False
+    cegb_lazy: bool = False
+    # extremely randomized trees (ref: config.h extra_trees → the split
+    # search evaluates ONE random threshold per feature per node); shares
+    # the feat["ff_key"] per-tree RNG stream
+    extra_trees: bool = False
 
 
 class DeviceTree(NamedTuple):
@@ -283,14 +294,36 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                                                  tiled=True)
             return h
 
+        cegb_on = spec.cegb_tradeoff > 0.0 and \
+            (spec.cegb_penalty_split > 0.0 or spec.cegb_coupled
+             or spec.cegb_lazy)
+
+        def cegb_penalty(n_child, path_used):
+            """Per-feature gain penalty for a candidate split of a node
+            with `n_child` rows and `path_used` [F] features already on
+            its path (ref: CostEfficientGradientBoosting::DetlaGain —
+            split cost + once-per-model feature cost + per-row lazy
+            feature cost)."""
+            if not cegb_on:
+                return None
+            p = jnp.full((F,), spec.cegb_penalty_split * n_child,
+                         jnp.float32)
+            if spec.cegb_coupled:
+                p = p + feat["cegb_coupled"] * \
+                    (1.0 - feat["cegb_used"].astype(jnp.float32))
+            if spec.cegb_lazy:
+                p = p + feat["cegb_lazy"] * n_child * \
+                    (1.0 - path_used.astype(jnp.float32))
+            return spec.cegb_tradeoff * p
+
         def split_of(hist, g, h, c, node_allowed, lb, ub, p_out,
-                     cand_mask=None):
+                     cand_mask=None, penalty=None):
             with jax.named_scope("find_split"):
                 return _split_of(hist, g, h, c, node_allowed, lb, ub,
-                                 p_out, cand_mask)
+                                 p_out, cand_mask, penalty)
 
         def _split_of(hist, g, h, c, node_allowed, lb, ub, p_out,
-                      cand_mask=None):
+                      cand_mask=None, penalty=None):
             if spec.bundled:
                 hist = expand_bundled(hist, g, h, c)
             if block:
@@ -299,10 +332,14 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 if cand_mask is not None:
                     cand_mask = jax.lax.dynamic_slice_in_dim(
                         cand_mask, offset, Fb, axis=0)
+                if penalty is not None:
+                    penalty = jax.lax.dynamic_slice_in_dim(
+                        penalty, offset, Fb, axis=0)
             s = find(hist, g, h, c, bfeat["nb"], bfeat["missing"],
                      bfeat["default"], node_allowed, bfeat["is_cat"],
                      mono=bmono, out_lb=lb, out_ub=ub,
-                     parent_output=p_out, cand_mask=cand_mask)
+                     parent_output=p_out, cand_mask=cand_mask,
+                     gain_penalty=penalty)
             if block:
                 s = s._replace(feature=jnp.where(s.feature >= 0,
                                                  s.feature + offset,
@@ -326,6 +363,24 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         else:
             def bynode_mask(node_idx):
                 return jnp.ones((F,), bool)
+
+        if spec.extra_trees:
+            def extra_mask(node_idx):
+                """One random numerical threshold per feature per node
+                (ref: extra_trees — extremely randomized split search);
+                categorical features keep their full candidate sets."""
+                key = jax.random.fold_in(feat["ff_key"],
+                                         (1 << 24) + node_idx)
+                r = jax.random.uniform(key, (F,))
+                t_max = jnp.maximum(feat["nb"] - 2, 0)
+                pick = (r * (t_max + 1).astype(jnp.float32))\
+                    .astype(jnp.int32)
+                m = jnp.zeros((F, MB), bool)\
+                    .at[jnp.arange(F), jnp.clip(pick, 0, MB - 1)].set(True)
+                return m | feat["is_cat"][:, None]
+        else:
+            def extra_mask(node_idx):
+                return None
 
         # forced splits (BFS order), applied before best-gain growth
         n_forced = len(spec.forced_splits)
@@ -355,7 +410,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             allowed = allowed & jnp.any(feat["ic_groups"], axis=0)
         s0 = split_of(hist0, root_g, root_h, root_c,
                       allowed & bynode_mask(0),
-                      jnp.float32(-INF), jnp.float32(INF), root_out)
+                      jnp.float32(-INF), jnp.float32(INF), root_out,
+                      cand_mask=extra_mask(0),
+                      penalty=cegb_penalty(root_c, jnp.zeros((F,), bool)))
 
         # per-leaf histogram storage: one slot per leaf by default, or a
         # bounded LRU pool (ref: feature_histogram.hpp `HistogramPool`) —
@@ -408,9 +465,10 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             # used[p] = step of last touch (-1 sorts empty slots first)
             state["owner"] = jnp.full((P,), -1, jnp.int32).at[0].set(0)
             state["used"] = jnp.full((P,), -1, jnp.int32).at[0].set(0)
-        if spec.n_ic_groups:
+        track_used = spec.n_ic_groups > 0 or (cegb_on and spec.cegb_lazy)
+        if track_used:
             # features used on each leaf's root path (ref: col_sampler.hpp
-            # interaction-constraint filtering)
+            # interaction-constraint filtering; CEGB lazy feature costs)
             state["leaf_used"] = jnp.zeros((L, F), bool)
 
         def cond(st):
@@ -573,22 +631,28 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             deep_ok = (spec.max_depth <= 0) | (depth < spec.max_depth)
             child_allowed = allowed & deep_ok
             extra = {"owner": pool_owner, "used": pool_used} if pooled else {}
-            if spec.n_ic_groups:
-                # both children share the path's used-feature set; allowed =
-                # union of constraint groups that contain the whole path
+            child_used = None
+            if track_used:
+                # both children share the path's used-feature set
                 child_used = st["leaf_used"][best].at[f].set(True)
+                extra["leaf_used"] = st["leaf_used"].at[best]\
+                    .set(child_used).at[new].set(child_used)
+            if spec.n_ic_groups:
+                # allowed = union of constraint groups containing the path
                 groups = feat["ic_groups"]
                 ok_k = ~jnp.any(child_used[None, :] & ~groups, axis=1)
                 child_allowed = child_allowed & \
                     jnp.any(groups & ok_k[:, None], axis=0)
-                extra["leaf_used"] = st["leaf_used"].at[best]\
-                    .set(child_used).at[new].set(child_used)
             ls = split_of(lhist, lg, lh, lc,
                           child_allowed & bynode_mask(2 * step + 1),
-                          l_lb, l_ub, l_fin)
+                          l_lb, l_ub, l_fin,
+                          cand_mask=extra_mask(2 * step + 1),
+                          penalty=cegb_penalty(lc, child_used))
             rs = split_of(rhist, rg, rh, rc,
                           child_allowed & bynode_mask(2 * step + 2),
-                          r_lb, r_ub, r_fin)
+                          r_lb, r_ub, r_fin,
+                          cand_mask=extra_mask(2 * step + 2),
+                          penalty=cegb_penalty(rc, child_used))
 
             def put2(arr, a, b):
                 return arr.at[best].set(a).at[new].set(b)

@@ -106,7 +106,8 @@ def find_best_split(hist: Array,
                     out_ub: Array = None,
                     path_smooth: float = 0.0,
                     parent_output: Array = None,
-                    cand_mask: Array = None) -> SplitResult:
+                    cand_mask: Array = None,
+                    gain_penalty: Array = None) -> SplitResult:
     """Best split over all features of one leaf (numerical + categorical).
 
     `mono` [F] in {-1, 0, +1} plus scalar leaf output bounds [out_lb, out_ub]
@@ -256,6 +257,11 @@ def find_best_split(hist: Array,
 
     # ------------------------------------------------------------- decide
     gains = jnp.stack([gain0, gain1, gain2, gain3, gain4])       # [5, F, MB]
+    if gain_penalty is not None:
+        # CEGB feature-acquisition penalties (ref:
+        # cost_effective_gradient_boosting.hpp — subtracted from the split
+        # gain before selection); -inf candidates stay -inf
+        gains = gains - gain_penalty[None, :, None]
     if cand_mask is not None:
         # forced splits: only the designated (feature, bin) cell competes
         gains = jnp.where(cand_mask[None, :, :], gains, NEG_INF)

@@ -68,7 +68,13 @@ class Tree:
         # bin-level left-subset masks per cat split (training-side view used
         # by the device traversal; rebuilt from the bitset on model load)
         self.cat_bin_masks: np.ndarray = np.zeros((0, 0), dtype=bool)
+        # linear trees (ref: tree.h is_linear_ / LinearTreeLearner):
+        # leaf output = leaf_const + Σ leaf_coeff·x over leaf_features;
+        # rows with NaN in any leaf feature fall back to leaf_value
         self.is_linear = False
+        self.leaf_const = np.zeros(num_leaves, dtype=np.float64)
+        self.leaf_features: list = [[] for _ in range(num_leaves)]
+        self.leaf_coeff: list = [[] for _ in range(num_leaves)]
 
     # ------------------------------------------------------------ construct
     @classmethod
@@ -163,12 +169,56 @@ class Tree:
         t.leaf_count = np.asarray(dev.leaf_cnt)[:nl].astype(np.float64)
         return t
 
+    def leaf_path_features(self) -> list:
+        """Per-leaf NUMERICAL features on the root path, in path order
+        (ref: linear_tree_learner.cpp gathers the branch features)."""
+        paths = [[] for _ in range(self.num_leaves)]
+        if not self.num_internal():
+            return paths
+        # iterative traversal — leaf-wise trees can be num_leaves deep,
+        # which would blow Python's recursion limit
+        stack = [(0, [])]
+        while stack:
+            node, feats = stack.pop()
+            if node < 0:
+                paths[~node] = feats
+                continue
+            f = int(self.split_feature[node])
+            is_cat = (self.decision_type[node] & K_CATEGORICAL_MASK) != 0
+            nf = feats if (is_cat or f in feats) else feats + [f]
+            stack.append((int(self.left_child[node]), nf))
+            stack.append((int(self.right_child[node]), nf))
+        return paths
+
+    def linear_predict(self, X: np.ndarray, leaf_idx: np.ndarray
+                       ) -> np.ndarray:
+        """Linear-leaf outputs for rows routed to `leaf_idx`
+        (NaN in any leaf feature → constant fallback, ref: tree.cpp
+        linear prediction path)."""
+        out = np.empty(len(leaf_idx), dtype=np.float64)
+        for leaf in range(self.num_leaves):
+            rows = np.nonzero(leaf_idx == leaf)[0]
+            if not len(rows):
+                continue
+            feats = self.leaf_features[leaf]
+            if not feats:
+                out[rows] = self.leaf_const[leaf]
+                continue
+            Xl = X[np.ix_(rows, feats)].astype(np.float64)
+            ok = ~np.isnan(Xl).any(axis=1)
+            vals = self.leaf_const[leaf] + \
+                Xl @ np.asarray(self.leaf_coeff[leaf], np.float64)
+            out[rows] = np.where(ok, vals, self.leaf_value[leaf])
+        return out
+
     def add_bias(self, val: float) -> None:
         """ref: tree.h `Tree::AddBias` — folds boost_from_average init score
         into the (first) tree so the saved model is self-contained."""
         self.leaf_value = self.leaf_value + val
         if self.num_leaves > 1:
             self.internal_value = self.internal_value + val
+        if self.is_linear:
+            self.leaf_const = self.leaf_const + val
 
     # -------------------------------------------------------------- predict
     def _decide_left(self, node: np.ndarray, fval: np.ndarray) -> np.ndarray:
@@ -208,6 +258,8 @@ class Tree:
         n = X.shape[0]
         if self.num_leaves <= 1:
             return np.full(n, self.leaf_value[0] if len(self.leaf_value) else 0.0)
+        if self.is_linear:
+            return self.linear_predict(X, self.predict_leaf_index(X))
         node = np.zeros(n, dtype=np.int64)
         out = np.zeros(n, dtype=np.float64)
         active = np.ones(n, dtype=bool)
@@ -289,6 +341,15 @@ class Tree:
         else:
             arr("leaf_value", self.leaf_value)
         lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # ref: tree.cpp linear-model serialization (leaf_const +
+            # per-leaf feature/coefficient lists, flattened)
+            arr("leaf_const", self.leaf_const)
+            arr("num_features", [len(f) for f in self.leaf_features], str)
+            arr("leaf_features",
+                [f for fs in self.leaf_features for f in fs], str)
+            arr("leaf_coeff",
+                [c for cs in self.leaf_coeff for c in cs])
         lines.append(f"shrinkage={_fmt_g(self.shrinkage)}")
         lines.append("")
         return "\n".join(lines) + "\n"
@@ -340,6 +401,19 @@ class Tree:
             t.leaf_value = get("leaf_value", np.float64, nl)
         t.shrinkage = float(kv.get("shrinkage", 1.0))
         t.is_linear = bool(int(kv.get("is_linear", 0)))
+        if t.is_linear:
+            t.leaf_const = get("leaf_const", np.float64, nl)
+            counts = get("num_features", np.int64, nl)
+            flat_f = get("leaf_features", np.int64,
+                         int(counts.sum())).tolist()
+            flat_c = get("leaf_coeff", np.float64,
+                         int(counts.sum())).tolist()
+            pos = 0
+            for leaf, c in enumerate(counts):
+                c = int(c)
+                t.leaf_features[leaf] = [int(v) for v in flat_f[pos:pos + c]]
+                t.leaf_coeff[leaf] = list(flat_c[pos:pos + c])
+                pos += c
         return t
 
     def recompute_threshold_bins(self, bin_mappers: List[BinMapper]) -> None:

@@ -335,6 +335,15 @@ class BinMapper:
                 out = np.where(hit, sb[pos_c], 0).astype(np.int32)
             return out
         n_numeric = self.num_bin - (1 if self.missing_type == MISSING_TYPE_NAN else 0)
+        # native binary-search hot loop when the C library is built
+        # (ref: bin.h ValueToBin; the zero bin's ±kZeroThreshold bounds
+        # make the plain search reproduce the missing-zero routing)
+        if n_numeric >= 2 and len(self.bin_upper_bound) >= n_numeric:
+            from ..native import values_to_bins as _native_v2b
+            nb = _native_v2b(values, self.bin_upper_bound[:n_numeric],
+                             self.missing_type, self.num_bin - 1)
+            if nb is not None:
+                return nb.astype(np.int32)
         nan_mask = np.isnan(values)
         vals = np.where(nan_mask, 0.0, values)
         idx = np.searchsorted(self.bin_upper_bound[:n_numeric - 1], vals, side="left")

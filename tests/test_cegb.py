@@ -1,0 +1,73 @@
+"""Cost-Effective Gradient Boosting penalties
+(ref: cost_effective_gradient_boosting.hpp — split cost, once-per-model
+coupled feature cost, per-row lazy feature cost subtracted from gains)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=3000, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    # every feature mildly informative so penalties steer choices
+    y = X.sum(axis=1) * 0.5 + 0.5 * rng.randn(n)
+    return X, y
+
+
+class TestCEGB:
+    def test_split_penalty_prunes(self):
+        X, y = make_data()
+        base = lgb.train({"objective": "regression", "num_leaves": 31,
+                          "verbosity": -1}, lgb.Dataset(X, label=y),
+                         num_boost_round=3)
+        pen = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "cegb_tradeoff": 1.0,
+                         "cegb_penalty_split": 0.2, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        n_base = sum(t.num_leaves for t in base.trees)
+        n_pen = sum(t.num_leaves for t in pen.trees)
+        assert n_pen < n_base, (n_pen, n_base)
+        assert n_pen > len(pen.trees)  # still splits something
+
+    def test_coupled_penalty_concentrates_features(self):
+        X, y = make_data(seed=1)
+        base = lgb.train({"objective": "regression", "num_leaves": 15,
+                          "verbosity": -1}, lgb.Dataset(X, label=y),
+                         num_boost_round=8)
+        pen = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "cegb_tradeoff": 1.0,
+                         "cegb_penalty_feature_coupled":
+                             [50.0] * X.shape[1],
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+
+        def used_features(b):
+            s = set()
+            for t in b.trees:
+                s.update(t.split_feature[:t.num_internal()].tolist())
+            return s
+
+        # paying a large one-time cost per feature → reuse bought features
+        assert len(used_features(pen)) <= len(used_features(base))
+        assert pen.feature_importance().sum() > 0
+
+    def test_lazy_penalty_prefers_path_features(self):
+        X, y = make_data(seed=2)
+        pen = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "cegb_tradeoff": 1.0,
+                         "cegb_penalty_feature_lazy":
+                             [0.02] * X.shape[1],
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        assert pen.num_trees() == 5
+        mse = float(np.mean((pen.predict(X) - y) ** 2))
+        assert mse < float(np.var(y))
+
+    def test_no_warning_anymore(self, caplog):
+        import logging
+        X, y = make_data(400, seed=3)
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            lgb.train({"objective": "regression", "num_leaves": 4,
+                       "cegb_penalty_split": 0.01, "verbosity": 1},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        assert "NO effect" not in caplog.text

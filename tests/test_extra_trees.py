@@ -1,0 +1,54 @@
+"""Extremely randomized trees (ref: config.h extra_trees — the split
+search evaluates one RANDOM threshold per feature per node)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_data(n=3000, f=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = X[:, 0] * 2 - X[:, 1] + 0.3 * rng.randn(n)
+    return X, y
+
+
+class TestExtraTrees:
+    def test_differs_from_exact_and_learns(self):
+        X, y = make_data()
+        exact = lgb.train({"objective": "regression", "num_leaves": 15,
+                           "verbosity": -1}, lgb.Dataset(X, label=y),
+                          num_boost_round=10)
+        et = lgb.train({"objective": "regression", "num_leaves": 15,
+                        "extra_trees": True, "verbosity": -1},
+                       lgb.Dataset(X, label=y), num_boost_round=10)
+        assert not np.allclose(exact.predict(X), et.predict(X))
+        mse = float(np.mean((et.predict(X) - y) ** 2))
+        assert mse < 0.5 * float(np.var(y))
+
+    def test_deterministic_given_seed(self):
+        X, y = make_data(seed=1)
+        params = {"objective": "regression", "num_leaves": 7,
+                  "extra_trees": True, "feature_fraction_seed": 7,
+                  "verbosity": -1}
+        a = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+        b = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                      num_boost_round=5)
+        np.testing.assert_array_equal(a.predict(X), b.predict(X))
+
+    def test_chunked_matches_periter(self):
+        import lightgbm_tpu.booster as booster_mod
+        X, y = make_data(seed=2)
+        params = {"objective": "regression", "num_leaves": 15,
+                  "extra_trees": True, "verbosity": -1}
+        bc = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=16)
+        old = booster_mod.Booster._BULK_CHUNK
+        booster_mod.Booster._BULK_CHUNK = 10 ** 9
+        try:
+            bp = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                           num_boost_round=16)
+        finally:
+            booster_mod.Booster._BULK_CHUNK = old
+        np.testing.assert_allclose(bc.predict(X), bp.predict(X),
+                                   rtol=1e-6, atol=1e-8)

@@ -1,10 +1,20 @@
 """Accepted-but-inert params must warn, never silently no-op
-(ref: config.cpp Config::CheckParamConflict warns-and-corrects)."""
+(ref: config.cpp Config::CheckParamConflict warns-and-corrects).
+
+Every previously-inert param has landed, so the warning mechanism itself is
+tested by temporarily marking a real param as inert."""
 import logging
 
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
+from lightgbm_tpu.booster import Booster
+
+
+@pytest.fixture
+def fake_inert(monkeypatch):
+    monkeypatch.setattr(Booster, "_INERT_PARAMS", ("extra_trees",))
 
 
 def _train(params, caplog):
@@ -17,16 +27,20 @@ def _train(params, caplog):
     return caplog.text
 
 
-def test_inert_param_warns(caplog):
-    text = _train({"linear_tree": True}, caplog)
-    assert "linear_tree" in text and "NO effect" in text
+def test_inert_param_warns(fake_inert, caplog):
+    text = _train({"extra_trees": True}, caplog)
+    assert "extra_trees" in text and "NO effect" in text
 
 
-def test_default_value_does_not_warn(caplog):
-    text = _train({"linear_tree": False}, caplog)
+def test_default_value_does_not_warn(fake_inert, caplog):
+    text = _train({"extra_trees": False}, caplog)
     assert "NO effect" not in text
 
 
-def test_unset_param_does_not_warn(caplog):
-    text = _train({}, caplog)
+def test_nothing_is_inert_anymore(caplog):
+    """The real inert list is EMPTY — every accepted param acts."""
+    assert Booster._INERT_PARAMS == ()
+    text = _train({"extra_trees": True, "linear_tree": True,
+                   "use_quantized_grad": True,
+                   "cegb_penalty_split": 0.01}, caplog)
     assert "NO effect" not in text

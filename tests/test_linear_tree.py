@@ -1,0 +1,84 @@
+"""Linear trees (ref: linear_tree_learner.cpp `LinearTreeLearner` — leaves
+hold ridge-fit linear models over their path features; rows with NaN in a
+path feature fall back to the constant leaf output)."""
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def make_pwlinear(n=3000, seed=0):
+    """Piecewise-LINEAR target in the SPLIT variable — leaves are linear in
+    a feature that is on their path, so linear leaves should crush
+    constant ones (leaf models only see path features, like the
+    reference)."""
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4)
+    y = np.where(X[:, 0] > 0, 2.0 * X[:, 0] + 1.0, -1.5 * X[:, 0] - 0.5)
+    y = y + 0.1 * rng.randn(n)
+    return X, y
+
+
+class TestLinearTree:
+    def test_beats_constant_leaves_on_piecewise_linear(self):
+        X, y = make_pwlinear()
+        # few leaves: constant leaves staircase a linear target badly,
+        # linear leaves are near-exact once a split lands near the kink
+        const = lgb.train({"objective": "regression", "num_leaves": 4,
+                           "min_data_in_leaf": 50, "learning_rate": 1.0,
+                           "verbosity": -1},
+                          lgb.Dataset(X, label=y), num_boost_round=5)
+        lin = lgb.train({"objective": "regression", "num_leaves": 4,
+                         "min_data_in_leaf": 50, "learning_rate": 1.0,
+                         "linear_tree": True, "linear_lambda": 0.01,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        assert lin.trees[0].is_linear
+        mse_c = float(np.mean((const.predict(X) - y) ** 2))
+        mse_l = float(np.mean((lin.predict(X) - y) ** 2))
+        assert mse_l < 0.5 * mse_c, (mse_l, mse_c)
+
+    def test_model_text_roundtrip(self):
+        X, y = make_pwlinear(seed=1)
+        lin = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "linear_tree": True, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        s1 = lin.model_to_string(num_iteration=-1)
+        b2 = lgb.Booster(model_str=s1)
+        assert b2.trees[0].is_linear
+        np.testing.assert_allclose(b2.predict(X), lin.predict(X), rtol=1e-9)
+        assert s1 == b2.model_to_string(num_iteration=-1)
+
+    def test_nan_rows_fall_back_to_constant(self):
+        X, y = make_pwlinear(seed=2)
+        X[::7, 1] = np.nan
+        lin = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "linear_tree": True, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        p = lin.predict(X)
+        assert np.all(np.isfinite(p))
+
+    def test_valid_set_and_early_stopping(self):
+        X, y = make_pwlinear(seed=3)
+        Xv, yv = make_pwlinear(800, seed=4)
+        rec = {}
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "linear_tree": True, "metric": "l2",
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=40,
+                        valid_sets=[lgb.Dataset(Xv, label=yv)],
+                        callbacks=[lgb.early_stopping(5, verbose=False),
+                                   lgb.record_evaluation(rec)])
+        curve = rec["valid_0"]["l2"]
+        assert curve[-1] < curve[0]
+        mse = float(np.mean((bst.predict(Xv) - yv) ** 2))
+        # recorded final metric must match out-of-band prediction
+        assert abs(mse - min(curve)) / max(min(curve), 1e-9) < 0.2
+
+    def test_no_warning_anymore(self, caplog):
+        import logging
+        X, y = make_pwlinear(400, seed=5)
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            lgb.train({"objective": "regression", "linear_tree": True,
+                       "num_leaves": 4, "verbosity": 1},
+                      lgb.Dataset(X, label=y), num_boost_round=1)
+        assert "NO effect" not in caplog.text

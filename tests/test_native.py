@@ -1,0 +1,98 @@
+"""Native C++ data path (lightgbm_tpu/native): text parsing + bin-mapping
+hot loops with numpy-parity contracts (ref: src/io/parser.cpp,
+bin.h BinMapper::ValueToBin).  Skipped when no g++ toolchain exists."""
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.native import (get_lib, parse_dense, parse_libsvm,
+                                 values_to_bins)
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native toolchain unavailable")
+
+
+def test_parse_csv_matches_numpy(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.randn(500, 6)
+    data[::17, 2] = np.nan
+    p = str(tmp_path / "d.csv")
+    np.savetxt(p, data, delimiter=",", fmt="%.10g")
+    out, had_header = parse_dense(p)
+    assert not had_header
+    np.testing.assert_allclose(out, data, rtol=1e-9, equal_nan=True)
+
+
+def test_parse_tsv_with_header(tmp_path):
+    data = np.arange(12, dtype=np.float64).reshape(4, 3)
+    p = str(tmp_path / "d.tsv")
+    with open(p, "w") as f:
+        f.write("a\tb\tc\n")
+        for row in data:
+            f.write("\t".join(str(v) for v in row) + "\n")
+    out, had_header = parse_dense(p)
+    assert had_header
+    np.testing.assert_array_equal(out, data)
+
+
+def test_parse_libsvm(tmp_path):
+    p = str(tmp_path / "d.svm")
+    with open(p, "w") as f:
+        f.write("1.5 1:0.5 3:2.0\n")
+        f.write("-1 2:1.25\n")
+        f.write("0 1:1 2:2 3:3\n")
+    out = parse_libsvm(p)
+    expect = np.array([[1.5, 0.5, 0.0, 2.0],
+                       [-1.0, 0.0, 1.25, 0.0],
+                       [0.0, 1.0, 2.0, 3.0]])
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_parse_libsvm_zero_based(tmp_path):
+    """0-based index files are auto-detected by the probe pass (feature 0
+    must not be silently dropped)."""
+    p = str(tmp_path / "d0.svm")
+    with open(p, "w") as f:
+        f.write("1 0:7.0 2:2.0\n")
+        f.write("0 1:1.25\n")
+    out = parse_libsvm(p)
+    expect = np.array([[1.0, 7.0, 0.0, 2.0],
+                       [0.0, 0.0, 1.25, 0.0]])
+    np.testing.assert_array_equal(out, expect)
+
+
+def test_values_to_bins_matches_numpy_mapper():
+    from lightgbm_tpu.utils.binning import BinMapper
+    rng = np.random.RandomState(1)
+    vals = np.concatenate([rng.randn(5000),
+                           np.zeros(500), [np.nan] * 100])
+    rng.shuffle(vals)
+    m = BinMapper()
+    m.find_bin(vals, len(vals), 63, min_data_in_bin=3, bin_type=0,
+               use_missing=True, zero_as_missing=False)
+    got = m.values_to_bins(vals)  # routes through native when built
+    # force the numpy path for comparison
+    import lightgbm_tpu.native as native_mod
+    saved = native_mod._lib, native_mod._tried
+    native_mod._lib, native_mod._tried = None, True
+    try:
+        want = m.values_to_bins(vals)
+    finally:
+        native_mod._lib, native_mod._tried = saved
+    np.testing.assert_array_equal(got, want)
+
+
+def test_cli_train_with_native_parser(tmp_path):
+    import lightgbm_tpu.cli as cli
+    rng = np.random.RandomState(2)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] > 0).astype(np.float64)
+    train = np.column_stack([y, X])
+    p = str(tmp_path / "train.csv")
+    np.savetxt(p, train, delimiter=",", fmt="%.8g")
+    model = str(tmp_path / "model.txt")
+    rc = cli.run([f"task=train", f"data={p}", "objective=binary",
+                  "num_leaves=7", "num_iterations=3", "verbosity=-1",
+                  f"output_model={model}"])
+    assert rc == 0 and os.path.exists(model)

@@ -55,13 +55,60 @@ def make_higgs_like(n, f, seed=77):
     return X, y
 
 
+def _probe_backend_subprocess(timeout_s: int = 150) -> bool:
+    """Probe backend init in a THROWAWAY subprocess with a hard timeout —
+    a wedged remote-TPU (axon) worker makes jax.devices() hang forever,
+    which would otherwise eat the whole driver bench budget and record
+    nothing (the round-1 failure mode, and the wedge observed in round 2)."""
+    import subprocess
+    code = ("import jax; d = jax.devices(); "
+            "import jax.numpy as jnp; "
+            "x = jnp.ones((64,64)); (x@x).block_until_ready(); "
+            "print(d[0].platform)")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout_s,
+                           env=dict(os.environ))
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        print(f"[bench] backend probe HUNG (> {timeout_s}s) — treating as "
+              "unavailable", file=sys.stderr)
+        return False
+    except OSError:
+        return False
+
+
 def _init_backend():
     """Init the JAX backend; on failure retry in a FRESH interpreter (JAX
     caches backend state in-process, so an in-process retry would silently
     return the cached CPU backend) and finally fall back to CPU.
+    A subprocess probe with a hard timeout runs FIRST so a hung backend
+    init cannot stall the bench forever.
     Returns (jax, backend_desc)."""
     attempts = int(os.environ.get("BENCH_BACKEND_ATTEMPTS", 4))
     attempt = int(os.environ.get("BENCH_BACKEND_ATTEMPT", 0))
+    if not os.environ.get("BENCH_CPU_FALLBACK") and \
+            not os.environ.get("BENCH_PROBE_OK"):
+        if _probe_backend_subprocess():
+            os.environ["BENCH_PROBE_OK"] = "1"
+        else:
+            env = dict(os.environ)
+            if attempt + 1 < attempts:
+                print(f"[bench] probe attempt {attempt + 1}/{attempts} "
+                      "failed; retrying in 20s", file=sys.stderr)
+                time.sleep(20)
+                env["BENCH_BACKEND_ATTEMPT"] = str(attempt + 1)
+            else:
+                print("[bench] backend unavailable after probes; re-exec "
+                      "on CPU", file=sys.stderr)
+                sys.path.insert(0,
+                                os.path.dirname(os.path.abspath(__file__)))
+                from lightgbm_tpu.utils.env import cleaned_cpu_env
+                env = cleaned_cpu_env(env, 1)
+                env["BENCH_CPU_FALLBACK"] = "1"
+            sys.stdout.flush()
+            sys.stderr.flush()
+            os.execve(sys.executable, [sys.executable] + sys.argv, env)
     try:
         import jax
         devs = jax.devices()

@@ -281,6 +281,7 @@ class Booster:
             cegb_lazy=bool(list(
                 self.config.cegb_penalty_feature_lazy or [])),
             extra_trees=self.config.extra_trees,
+            voting_top_k=self.config.top_k,
         )
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
@@ -491,17 +492,37 @@ class Booster:
             self._train_bins = train_src
             self._learner_cache_key = None
             return
+        dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
+        use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
+        if dcn > 1 and not use_2level:
+            log.warning(f"cannot build a 2-level mesh from {shards} "
+                        f"device(s) with tpu_dcn_slices={dcn} (need an "
+                        "even division with >= 2 devices per slice); "
+                        "using a flat mesh")
+        # re-resolve with the mesh shape known — feature-parallel
+        # downgrades on 2-level meshes BEFORE placement
+        kind = resolve_tree_learner(cfg.tree_learner or "serial",
+                                    bundled=self._dd.efb is not None,
+                                    two_level=use_2level)
         # reset_parameter (lr schedules) calls this every iteration — reuse
         # the compiled grower and placed bins when nothing changed
-        key = (self._grower_spec, kind, shards)
+        key = (self._grower_spec, kind, shards, dcn if use_2level else 1)
         if getattr(self, "_learner_cache_key", None) == key:
             return
         from .parallel import get_mesh
         from .parallel.learner import make_distributed_grower, \
             place_training_data
-        self._mesh = get_mesh(shards)
+        if use_2level:
+            # 2-level mesh: heavy histogram traffic rides the ICI axis,
+            # slices exchange only reduced blocks over DCN (SURVEY §2.7.5)
+            from .parallel.mesh import get_mesh_2level
+            self._mesh = get_mesh_2level(dcn, shards // dcn)
+        else:
+            self._mesh = get_mesh(shards)
         self._train_bins = place_training_data(
-            np.asarray(train_src), self._mesh, kind)
+            np.asarray(train_src), self._mesh, kind,
+            pad_features=(kind in ("data", "feature")
+                          and self._dd.efb is None))
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
             self._dd.num_feature, self._dd.num_data)
@@ -985,7 +1006,6 @@ class Booster:
         round-trip (ref: dart.hpp `DART::Normalize`)."""
         cfg = self.config
         ok = (self._fobj is None and self.objective_ is not None
-              and getattr(self, "_mesh", None) is None
               and self._boost_mode in ("gbdt", "rf")
               # CEGB coupled penalties mutate per-model host state;
               # linear-leaf ridge fits run on the host raw matrix
@@ -1039,14 +1059,21 @@ class Booster:
 
     def _bulk_trainer(self, spec):
         from .ops.fused import make_bulk_trainer
-        if getattr(self, "_bulk_spec", None) != spec:
+        # the cache key includes the learner so switching tree_learner /
+        # mesh via reset_parameter rebuilds the trainer closure
+        key = (spec, getattr(self, "_learner_cache_key", None))
+        if getattr(self, "_bulk_key", None) != key:
             grad = self._grad_rng_fn if spec.needs_rng else self._grad_fn
             renew_args = None
             if spec.renew_alpha >= 0.0:
                 renew_args = (self._dd.label, self._renew_base()[1])
+            # distributed meshes plug the shard_map'ped grower into the
+            # chunk trainer — multi-chip training also fuses
+            grow_fn = self._grower if self._mesh is not None else None
             self._bulk_trainer_cache = make_bulk_trainer(spec, grad,
-                                                         renew_args)
-            self._bulk_spec = spec
+                                                         renew_args,
+                                                         grow_fn)
+            self._bulk_key = key
         return self._bulk_trainer_cache
 
     def _run_chunk(self, spec):

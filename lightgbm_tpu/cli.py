@@ -85,13 +85,20 @@ def load_data_file(path: str, config: Config
         has_header = True
     from .native import parse_dense, parse_libsvm
     if fmt == "libsvm":
-        data = parse_libsvm(path)  # index base auto-detected by the probe
+        try:
+            data = parse_libsvm(path)  # index base auto-detected
+        except ValueError:
+            data = None  # malformed for the strict parser → sklearn
         if data is not None:
             return data[:, 1:].copy(), data[:, 0].copy()
         from sklearn.datasets import load_svmlight_file
         X, y = load_svmlight_file(path)
         return np.asarray(X.todense(), dtype=np.float64), y
-    native = parse_dense(path)
+    try:
+        native = parse_dense(path)
+    except ValueError:
+        # e.g. text cells mid-file — genfromtxt maps those to NaN
+        native = None
     if native is not None:
         data, native_skipped_header = native
         if (has_header or config.header) and not native_skipped_header:

@@ -130,7 +130,8 @@ class BulkSpec(NamedTuple):
     quant_stochastic: bool = True
 
 
-def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
+def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None,
+                      grow_fn: Callable = None):
     """Build the jitted chunk trainer.
 
     grad_fn(score) -> (grad, hess) (or grad_fn(score, key) when
@@ -152,7 +153,10 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
     """
     from .predict import replay_leaf_ids
 
-    grow = make_grower(spec.grower)
+    # grow_fn: an alternative grower with the serial signature — the
+    # distributed shard_map'ped learner plugs in here, so multi-chip
+    # training gets the same one-sync-per-chunk behavior
+    grow = grow_fn if grow_fn is not None else make_grower(spec.grower)
     K = spec.num_class
     lr = 1.0 if spec.rf else spec.learning_rate
     if spec.renew_alpha >= 0.0:
@@ -171,7 +175,9 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None):
             grad, hess = grad_fn(grad_at, jax.random.fold_in(grad_key0, it))
         else:
             grad, hess = grad_fn(grad_at)
-        n = bins_fm.shape[1]
+        # row count from the score, NOT bins_fm — the distributed grower's
+        # bin matrix is pre-padded to the mesh shard multiple
+        n = score.shape[0]
         if spec.use_goss:
             # GOSS ranks EXACT gradients; quantization follows (reference
             # order: sample strategy, then gradient discretizer)

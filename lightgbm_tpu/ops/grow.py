@@ -98,6 +98,9 @@ class GrowerSpec(NamedTuple):
     # search evaluates ONE random threshold per feature per node); shares
     # the feat["ff_key"] per-tree RNG stream
     extra_trees: bool = False
+    # voting-parallel (PV-Tree) local top-k (ref: config.h top_k /
+    # voting_parallel_tree_learner.cpp)
+    voting_top_k: int = 20
 
 
 class DeviceTree(NamedTuple):
@@ -180,10 +183,23 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
       `Network::ReduceScatter` — so each shard scans only its F/S feature
       block for splits, then the winning `SplitInfo` is allreduce-maxed.
       Requires F % n_shards == 0 (callers pad features).
+
+    `axis_name` may be a TUPLE of mesh axes for a 2-level ("dcn", "ici")
+    mesh (multi-slice training): histograms reduce-scatter over the LAST
+    (ICI) axis and allreduce over the leading (DCN) axes — feature blocks
+    ride the fast interconnect, slices exchange only summed blocks, the
+    SplitInfo max reduces over ICI only (DCN replicas are identical after
+    the block psum).  `n_shards` is the LAST axis size.
     - "feature" (ref: feature_parallel_tree_learner.cpp): every shard holds
       ALL rows (bins replicated), searches only its feature block, and the
       winning SplitInfo is allreduce-maxed; split application is local on
       every shard since all rows are present.  Requires F % n_shards == 0.
+    - "voting" (ref: voting_parallel_tree_learner.cpp, PV-Tree): rows
+      sharded; each shard votes its local top-k features (local gains on
+      its row shard, size constraints scaled by 1/shards), the global top
+      2k by votes are elected, and ONLY those features' histograms are
+      psummed — communication drops from O(F·MB) to O(2k·MB), the
+      strategy for DCN-crossing meshes.  `n_shards` = total shard count.
     """
     L = spec.num_leaves
     MB = spec.max_bin
@@ -198,12 +214,34 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         max_cat_threshold=spec.max_cat_threshold,
         max_cat_to_onehot=spec.max_cat_to_onehot,
         path_smooth=spec.path_smooth)
+    # voting: local votes use the shard's row subset, so size constraints
+    # scale by 1/shards (ref: VotingParallelTreeLearner ctor divides
+    # min_data_in_leaf / min_sum_hessian by num_machines)
+    find_local_vote = functools.partial(
+        find_best_split,
+        l1=spec.lambda_l1, l2=spec.lambda_l2,
+        min_data_in_leaf=spec.min_data_in_leaf / max(n_shards, 1),
+        min_sum_hessian=spec.min_sum_hessian_in_leaf / max(n_shards, 1),
+        min_gain_to_split=spec.min_gain_to_split,
+        max_delta_step=spec.max_delta_step,
+        cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
+        max_cat_threshold=spec.max_cat_threshold,
+        max_cat_to_onehot=spec.max_cat_to_onehot,
+        path_smooth=spec.path_smooth, want_feature_gains=True)
 
     def clamp_output(g, h):
         return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
                            spec.max_delta_step)
 
+    # 2-level mesh: leading axes are DCN (cross-slice), last axis is ICI
+    axes_all = axis_name if isinstance(axis_name, tuple) else \
+        ((axis_name,) if axis_name is not None else None)
+    axis_last = axes_all[-1] if axes_all else None
+    axes_dcn = axes_all[:-1] if axes_all else ()
     block = axis_name is not None and mode in ("data_rs", "feature")
+    if block and axes_dcn and mode == "feature":
+        raise ValueError("feature-parallel over a 2-level mesh is not "
+                         "supported; use the data strategy")
     if spec.bundled and block:
         raise ValueError("EFB bundling requires mode='data' for "
                          "distributed growers (bundle columns do not align "
@@ -260,7 +298,7 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                     f"{mode} learner requires features ({F}) divisible by "
                     f"shards ({n_shards}); pad features first")
             Fb = F // n_shards
-            offset = jax.lax.axis_index(axis_name) * Fb
+            offset = jax.lax.axis_index(axis_last) * Fb
 
             def bslice(x):
                 return jax.lax.dynamic_slice_in_dim(x, offset, Fb, axis=0)
@@ -285,13 +323,16 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                     h = leaf_histogram(hist_bins, payload, mask_rows, HB)
                 if axis_name is not None:
                     if mode == "data":
-                        h = jax.lax.psum(h, axis_name)
+                        h = jax.lax.psum(h, axes_all)
                     elif mode == "data_rs":
                         # ref: Network::ReduceScatter of histogram buffers —
                         # each shard receives the summed block it will scan
-                        h = jax.lax.psum_scatter(h, axis_name,
+                        # (over ICI); DCN slices then allreduce the block
+                        h = jax.lax.psum_scatter(h, axis_last,
                                                  scatter_dimension=0,
                                                  tiled=True)
+                        if axes_dcn:
+                            h = jax.lax.psum(h, axes_dcn)
             return h
 
         cegb_on = spec.cegb_tradeoff > 0.0 and \
@@ -324,6 +365,35 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
 
         def _split_of(hist, g, h, c, node_allowed, lb, ub, p_out,
                       cand_mask=None, penalty=None):
+            if axis_name is not None and mode == "voting" \
+                    and cand_mask is not None:
+                # forced splits bypass the vote (the reference forces
+                # regardless of search heuristics): sum the full histogram
+                # so the designated cell sees GLOBAL stats
+                hist = jax.lax.psum(hist, axes_all)
+            elif axis_name is not None and mode == "voting":
+                # PV-Tree (ref: voting_parallel_tree_learner.cpp): each
+                # shard votes its local top-k features, the global top-2k
+                # by votes are elected, and only THOSE histograms are
+                # summed across shards — O(2k·MB) traffic instead of
+                # O(F·MB)
+                ltot = hist.sum(axis=1)[0]            # local (g, h, cnt)
+                fg = find_local_vote(hist, ltot[0], ltot[1], ltot[2],
+                                     bfeat["nb"], bfeat["missing"],
+                                     bfeat["default"], node_allowed,
+                                     bfeat["is_cat"], mono=bmono)
+                k = min(spec.voting_top_k, F)
+                top_idx = jax.lax.top_k(fg, k)[1]
+                votes = jnp.zeros((F,), jnp.float32).at[top_idx].set(1.0)
+                votes = jax.lax.psum(votes, axes_all)
+                # deterministic election: votes desc, feature index asc
+                vote_key = votes * (F + 1.0) \
+                    - jnp.arange(F, dtype=jnp.float32)
+                elected = jax.lax.top_k(vote_key, min(2 * k, F))[1]
+                sel = jax.lax.psum(hist[elected], axes_all)
+                hist = jnp.zeros_like(hist).at[elected].set(sel)
+                node_allowed = node_allowed & \
+                    jnp.zeros((F,), bool).at[elected].set(True)
             if spec.bundled:
                 hist = expand_bundled(hist, g, h, c)
             if block:
@@ -344,7 +414,7 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 s = s._replace(feature=jnp.where(s.feature >= 0,
                                                  s.feature + offset,
                                                  s.feature))
-                s = _merge_split_across_shards(s, axis_name, n_shards)
+                s = _merge_split_across_shards(s, axis_last, n_shards)
             return s
 
         # per-node column sampling (ref: col_sampler.hpp GetByNode); node
@@ -401,9 +471,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         if axis_name is not None and mode != "feature":
             # ref: DataParallelTreeLearner::BeforeTrain root-stat Allreduce
             # (feature mode holds all rows on every shard — already global)
-            root_g = jax.lax.psum(root_g, axis_name)
-            root_h = jax.lax.psum(root_h, axis_name)
-            root_c = jax.lax.psum(root_c, axis_name)
+            root_g = jax.lax.psum(root_g, axes_all)
+            root_h = jax.lax.psum(root_h, axes_all)
+            root_c = jax.lax.psum(root_c, axes_all)
         root_out = clamp_output(root_g, root_h)
         if spec.n_ic_groups:
             # only features inside some constraint group may ever split

@@ -107,7 +107,8 @@ def find_best_split(hist: Array,
                     path_smooth: float = 0.0,
                     parent_output: Array = None,
                     cand_mask: Array = None,
-                    gain_penalty: Array = None) -> SplitResult:
+                    gain_penalty: Array = None,
+                    want_feature_gains: bool = False):
     """Best split over all features of one leaf (numerical + categorical).
 
     `mono` [F] in {-1, 0, +1} plus scalar leaf output bounds [out_lb, out_ub]
@@ -265,6 +266,10 @@ def find_best_split(hist: Array,
     if cand_mask is not None:
         # forced splits: only the designated (feature, bin) cell competes
         gains = jnp.where(cand_mask[None, :, :], gains, NEG_INF)
+    if want_feature_gains:
+        # per-feature best gain — the voting-parallel learner's local vote
+        # (ref: voting_parallel_tree_learner.cpp local FindBestSplits)
+        return gains.max(axis=(0, 2))
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain = flat[best]

@@ -8,5 +8,6 @@ plumbing collapse into `jax.sharding.Mesh` + XLA collectives over ICI/DCN.
 `init()` replaces the whole `machines`/`local_listen_port`/Dask
 port-negotiation dance (ref: python-package/lightgbm/dask.py `_train`).
 """
-from .mesh import get_mesh, init  # noqa: F401
+from .mesh import get_mesh, get_mesh_2level, init  # noqa: F401
 from .data_parallel import make_sharded_train_step, shard_dataset  # noqa: F401
+from .learner import make_distributed_grower, resolve_tree_learner  # noqa: F401,E501

@@ -44,16 +44,23 @@ TREE_LEARNER_ALIASES = {
 }
 
 
-def resolve_tree_learner(name: str, bundled: bool = False) -> str:
+def resolve_tree_learner(name: str, bundled: bool = False,
+                         two_level: bool = False) -> str:
     """Canonicalize the tree_learner param (ref: config.cpp
-    `Config::GetTreeLearnerType`).  With EFB bundling, feature-parallel is
-    downgraded to data-parallel HERE so data placement and grower padding
-    agree on the strategy (bundle columns don't align with feature blocks)."""
+    `Config::GetTreeLearnerType`).  Downgrades happen HERE — before data
+    placement — so placement and grower padding always agree on the
+    strategy: feature-parallel falls back to data-parallel under EFB
+    (bundle columns don't align with feature blocks) and on 2-level
+    meshes (feature blocks ride a single ICI axis)."""
     kind = TREE_LEARNER_ALIASES.get(str(name).lower())
     if kind is None:
         raise ValueError(f"Unknown tree learner type {name}")
     if bundled and kind == "feature":
         log.warning("tree_learner=feature with EFB bundling falls back "
+                    "to the data-parallel strategy")
+        kind = "data"
+    if two_level and kind == "feature":
+        log.warning("tree_learner=feature over a 2-level mesh falls back "
                     "to the data-parallel strategy")
         kind = "data"
     return kind
@@ -68,13 +75,14 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
     vectors itself.  Returns `grow(bins_fm, grad [N], hess [N], sw [N],
     feat, allowed) -> DeviceTree` with `leaf_id` of length N.
     """
-    axis = mesh.axis_names[0]
-    S = int(mesh.shape[axis])
-    mode = {"data": "data_rs", "voting": "data_rs", "feature": "feature"}[kind]
-    if kind == "voting":
-        log.warning("tree_learner=voting is served by the data-parallel "
-                    "strategy on TPU (full histogram reduce-scatter rides "
-                    "ICI; PV-Tree's traffic cut targets commodity ethernet)")
+    axes = tuple(mesh.axis_names)     # ("data",) or ("dcn", "ici")
+    S_last = int(mesh.shape[axes[-1]])
+    S_total = 1
+    for a in axes:
+        S_total *= int(mesh.shape[a])
+    mode = {"data": "data_rs", "voting": "voting", "feature": "feature"}[kind]
+    assert not (kind == "feature" and len(axes) > 1), \
+        "feature kind must be downgraded before placement (2-level mesh)"
     if spec.bundled:
         # bundle columns don't align with per-feature blocks — use the
         # full-histogram psum strategy (still row-sharded).  feature kind
@@ -83,20 +91,27 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         assert kind != "feature", \
             "feature kind must be downgraded before placement (EFB)"
         mode = "data"
-    f_extra = (padded_feature_count(num_feature, S) - num_feature) \
+    # feature blocks split over the LAST (ICI) axis only; rows shard over
+    # the whole mesh
+    f_extra = (padded_feature_count(num_feature, S_last) - num_feature) \
         if mode in ("data_rs", "feature") else 0
-    n_extra = (padded_row_count(num_data, S) - num_data) \
+    n_extra = (padded_row_count(num_data, S_total) - num_data) \
         if mode != "feature" else 0
-    grow = make_grower(spec, axis_name=axis, mode=mode, n_shards=S)
+    # block modes split features over the last (ICI) axis; voting's local
+    # vote scales size constraints by the TOTAL shard count
+    grow = make_grower(spec,
+                       axis_name=axes if len(axes) > 1 else axes[0],
+                       mode=mode,
+                       n_shards=S_total if mode == "voting" else S_last)
 
-    row_sp = P(axis) if mode != "feature" else P(None)
+    row_sp = P(axes) if mode != "feature" else P(None)
     tree_specs = DeviceTree(
         n_splits=P(), split_leaf=P(), split_feature=P(), threshold_bin=P(),
         default_left=P(), split_is_cat=P(), split_cat_mask=P(),
         split_gain=P(), internal_g=P(), internal_h=P(), internal_cnt=P(),
         leaf_value=P(), leaf_g=P(), leaf_h=P(), leaf_cnt=P(),
         leaf_id=row_sp)
-    in_specs = (P(None, axis) if mode != "feature" else P(None, None),
+    in_specs = (P(None, axes) if mode != "feature" else P(None, None),
                 row_sp, row_sp, row_sp, P(None), P(None))
     sharded = jax.shard_map(grow, mesh=mesh, in_specs=in_specs,
                             out_specs=tree_specs, check_vma=False)
@@ -131,20 +146,26 @@ def padded_row_count(num_data: int, shards: int) -> int:
     return -(-num_data // shards) * shards
 
 
-def place_training_data(bins_fm, mesh: Mesh, kind: str):
+def place_training_data(bins_fm, mesh: Mesh, kind: str,
+                        pad_features: bool = True):
     """Pad the bin matrix to mesh-divisible shape and place it: rows
     sharded for data/voting, replicated for feature (ref: the reference's
     per-rank pre-partitioned files / full per-rank copies).  One-time cost;
-    the per-iteration jit then never re-transfers the big array."""
+    the per-iteration jit then never re-transfers the big array.
+    `pad_features` only for the block strategies (data_rs/feature) —
+    voting and bundled-data keep the original column count."""
     import numpy as np
-    axis = mesh.axis_names[0]
-    S = int(mesh.shape[axis])
+    axes = tuple(mesh.axis_names)
+    S_last = int(mesh.shape[axes[-1]])
+    S_total = 1
+    for a in axes:
+        S_total *= int(mesh.shape[a])
     f, n = bins_fm.shape
-    f_pad = padded_feature_count(f, S)
-    n_pad = padded_row_count(n, S) if kind != "feature" else n
+    f_pad = padded_feature_count(f, S_last) if pad_features else f
+    n_pad = padded_row_count(n, S_total) if kind != "feature" else n
     if (f_pad, n_pad) != (f, n):
         out = np.zeros((f_pad, n_pad), dtype=np.asarray(bins_fm).dtype)
         out[:f, :n] = np.asarray(bins_fm)
         bins_fm = out
-    sp = P(None, axis) if kind != "feature" else P(None, None)
+    sp = P(None, axes) if kind != "feature" else P(None, None)
     return jax.device_put(bins_fm, NamedSharding(mesh, sp))

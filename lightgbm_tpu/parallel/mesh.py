@@ -48,3 +48,28 @@ def get_mesh(num_shards: int = 0, axis: str = "data",
                 f"({len(devs)})")
         devs = devs[:num_shards]
     return Mesh(np.array(devs), (axis,))
+
+
+def get_mesh_2level(n_dcn: int, n_ici: int = 0,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """2-level ("dcn", "ici") mesh for multi-slice training.
+
+    The data-parallel grower reduce-scatters histograms over the fast
+    "ici" axis (within a slice) and allreduces the summed blocks over
+    "dcn" (across slices) — the layout SURVEY §2.7.5 prescribes so heavy
+    traffic rides ICI, not the datacenter network.  With
+    `jax.distributed.initialize` (see `init`), devices enumerate
+    slice-major, so reshaping [n_dcn, n_ici] aligns axis 1 with real ICI
+    neighbours."""
+    devs = list(devices) if devices is not None else jax.devices()
+    if n_ici <= 0:
+        if len(devs) % n_dcn:
+            raise ValueError(f"{len(devs)} devices not divisible by "
+                             f"n_dcn={n_dcn}")
+        n_ici = len(devs) // n_dcn
+    need = n_dcn * n_ici
+    if need > len(devs):
+        raise ValueError(f"mesh {n_dcn}x{n_ici} exceeds visible devices "
+                         f"({len(devs)})")
+    return Mesh(np.array(devs[:need]).reshape(n_dcn, n_ici),
+                ("dcn", "ici"))

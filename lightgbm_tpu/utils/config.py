@@ -186,6 +186,9 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # 3 output rows (~2% utilization) and ~190x slower; the kernel stays
     # correctness-tested as the CUDA-kernel-parity artifact
     "tpu_use_pallas": (False, "bool", ()),
+    # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
+    # with this many slices (1 = flat single-slice mesh)
+    "tpu_dcn_slices": (1, "int", ()),
     "tpu_num_shards": (0, "int", ()),        # 0 = all visible devices
     "saved_feature_importance_type": (0, "int", ()),
     "snapshot_freq": (-1, "int", ("save_period",)),

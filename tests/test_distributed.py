@@ -143,6 +143,68 @@ class TestShardedGrower:
             np.testing.assert_allclose(dist.predict(X, raw_score=True),
                                        preds_ref, rtol=2e-4, atol=2e-5)
 
+    def test_distributed_fused_chunks_match_periter(self):
+        """The fused chunk trainer accepts the shard_map'ped grower —
+        multi-chip training syncs once per chunk and must equal the
+        per-iteration distributed path exactly."""
+        import lightgbm_tpu.booster as booster_mod
+        X, y = make_data(1100, f=7, seed=21)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "tree_learner": "data", "learning_rate": 0.1,
+                  "verbosity": -1}
+        bc = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=16)
+        assert bc._mesh is not None
+        old = booster_mod.Booster._BULK_CHUNK
+        booster_mod.Booster._BULK_CHUNK = 10 ** 9
+        try:
+            bp = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                           num_boost_round=16)
+        finally:
+            booster_mod.Booster._BULK_CHUNK = old
+        np.testing.assert_allclose(bc.predict(X, raw_score=True),
+                                   bp.predict(X, raw_score=True),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_voting_elects_subset_when_features_exceed_2k(self):
+        """Real PV-Tree path: with top_k < F/2, only elected features'
+        histograms are reduced — the model must still learn and only use
+        a plausible feature set."""
+        rng = np.random.RandomState(41)
+        X = rng.randn(1600, 24)
+        y = (X[:, 3] - 0.8 * X[:, 17] + 0.3 * rng.randn(1600) > 0)\
+            .astype(np.float64)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "tree_learner": "voting", "top_k": 3,
+                         "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=8)
+        assert bst._mesh is not None
+        p = bst.predict(X)
+        assert np.mean(p[y > 0]) > np.mean(p[y == 0])
+        # the informative features must be among those used
+        used = set()
+        for t in bst.trees:
+            used.update(t.split_feature[:t.num_internal()].tolist())
+        assert 3 in used and 17 in used
+
+    def test_two_level_dcn_mesh_parity(self):
+        """2-level ("dcn", "ici") mesh (multi-slice layout): histograms
+        reduce-scatter over ICI, allreduce over DCN — results must equal
+        the serial learner."""
+        X, y = make_data(1100, f=7, seed=31)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "learning_rate": 0.1, "verbosity": -1}
+        serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                           num_boost_round=5)
+        dist = lgb.train({**params, "tree_learner": "data",
+                          "tpu_dcn_slices": 2},
+                         lgb.Dataset(X, label=y), num_boost_round=5)
+        assert dist._mesh is not None
+        assert dict(dist._mesh.shape) == {"dcn": 2, "ici": 4}
+        np.testing.assert_allclose(dist.predict(X, raw_score=True),
+                                   serial.predict(X, raw_score=True),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_num_machines_limits_shards(self):
         X, y = make_data(512, f=4, seed=5)
         bst = lgb.train({"objective": "binary", "num_leaves": 7,

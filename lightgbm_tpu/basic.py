@@ -71,6 +71,9 @@ def _to_2d_float(data: Any) -> np.ndarray:
     sparse (ref: LGBM_DatasetCreateFromCSR/CSC — densified here; the
     sparsity win comes from EFB bundling after binning, utils/efb.py), and
     Sequence ingest."""
+    if isinstance(data, str):
+        raise LightGBMError(
+            "file-path data must be resolved by Dataset.construct")
     if isinstance(data, Sequence) or (
             isinstance(data, list) and data
             and isinstance(data[0], Sequence)):
@@ -164,14 +167,16 @@ class Dataset:
     def num_data(self) -> int:
         if self._num_data is not None:
             return self._num_data
-        if self.data is not None:
+        if self.data is not None and not isinstance(self.data, str):
             return len(self.data)
+        # file-path data: constructing here would lock in binning params
+        # before train-time params arrive (reference raises too)
         raise LightGBMError("Cannot get num_data before construct")
 
     def num_feature(self) -> int:
         if self._num_feature is not None:
             return self._num_feature
-        if self.data is not None:
+        if self.data is not None and not isinstance(self.data, str):
             arr = self.data
             return 1 if np.ndim(arr) == 1 else np.shape(arr)[1]
         raise LightGBMError("Cannot get num_feature before construct")
@@ -211,6 +216,15 @@ class Dataset:
             raise LightGBMError("Cannot construct Dataset: no raw data "
                                 "(was it freed by free_raw_data?)")
         cfg = Config(self.params)
+        if isinstance(self.data, str):
+            # text-file ingest (ref: DatasetLoader::LoadFromFile — the CLI
+            # parser stack serves the Python API too); the file's label
+            # column feeds `label` unless one was given explicitly
+            from .cli import load_data_file
+            X, y = load_data_file(self.data, cfg)
+            self.data = X
+            if self.label is None and y is not None:
+                self.label = y
         raw = _to_2d_float(self.data)
         n, f = raw.shape
         self._num_data, self._num_feature = n, f

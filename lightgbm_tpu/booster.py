@@ -186,6 +186,16 @@ class Booster:
                 log.warning(f"Parameter {name} is accepted but not yet "
                             "implemented in lightgbm_tpu — it has NO effect "
                             "on this run")
+        # socket-era network params are superseded by the mesh runtime
+        # (ref: Config machines/local_listen_port → SURVEY §2.7.5)
+        for name in ("machines", "local_listen_port", "time_out"):
+            if name in seen and \
+                    getattr(self.config, name) != _PARAMS[name][0]:
+                log.warning(
+                    f"Parameter {name} configures the reference's TCP "
+                    "transport and is ignored here — multi-host setup is "
+                    "lightgbm_tpu.parallel.init(coordinator_address=...) "
+                    "+ num_machines/tree_learner")
 
     # ------------------------------------------------------------- training
     def _init_train(self, train_set: Dataset) -> None:
@@ -203,7 +213,8 @@ class Booster:
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
                      "use_missing", "zero_as_missing", "data_random_seed",
                      "max_bin_by_feature", "feature_pre_filter",
-                     "enable_bundle", "max_conflict_rate", "linear_tree")}}
+                     "enable_bundle", "max_conflict_rate", "linear_tree",
+                     "label_column", "header")}}
         self.train_set = train_set
         self._dd = _DeviceData(train_set)
         self.objective_: Optional[ObjectiveFunction] = \
@@ -218,6 +229,12 @@ class Booster:
             self.objective_.init_meta(
                 label.astype(np.float64), train_set.get_weight(),
                 train_set._query_boundaries)
+            if getattr(train_set, "position", None) is not None:
+                log.warning(
+                    "Dataset positions are accepted but position-bias "
+                    "correction (ref: v4 lambdarank position bias) is not "
+                    "yet implemented — positions have NO effect on this "
+                    "run")
 
         metric_names = self.config.metric or self.config.default_metric()
         self.metrics_: List[Metric] = create_metrics(self.config, metric_names)
@@ -466,11 +483,12 @@ class Booster:
         see parallel/learner.py)."""
         from .parallel.learner import resolve_tree_learner
         cfg = self.config
+        bundled = self._dd.efb is not None
+        # quiet resolution first — warnings fire once, after the cache check
         kind = resolve_tree_learner(cfg.tree_learner or "serial",
-                                    bundled=self._dd.efb is not None)
+                                    bundled=bundled, quiet=True)
         # EFB: training reads the bundled matrix (see _DeviceData)
-        train_src = self._dd.bundle_fm if self._dd.efb is not None \
-            else self._dd.bins_fm
+        train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
         if kind == "serial":
             self._mesh = None
             self._train_bins = train_src
@@ -481,33 +499,34 @@ class Booster:
         except RuntimeError:
             n_dev = 1
         shards = cfg.num_machines if (cfg.num_machines or 0) > 1 else n_dev
-        if shards > n_dev:
-            log.warning(f"num_machines={shards} exceeds visible devices "
-                        f"({n_dev}); using {n_dev}")
-            shards = n_dev
-        if shards <= 1:
-            log.warning(f"tree_learner={kind} requested but only one device "
-                        "is visible; using the serial learner")
-            self._mesh = None
-            self._train_bins = train_src
-            self._learner_cache_key = None
-            return
+        shards = min(shards, n_dev)
         dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
         use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
+        kind = resolve_tree_learner(cfg.tree_learner or "serial",
+                                    bundled=bundled, two_level=use_2level,
+                                    quiet=True)
+        # reset_parameter (lr schedules) calls this every iteration — reuse
+        # the compiled grower and placed bins when nothing changed
+        key = (self._grower_spec, kind, shards, dcn if use_2level else 1)
+        if getattr(self, "_learner_cache_key", None) == key:
+            return
+        # cache miss → emit the one-time configuration warnings
+        resolve_tree_learner(cfg.tree_learner or "serial", bundled=bundled,
+                             two_level=use_2level)
+        if (cfg.num_machines or 0) > n_dev:
+            log.warning(f"num_machines={cfg.num_machines} exceeds visible "
+                        f"devices ({n_dev}); using {n_dev}")
         if dcn > 1 and not use_2level:
             log.warning(f"cannot build a 2-level mesh from {shards} "
                         f"device(s) with tpu_dcn_slices={dcn} (need an "
                         "even division with >= 2 devices per slice); "
                         "using a flat mesh")
-        # re-resolve with the mesh shape known — feature-parallel
-        # downgrades on 2-level meshes BEFORE placement
-        kind = resolve_tree_learner(cfg.tree_learner or "serial",
-                                    bundled=self._dd.efb is not None,
-                                    two_level=use_2level)
-        # reset_parameter (lr schedules) calls this every iteration — reuse
-        # the compiled grower and placed bins when nothing changed
-        key = (self._grower_spec, kind, shards, dcn if use_2level else 1)
-        if getattr(self, "_learner_cache_key", None) == key:
+        if shards <= 1:
+            log.warning(f"tree_learner={kind} requested but only one device "
+                        "is visible; using the serial learner")
+            self._mesh = None
+            self._train_bins = train_src
+            self._learner_cache_key = key
             return
         from .parallel import get_mesh
         from .parallel.learner import make_distributed_grower, \
@@ -1360,6 +1379,14 @@ class Booster:
                 data_has_header: bool = False, validate_features: bool = False,
                 **kwargs) -> np.ndarray:
         """ref: basic.py Booster.predict → gbdt_prediction.cpp."""
+        if isinstance(data, str):
+            # text-file prediction (ref: Application task=predict /
+            # Predictor file path) — same format as training files, label
+            # column present and ignored
+            from .cli import load_data_file
+            data, _ = load_data_file(
+                data, Config({k: v for k, v in self.params.items()
+                              if not callable(v)}))
         X = _to_2d_float(data)
         n = X.shape[0]
         K = self.num_tree_per_iteration

@@ -45,23 +45,27 @@ TREE_LEARNER_ALIASES = {
 
 
 def resolve_tree_learner(name: str, bundled: bool = False,
-                         two_level: bool = False) -> str:
+                         two_level: bool = False,
+                         quiet: bool = False) -> str:
     """Canonicalize the tree_learner param (ref: config.cpp
     `Config::GetTreeLearnerType`).  Downgrades happen HERE — before data
     placement — so placement and grower padding always agree on the
     strategy: feature-parallel falls back to data-parallel under EFB
     (bundle columns don't align with feature blocks) and on 2-level
-    meshes (feature blocks ride a single ICI axis)."""
+    meshes (feature blocks ride a single ICI axis).  `quiet` suppresses
+    the downgrade warnings (cache-hit re-resolution)."""
     kind = TREE_LEARNER_ALIASES.get(str(name).lower())
     if kind is None:
         raise ValueError(f"Unknown tree learner type {name}")
     if bundled and kind == "feature":
-        log.warning("tree_learner=feature with EFB bundling falls back "
-                    "to the data-parallel strategy")
+        if not quiet:
+            log.warning("tree_learner=feature with EFB bundling falls "
+                        "back to the data-parallel strategy")
         kind = "data"
     if two_level and kind == "feature":
-        log.warning("tree_learner=feature over a 2-level mesh falls back "
-                    "to the data-parallel strategy")
+        if not quiet:
+            log.warning("tree_learner=feature over a 2-level mesh falls "
+                        "back to the data-parallel strategy")
         kind = "data"
     return kind
 

@@ -37,6 +37,11 @@ def test_default_value_does_not_warn(fake_inert, caplog):
     assert "NO effect" not in text
 
 
+def test_socket_network_params_warn(caplog):
+    text = _train({"machines": "10.0.0.1:12400,10.0.0.2:12400"}, caplog)
+    assert "machines" in text and "parallel.init" in text
+
+
 def test_nothing_is_inert_anymore(caplog):
     """The real inert list is EMPTY — every accepted param acts."""
     assert Booster._INERT_PARAMS == ()

@@ -47,6 +47,65 @@ class TestSequenceIngest:
                                       np.asarray(ref.bin_data))
 
 
+class TestFileIngest:
+    def test_dataset_from_csv_path(self, tmp_path):
+        """Dataset accepts a text-file path like the reference
+        (ref: DatasetLoader::LoadFromFile; label = column 0)."""
+        rng = np.random.RandomState(4)
+        X = rng.randn(600, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        p = str(tmp_path / "train.csv")
+        np.savetxt(p, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+        ds = lgb.Dataset(p)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, ds, num_boost_round=5)
+        ref = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=5)
+        np.testing.assert_allclose(bst.predict(X), ref.predict(X),
+                                   rtol=1e-9)
+
+    def test_num_data_on_path_dataset(self, tmp_path):
+        rng = np.random.RandomState(5)
+        arr = np.column_stack([np.zeros(50), rng.randn(50, 3)])
+        p = str(tmp_path / "d.csv")
+        np.savetxt(p, arr, delimiter=",", fmt="%.8g")
+        ds = lgb.Dataset(p)
+        # pre-construct access must NOT silently construct with default
+        # binning params (reference raises the same way)
+        with pytest.raises(lgb.LightGBMError):
+            ds.num_data()
+        ds.construct()
+        assert ds.num_data() == 50
+        assert ds.num_feature() == 3
+
+    def test_label_column_forwarded_from_train_params(self, tmp_path):
+        rng = np.random.RandomState(6)
+        X = rng.randn(500, 3)
+        y = (X[:, 0] > 0).astype(np.float64)
+        # label in column 2 of the file
+        arr = np.column_stack([X[:, 0], X[:, 1], y, X[:, 2]])
+        p = str(tmp_path / "d.csv")
+        np.savetxt(p, arr, delimiter=",", fmt="%.8g")
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "label_column": "2", "verbosity": -1},
+                        lgb.Dataset(p), num_boost_round=5)
+        lbl = bst.train_set.get_label()
+        np.testing.assert_array_equal(lbl, y)
+
+    def test_predict_from_file_path(self, tmp_path):
+        rng = np.random.RandomState(7)
+        X = rng.randn(400, 4)
+        y = (X[:, 0] > 0).astype(np.float64)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=5)
+        p = str(tmp_path / "test.csv")
+        np.savetxt(p, np.column_stack([y, X]), delimiter=",", fmt="%.8g")
+        np.testing.assert_allclose(bst.predict(p), bst.predict(X),
+                                   rtol=1e-9)
+
+
 class TestSparseIngest:
     def test_csr_matches_dense(self):
         scipy_sparse = pytest.importorskip("scipy.sparse")

@@ -66,6 +66,12 @@ def _is_sparse(data: Any) -> bool:
     return hasattr(data, "tocsr") and hasattr(data, "toarray")
 
 
+def _is_arrow(data: Any) -> bool:
+    # pyarrow.Table / RecordBatch without importing pyarrow eagerly
+    return type(data).__module__.startswith("pyarrow") and \
+        hasattr(data, "column_names") and hasattr(data, "columns")
+
+
 def _to_2d_float(data: Any) -> np.ndarray:
     """Coerce input matrix to 2D float64 numpy, handling pandas, scipy
     sparse (ref: LGBM_DatasetCreateFromCSR/CSC — densified here; the
@@ -80,6 +86,13 @@ def _to_2d_float(data: Any) -> np.ndarray:
         return _sequence_to_array(data)
     if _is_sparse(data):
         return np.asarray(data.toarray(), dtype=np.float64)
+    if _is_arrow(data):
+        # ref: LGBM_DatasetCreateFromArrow — columns to float64 with
+        # Arrow nulls as NaN
+        cols = [np.asarray(c.to_numpy(zero_copy_only=False),
+                           dtype=np.float64)
+                for c in data.columns]
+        return np.column_stack(cols) if cols else np.empty((0, 0))
     if hasattr(data, "values") and hasattr(data, "dtypes"):  # pandas DataFrame
         arr = data.to_numpy(dtype=np.float64, na_value=np.nan)
     else:
@@ -111,7 +124,9 @@ def _feature_names_from(data: Any, n_features: int,
                 f"Length of feature_names ({len(names)}) does not match "
                 f"number of features ({n_features})")
         return [str(n) for n in names]
-    if hasattr(data, "columns"):
+    if hasattr(data, "column_names"):  # pyarrow Table/RecordBatch
+        return [str(c) for c in data.column_names]
+    if hasattr(data, "columns"):       # pandas
         return [str(c) for c in data.columns]
     return [f"Column_{i}" for i in range(n_features)]
 

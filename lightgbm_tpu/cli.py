@@ -126,7 +126,8 @@ def load_data_file(path: str, config: Config
 def run(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
-              "tasks: train | predict | refit", file=sys.stderr)
+              "tasks: train | predict | refit | convert_model",
+              file=sys.stderr)
         return 0
     params = parse_args(argv)
     config = Config(params)
@@ -168,6 +169,17 @@ def run(argv: List[str]) -> int:
                    delimiter="\t")
         log.info(f"Finished prediction; results saved to "
                  f"{config.output_result}")
+        return 0
+
+    if task == "convert_model":
+        # ref: application.cpp task=convert_model → Tree::ToIfElse
+        if not config.input_model:
+            raise LightGBMError("task=convert_model requires "
+                                "input_model=...")
+        from .convert import convert_model
+        booster = Booster(model_file=config.input_model)
+        convert_model(booster, config.convert_model,
+                      config.convert_model_language)
         return 0
 
     if task == "refit":

@@ -1,0 +1,112 @@
+"""CLI task=convert_model: emitted if-else scorers must match predict()
+(ref: application.cpp Application::ConvertModel / tree.cpp Tree::ToIfElse).
+"""
+import ctypes
+import importlib.util
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _train(tmp_path, objective="regression", num_class=1, with_cat=False,
+           with_nan=False, rounds=12):
+    rng = np.random.RandomState(8)
+    n, f = 600, 5
+    X = rng.randn(n, f)
+    cats = []
+    if with_cat:
+        X[:, 2] = rng.randint(0, 12, n)
+        cats = [2]
+    if with_nan:
+        X[rng.rand(n, f) < 0.1] = np.nan
+    if objective == "multiclass":
+        y = rng.randint(0, num_class, n).astype(float)
+        params = {"objective": "multiclass", "num_class": num_class}
+    else:
+        y = np.nansum(X[:, :2], axis=1) + rng.randn(n) * 0.1
+        params = {"objective": "regression"}
+    params.update({"num_leaves": 8, "verbosity": -1, "min_data_in_leaf": 5})
+    bst = lgb.train(params, lgb.Dataset(X, label=y,
+                                        categorical_feature=cats),
+                    num_boost_round=rounds)
+    mp = os.path.join(tmp_path, "model.txt")
+    bst.save_model(mp)
+    return bst, X, mp
+
+
+def _run_cli(args):
+    r = subprocess.run(
+        [sys.executable, "-m", "lightgbm_tpu"] + args,
+        capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    return r
+
+
+def _compile_c(c_path, tmp_path):
+    so = os.path.join(tmp_path, "scorer.so")
+    r = subprocess.run(["gcc", "-O1", "-shared", "-fPIC", c_path,
+                        "-o", so, "-lm"], capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    return ctypes.CDLL(so)
+
+
+def _import_py(py_path):
+    spec = importlib.util.spec_from_file_location("gen_scorer", py_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.quick
+def test_convert_model_c_matches_predict(tmp_path):
+    bst, X, mp = _train(tmp_path, with_cat=True, with_nan=True)
+    out = os.path.join(tmp_path, "scorer.c")
+    _run_cli([f"task=convert_model", f"input_model={mp}",
+              f"convert_model={out}"])
+    lib = _compile_c(out, tmp_path)
+    lib.score_raw.restype = ctypes.c_double
+    lib.score_raw.argtypes = [ctypes.POINTER(ctypes.c_double)]
+    expect = bst.predict(X, raw_score=True)
+    got = np.array([
+        lib.score_raw(np.ascontiguousarray(row, dtype=np.float64)
+                      .ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+        for row in X])
+    np.testing.assert_allclose(got, expect, rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.quick
+def test_convert_model_python_matches_predict(tmp_path):
+    bst, X, mp = _train(tmp_path, with_nan=True)
+    out = os.path.join(tmp_path, "scorer.py")
+    _run_cli([f"task=convert_model", f"input_model={mp}",
+              f"convert_model={out}", "convert_model_language=python"])
+    mod = _import_py(out)
+    expect = bst.predict(X, raw_score=True)
+    got = np.array([mod.score_raw(list(map(float, row))) for row in X])
+    np.testing.assert_allclose(got, expect, rtol=1e-10, atol=1e-10)
+
+
+def test_convert_model_multiclass_c(tmp_path):
+    bst, X, mp = _train(tmp_path, objective="multiclass", num_class=3)
+    out = os.path.join(tmp_path, "scorer_mc.c")
+    _run_cli([f"task=convert_model", f"input_model={mp}",
+              f"convert_model={out}"])
+    lib = _compile_c(out, tmp_path)
+    lib.score_raw_multi.restype = None
+    lib.score_raw_multi.argtypes = [ctypes.POINTER(ctypes.c_double),
+                                    ctypes.POINTER(ctypes.c_double)]
+    expect = bst.predict(X, raw_score=True)
+    got = np.empty((len(X), 3))
+    for i, row in enumerate(X):
+        buf = (ctypes.c_double * 3)()
+        lib.score_raw_multi(
+            np.ascontiguousarray(row, dtype=np.float64)
+            .ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf)
+        got[i] = list(buf)
+    np.testing.assert_allclose(got, expect, rtol=1e-10, atol=1e-10)

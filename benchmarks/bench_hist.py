@@ -31,6 +31,18 @@ def main():
     bytes_per_call = n * f + n * 3 * 4 + n  # bins + payload + mask
 
     impls = {"segment_sum": lambda: seg(bins, payload, mask)}
+
+    # packed-int quantized variant (2 scatter sweeps instead of 3); uses a
+    # quantized payload on the same value lattice the trainer would feed it
+    from lightgbm_tpu.ops.fused import quantize_gradients
+    from lightgbm_tpu.ops.histogram import leaf_histogram_packed
+    gq, hq, (sg, sh) = quantize_gradients(
+        payload[:, 0], jnp.abs(payload[:, 1]) + 0.1, 8, return_scales=True)
+    payload_q = jnp.stack([gq, hq, jnp.ones_like(gq)], axis=1)
+    packed = jax.jit(lambda b, p, m: leaf_histogram_packed(b, p, m, mb,
+                                                           sg, sh))
+    impls["packed_quant"] = lambda: packed(bins, payload_q, mask)
+
     for impl in ("onehot", "hilo"):
         impls[f"pallas_{impl}"] = (
             lambda impl=impl: pallas_histogram(bins, payload, mask, mb,

@@ -163,6 +163,11 @@ class Dataset:
         # constructed state
         self._handle_constructed = False
         self.bin_data: Optional[np.ndarray] = None  # [N, F] uint8/16, device or host
+        # binned CSC (scipy-style) for sparse ingest: stored entries are the
+        # bins of the raw-nonzero values; absent entries imply each
+        # feature's zero bin.  When EFB bundles the sparse matrix,
+        # `bin_data` stays None and this is the canonical binned form.
+        self.sparse_binned = None
         self.bin_mappers: Optional[List[BinMapper]] = None
         self.num_total_bin: int = 0
         self.efb = None                        # BundleSpec (utils/efb.py)
@@ -183,6 +188,8 @@ class Dataset:
         if self._num_data is not None:
             return self._num_data
         if self.data is not None and not isinstance(self.data, str):
+            if _is_sparse(self.data):   # len() of scipy matrices raises
+                return int(self.data.shape[0])
             return len(self.data)
         # file-path data: constructing here would lock in binning params
         # before train-time params arrive (reference raises too)
@@ -240,6 +247,18 @@ class Dataset:
             self.data = X
             if self.label is None and y is not None:
                 self.label = y
+        if _is_sparse(self.data):
+            # CSR/CSC ingest stays sparse end-to-end — no float64 dense
+            # intermediate (ref: LGBM_DatasetCreateFromCSR +
+            # src/io/sparse_bin.hpp: the reference also bins straight from
+            # the sparse stream; round-2 densified here and made
+            # Criteo-scale inputs unreachable)
+            self._construct_sparse(cfg)
+            self._set_all_fields()
+            self._handle_constructed = True
+            if self.free_raw_data and not cfg.linear_tree:
+                self.data = None
+            return self
         raw = _to_2d_float(self.data)
         n, f = raw.shape
         self._num_data, self._num_feature = n, f
@@ -296,19 +315,10 @@ class Dataset:
             sample = raw[np.sort(sample_idx)]
         else:
             sample = raw
-        max_bin_by_feature = cfg.max_bin_by_feature
         mappers: List[BinMapper] = []
         for j in range(f):
-            m = BinMapper()
-            mb = (max_bin_by_feature[j] if j < len(max_bin_by_feature)
-                  else cfg.max_bin)
-            bt = (BIN_TYPE_CATEGORICAL if j in self._categorical_indices
-                  else BIN_TYPE_NUMERICAL)
-            m.find_bin(sample[:, j], len(sample), mb,
-                       min_data_in_bin=cfg.min_data_in_bin,
-                       bin_type=bt, use_missing=cfg.use_missing,
-                       zero_as_missing=cfg.zero_as_missing)
-            mappers.append(m)
+            mappers.append(self._fit_one_mapper(j, sample[:, j],
+                                                len(sample), cfg))
         n_trivial = sum(m.is_trivial for m in mappers)
         if n_trivial:
             log.info(f"{n_trivial} trivial (constant) features found and ignored "
@@ -325,12 +335,146 @@ class Dataset:
             out[:, j] = m.values_to_bins(raw[:, j]).astype(dtype)
         return out
 
+    # ----------------------------------------------------- sparse construct
+    def _construct_sparse(self, cfg: Config) -> None:
+        """Bin a scipy CSR/CSC matrix without densifying to float64
+        (ref: LGBM_DatasetCreateFromCSR → DatasetLoader sparse sampling,
+        src/io/sparse_bin.hpp).  Peak host memory is O(nnz + output):
+        mappers fit on sampled nonzero values + implied zero counts, EFB
+        conflicts count from per-column nonzero masks, and the final
+        matrix is written straight as uint8/16 (bundled [N, G] when EFB
+        applies, dense [N, F] bins otherwise)."""
+        from .utils.efb import (build_bundled_sparse, find_bundles_sparse,
+                                materialize_dense_bins)
+        n, f = (int(s) for s in self.data.shape)
+        self._num_data, self._num_feature = n, f
+        self._feature_names = _feature_names_from(
+            self.data, f,
+            None if self.feature_name == "auto" else self.feature_name)
+        self._categorical_indices = self._resolve_categoricals(
+            self._feature_names, f)
+
+        csc = self.data.tocsc()     # the ONE full-matrix conversion
+        if self.reference is not None:
+            if f != len(self.reference.bin_mappers):
+                raise LightGBMError(
+                    f"The number of features in data ({f}) is not the same "
+                    f"as it was in training data "
+                    f"({len(self.reference.bin_mappers)})")
+            self.bin_mappers = self.reference.bin_mappers
+            self._categorical_indices = self.reference._categorical_indices
+        else:
+            self.bin_mappers = self._fit_bin_mappers_sparse(csc, cfg)
+        binned = self._bin_sparse_csc(csc, self.bin_mappers)
+        del csc
+        self.sparse_binned = binned
+        self.num_total_bin = sum(m.num_bin for m in self.bin_mappers)
+
+        if self.reference is not None:
+            # valid sets are traversed (not histogrammed): they need the
+            # dense bin matrix, and inherit the reference's bundling spec
+            self.efb = getattr(self.reference, "efb", None)
+            self.bin_data = materialize_dense_bins(binned, self.bin_mappers)
+            return
+        if cfg.enable_bundle:
+            self.efb = find_bundles_sparse(binned, self.bin_mappers,
+                                           cfg.max_conflict_rate,
+                                           cfg.data_random_seed)
+        if self.efb is not None:
+            log.info(f"EFB: bundled {f} features into "
+                     f"{self.efb.n_cols} columns "
+                     f"({len(self.efb.bundles)} multi-feature bundles)")
+            self.bundle_data = build_bundled_sparse(binned, self.efb,
+                                                    self.bin_mappers)
+            # bin_data stays None: the [N, F] dense matrix is only
+            # materialized lazily if a traversal path (DART / valid-style
+            # scoring / save) asks for it
+        else:
+            self.bin_data = materialize_dense_bins(binned, self.bin_mappers)
+
+    def _fit_one_mapper(self, j: int, values: np.ndarray, total_cnt: int,
+                        cfg: Config) -> BinMapper:
+        """One feature's BinMapper (shared by the dense and sparse fit
+        loops; `values` may omit zeros when total_cnt > len(values) —
+        the reference's sparse sampling contract, bin.cpp FindBin)."""
+        m = BinMapper()
+        mbf = cfg.max_bin_by_feature
+        mb = mbf[j] if j < len(mbf) else cfg.max_bin
+        bt = (BIN_TYPE_CATEGORICAL if j in self._categorical_indices
+              else BIN_TYPE_NUMERICAL)
+        m.find_bin(values, total_cnt, mb,
+                   min_data_in_bin=cfg.min_data_in_bin,
+                   bin_type=bt, use_missing=cfg.use_missing,
+                   zero_as_missing=cfg.zero_as_missing)
+        return m
+
+    def _fit_bin_mappers_sparse(self, csc, cfg: Config) -> List[BinMapper]:
+        """Per-feature BinMappers from a (possibly row-sampled) binned-input
+        CSC: each feature sees its sampled *nonzero* values plus the
+        implied zero count (ref: DatasetLoader::SampleTextDataFromFile).
+        Reuses the caller's CSC when no sampling applies — no extra
+        full-matrix conversion on the Criteo-scale path."""
+        n, f = csc.shape
+        sample_cnt = min(cfg.bin_construct_sample_cnt, n)
+        in_sample = None
+        if sample_cnt < n:
+            rng = np.random.RandomState(cfg.data_random_seed)
+            rows = rng.choice(n, sample_cnt, replace=False)
+            in_sample = np.zeros(n, dtype=bool)
+            in_sample[rows] = True
+        indptr, indices, data = csc.indptr, csc.indices, csc.data
+        mappers: List[BinMapper] = []
+        for j in range(f):
+            sl = slice(int(indptr[j]), int(indptr[j + 1]))
+            vals = np.asarray(data[sl], dtype=np.float64)
+            if in_sample is not None:
+                # per-column row filter on the shared CSC — no full-matrix
+                # tocsr/tocsc copies on the Criteo-scale path
+                vals = vals[in_sample[indices[sl]]]
+            mappers.append(self._fit_one_mapper(j, vals, sample_cnt, cfg))
+        n_trivial = sum(m.is_trivial for m in mappers)
+        if n_trivial:
+            log.info(f"{n_trivial} trivial (constant) features found and "
+                     f"ignored for splitting")
+        return mappers
+
+    @staticmethod
+    def _bin_sparse_csc(csc, mappers: List[BinMapper]):
+        """Binned CSC with the same sparsity pattern: stored raw values →
+        their bins (a stored value may legitimately bin to 0).  Absent
+        entries imply each feature's `value_to_bin(0.0)`."""
+        max_nb = max((m.num_bin for m in mappers), default=1)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        out_data = np.empty(len(csc.data), dtype=dtype)
+        for j, m in enumerate(mappers):
+            sl = slice(int(csc.indptr[j]), int(csc.indptr[j + 1]))
+            out_data[sl] = m.values_to_bins(
+                np.asarray(csc.data[sl], dtype=np.float64)).astype(dtype)
+        return type(csc)((out_data, csc.indices, csc.indptr),
+                         shape=csc.shape)
+
+    def _dense_bin_matrix(self) -> np.ndarray:
+        """The [N, F] dense bin matrix, materializing from the sparse
+        binned form when the EFB path skipped it."""
+        if self.bin_data is not None:
+            return np.asarray(self.bin_data)
+        if self.sparse_binned is None:
+            raise LightGBMError("Dataset has no binned data (not "
+                                "constructed?)")
+        from .utils.efb import materialize_dense_bins
+        return materialize_dense_bins(self.sparse_binned, self.bin_mappers)
+
     def _construct_subset(self) -> None:
         ref = self.reference
         assert ref is not None and ref._handle_constructed
         idx = np.asarray(self.used_indices, dtype=np.int64)
         self.bin_mappers = ref.bin_mappers
-        self.bin_data = np.asarray(ref.bin_data)[idx]
+        if ref.bin_data is not None:
+            self.bin_data = np.asarray(ref.bin_data)[idx]
+        else:
+            # sparse-EFB parent: subset the sparse binned form (row slice
+            # on the CSR view), keep bin_data unmaterialized
+            self.sparse_binned = ref.sparse_binned.tocsr()[idx].tocsc()
         self.efb = getattr(ref, "efb", None)
         if self.efb is not None and ref.bundle_data is not None:
             self.bundle_data = np.asarray(ref.bundle_data)[idx]
@@ -437,14 +581,32 @@ class Dataset:
     def get_init_score(self) -> Optional[np.ndarray]:
         return self._init_score_arr
 
+    def set_position(self, position: Any) -> "Dataset":
+        """Per-row result-list positions for unbiased lambdarank
+        (ref: v4 basic.py `Dataset.set_position` / Metadata positions)."""
+        self.position = position
+        self.version += 1
+        return self
+
+    def get_position(self) -> Optional[np.ndarray]:
+        if self.position is None:
+            return None
+        pos = np.asarray(
+            self.position.values if hasattr(self.position, "values")
+            and not isinstance(self.position, np.ndarray)
+            else self.position).reshape(-1)
+        return pos.astype(np.int32)
+
     def get_field(self, field_name: str):
         return {"label": self.get_label(), "weight": self.get_weight(),
                 "group": self.get_group(), "init_score": self.get_init_score(),
+                "position": self.get_position(),
                 }.get(field_name)
 
     def set_field(self, field_name: str, data: Any) -> "Dataset":
         return {"label": self.set_label, "weight": self.set_weight,
                 "group": self.set_group, "init_score": self.set_init_score,
+                "position": self.set_position,
                 }[field_name](data)
 
     def get_feature_name(self) -> List[str]:
@@ -509,14 +671,24 @@ class Dataset:
         return self
 
     def _savez(self, fh) -> None:
+        if self.bin_data is not None:
+            payload = {"bin_data": np.asarray(self.bin_data)}
+        else:  # sparse-EFB dataset: persist the binned CSC triplet
+            sb = self.sparse_binned
+            payload = {"sparse_data": np.asarray(sb.data),
+                       "sparse_indices": np.asarray(sb.indices),
+                       "sparse_indptr": np.asarray(sb.indptr),
+                       "sparse_shape": np.asarray(sb.shape, np.int64)}
         np.savez_compressed(
             fh,
-            bin_data=np.asarray(self.bin_data),
+            **payload,
             mappers=json.dumps([m.to_dict() for m in self.bin_mappers]),
             label=self._label_arr if self._label_arr is not None else np.array([]),
             weight=self._weight_arr if self._weight_arr is not None else np.array([]),
             query=self._query_boundaries if self._query_boundaries is not None
             else np.array([]),
+            position=(self.get_position() if self.position is not None
+                      else np.array([], np.int32)),
             feature_names=json.dumps(self._feature_names),
             categorical=np.asarray(self._categorical_indices, dtype=np.int64),
             efb=json.dumps(self.efb.to_dict()) if self.efb is not None
@@ -527,9 +699,17 @@ class Dataset:
     def load_binary(cls, filename: str) -> "Dataset":
         z = np.load(filename, allow_pickle=False)
         ds = cls(None, free_raw_data=False)
-        ds.bin_data = z["bin_data"]
         ds.bin_mappers = [BinMapper.from_dict(d) for d in json.loads(str(z["mappers"]))]
-        ds._num_data, ds._num_feature = ds.bin_data.shape
+        if "bin_data" in z:
+            ds.bin_data = z["bin_data"]
+            ds._num_data, ds._num_feature = ds.bin_data.shape
+        else:
+            from scipy.sparse import csc_matrix
+            shape = tuple(z["sparse_shape"].tolist())
+            ds.sparse_binned = csc_matrix(
+                (z["sparse_data"], z["sparse_indices"],
+                 z["sparse_indptr"]), shape=shape)
+            ds._num_data, ds._num_feature = shape
         ds.num_total_bin = sum(m.num_bin for m in ds.bin_mappers)
         ds._feature_names = json.loads(str(z["feature_names"]))
         ds._categorical_indices = z["categorical"].tolist()
@@ -539,10 +719,17 @@ class Dataset:
             ds._weight_arr = z["weight"]
         if len(z["query"]):
             ds._query_boundaries = z["query"]
+        if "position" in z and len(z["position"]):
+            ds.position = z["position"]
         if "efb" in z and str(z["efb"]):
-            from .utils.efb import BundleSpec, build_bundled
+            from .utils.efb import (BundleSpec, build_bundled,
+                                    build_bundled_sparse)
             ds.efb = BundleSpec.from_dict(json.loads(str(z["efb"])))
-            ds.bundle_data = build_bundled(ds.bin_data, ds.efb)
+            ds.bundle_data = (
+                build_bundled(ds.bin_data, ds.efb)
+                if ds.bin_data is not None
+                else build_bundled_sparse(ds.sparse_binned, ds.efb,
+                                          ds.bin_mappers))
         ds._handle_constructed = True
         return ds
 
@@ -557,8 +744,11 @@ class Dataset:
     def add_features_from(self, other: "Dataset") -> "Dataset":
         self.construct()
         other.construct()
+        # the merged dataset is unbundled (efb reset below), so both sides
+        # need their dense bin matrices — sparse-EFB sides materialize here
         self.bin_data = np.concatenate(
-            [np.asarray(self.bin_data), np.asarray(other.bin_data)], axis=1)
+            [self._dense_bin_matrix(), other._dense_bin_matrix()], axis=1)
+        self.sparse_binned = None
         self.bin_mappers = list(self.bin_mappers) + list(other.bin_mappers)
         self._feature_names = list(self._feature_names) + list(other._feature_names)
         self._categorical_indices = (

@@ -45,13 +45,19 @@ class _DeviceData:
 
     def __init__(self, ds: Dataset, for_train: bool = True):
         ds.construct()
-        bins = np.asarray(ds.bin_data)
-        self.num_data, self.num_feature = bins.shape
-        self.bins_fm = jnp.asarray(np.ascontiguousarray(bins.T))  # [F, N]
+        self._ds = ds
+        self.num_data, self.num_feature = ds._num_data, ds._num_feature
         # EFB: the grower trains on the bundled [G, N] matrix; the original
         # [F, N] stays for tree traversal.  Valid sets are only traversed,
-        # so their bundled matrix is neither built nor uploaded.
+        # so their bundled matrix is neither built nor uploaded.  A
+        # sparse-EFB training set has NO dense [N, F] matrix at all —
+        # `bins_fm` materializes lazily if a traversal path (DART drop,
+        # per-tree valid scoring on train bins) actually needs it.
         self.efb = getattr(ds, "efb", None)
+        self._bins_fm = None
+        if ds.bin_data is not None:
+            bins = np.asarray(ds.bin_data)
+            self._bins_fm = jnp.asarray(np.ascontiguousarray(bins.T))
         # raw values retained for linear-tree leaf fits / scoring
         self.raw_ref = ds.data if ds.data is not None else None
         self._raw2d: Optional[np.ndarray] = None
@@ -59,8 +65,14 @@ class _DeviceData:
         if self.efb is not None and for_train:
             bd = ds.bundle_data
             if bd is None:  # e.g. train continuation on a referenced Dataset
-                from .utils.efb import build_bundled
-                bd = ds.bundle_data = build_bundled(bins, self.efb)
+                if ds.bin_data is not None:
+                    from .utils.efb import build_bundled
+                    bd = ds.bundle_data = build_bundled(
+                        np.asarray(ds.bin_data), self.efb)
+                else:
+                    from .utils.efb import build_bundled_sparse
+                    bd = ds.bundle_data = build_bundled_sparse(
+                        ds.sparse_binned, self.efb, ds.bin_mappers)
             self.bundle_fm = jnp.asarray(
                 np.ascontiguousarray(np.asarray(bd).T))
         mappers = ds.bin_mappers
@@ -83,6 +95,17 @@ class _DeviceData:
         self.weight = jnp.asarray(w.astype(np.float32)) if w is not None else None
         self.init_score = ds.get_init_score()
         self.query_boundaries = ds._query_boundaries
+
+    @property
+    def bins_fm(self):
+        if self._bins_fm is None:
+            log.warning("materializing the dense [N, F] bin matrix from a "
+                        "sparse dataset for tree traversal — avoid DART / "
+                        "train-set traversal paths on sparse-EFB data if "
+                        "memory-bound")
+            dense = self._ds._dense_bin_matrix()
+            self._bins_fm = jnp.asarray(np.ascontiguousarray(dense.T))
+        return self._bins_fm
 
     def get_raw(self) -> np.ndarray:
         """Raw feature matrix (linear trees only; requires the Dataset to
@@ -208,6 +231,17 @@ class Booster:
             self.params["objective"] = "none"
         self.config = Config(self.params)
         self._warn_inert_params()
+        self._debug_nans = bool(self.config.tpu_debug_nans)
+        if self._debug_nans:
+            # numeric-sanitizer mode (ref: cmake/Sanitizer.cmake posture):
+            # any NaN produced inside this booster's jitted training steps
+            # raises FloatingPointError at the producing op instead of
+            # poisoning the whole model.  Applied as a context around THIS
+            # booster's dispatches (jax.debug_nans), never as the global
+            # flag — a leaked global would slow and abort unrelated
+            # boosters in the same process.
+            log.warning("tpu_debug_nans=true: NaN checks enabled — "
+                        "training is slower; use for debugging only")
         train_set.params = {**(train_set.params or {}), **{
             k: v for k, v in self.params.items()
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
@@ -230,11 +264,18 @@ class Booster:
                 label.astype(np.float64), train_set.get_weight(),
                 train_set._query_boundaries)
             if getattr(train_set, "position", None) is not None:
-                log.warning(
-                    "Dataset positions are accepted but position-bias "
-                    "correction (ref: v4 lambdarank position bias) is not "
-                    "yet implemented — positions have NO effect on this "
-                    "run")
+                pos = train_set.get_position()
+                if hasattr(self.objective_, "set_positions"):
+                    # unbiased lambdarank (ref: v4 rank_objective.hpp
+                    # position handling): propensity state rides the
+                    # per-iteration grad call — see _grad_fn setup below
+                    self.objective_.set_positions(pos)
+                else:
+                    log.warning(
+                        f"Dataset positions are only consumed by the "
+                        f"lambdarank objective — positions have NO effect "
+                        f"on objective="
+                        f"{getattr(self.objective_, 'name', '?')}")
 
         metric_names = self.config.metric or self.config.default_metric()
         self.metrics_: List[Metric] = create_metrics(self.config, metric_names)
@@ -265,6 +306,13 @@ class Booster:
         self._average_output = self._boost_mode == "rf"
 
         self._ic_groups = self._parse_ic_groups()
+        interm = self._monotone_intermediate()
+        pool_slots = self._hist_pool_slots()
+        if interm and pool_slots:
+            log.warning("monotone_constraints_method=intermediate needs "
+                        "per-leaf histograms to re-search moved leaves — "
+                        "ignoring histogram_pool_size")
+            pool_slots = 0
         self._grower_spec = GrowerSpec(
             num_leaves=self.config.num_leaves,
             max_depth=self.config.max_depth,
@@ -283,7 +331,7 @@ class Booster:
             bundled=self._dd.efb is not None,
             bundle_max_bin=self._dd.efb.max_bin
             if self._dd.efb is not None else 0,
-            hist_pool_slots=self._hist_pool_slots(),
+            hist_pool_slots=pool_slots,
             path_smooth=self.config.path_smooth,
             feature_fraction_bynode=self.config.feature_fraction_bynode,
             n_ic_groups=0 if self._ic_groups is None
@@ -299,6 +347,7 @@ class Booster:
                 self.config.cegb_penalty_feature_lazy or [])),
             extra_trees=self.config.extra_trees,
             voting_top_k=self.config.top_k,
+            monotone_intermediate=interm,
         )
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
@@ -329,10 +378,58 @@ class Booster:
                 self._grad_rng_fn = jax.jit(_grad)
                 self._grad_fn = lambda s: self._grad_rng_fn(
                     s, jax.random.fold_in(self._grad_key0, self.cur_iter))
+            elif getattr(self.objective_, "has_state", False):
+                # stateful objective (unbiased lambdarank): the propensity
+                # state must be a runtime input — a closed-over array would
+                # be baked into the jit as a constant and never update
+                self._obj_state = self.objective_.init_state()
+
+                def _grad_state(score, state):
+                    return self.objective_.grad_hess(score, lbl, wgt,
+                                                     state=state)
+                self._grad_state_fn = jax.jit(_grad_state)
+
+                def _grad(s):
+                    g, h, self._obj_state = self._grad_state_fn(
+                        s, self._obj_state)
+                    return g, h
+                self._grad_fn = _grad
             else:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
+
+    def _monotone_intermediate(self) -> bool:
+        """Whether the grower runs the `intermediate` monotone method
+        (ref: monotone_constraints.hpp `IntermediateLeafConstraints`;
+        config.h monotone_constraints_method).  `advanced` downgrades to
+        intermediate, distributed learners downgrade to basic — both with
+        a warning."""
+        cfg = self.config
+        mono = list(cfg.monotone_constraints or [])
+        if not mono or not any(mono):
+            return False
+        method = (cfg.monotone_constraints_method or "basic").lower()
+        if method == "basic":
+            return False
+        if method == "advanced":
+            log.warning(
+                "monotone_constraints_method=advanced is not implemented "
+                "— using intermediate (ref: monotone_constraints.hpp "
+                "AdvancedLeafConstraints is out of scope)")
+        elif method != "intermediate":
+            raise LightGBMError(
+                f"Unknown monotone_constraints_method {method}")
+        from .parallel.learner import TREE_LEARNER_ALIASES
+        kind = TREE_LEARNER_ALIASES.get(
+            str(cfg.tree_learner or "serial").lower(), "serial")
+        if kind != "serial":
+            log.warning(
+                "monotone_constraints_method=intermediate is only "
+                "implemented for the serial tree learner — using the "
+                "basic method")
+            return False
+        return True
 
     def _cegb_active(self) -> bool:
         """CEGB is on when any penalty is configured
@@ -417,6 +514,19 @@ class Booster:
         backends (gated on a tiny compile-and-compare probe so a Mosaic
         regression degrades to the XLA path instead of crashing training),
         segment-sum elsewhere (CPU tests, interpret)."""
+        cfg = self.config
+        from .ops.histogram import PACKED_MAX_QUANT_BINS
+        if (cfg.use_quantized_grad and not cfg.tpu_use_pallas
+                and 0 < cfg.num_grad_quant_bins <= PACKED_MAX_QUANT_BINS
+                and not self._use_goss
+                and self._fobj is None and self.objective_ is not None):
+            # packed-int scatter: one sweep covers (g, h) — valid only
+            # when payload values are exact integer lattice points with
+            # hq >= 0 (GOSS rescale weights break integrality; custom
+            # objectives may return negative hessians, whose hq < 0
+            # borrows into the packed grad field; more quant bins than
+            # the tile bound would overflow the 16-bit field)
+            return "packed"
         if not self.config.tpu_use_pallas:
             return "segment_sum"
         try:
@@ -642,12 +752,33 @@ class Booster:
         return feature_mask(iteration, k, self._ff_key0, base,
                             feature_fraction=self.config.feature_fraction)
 
+    def _nan_check_ctx(self):
+        """Per-booster numeric-sanitizer scope (tpu_debug_nans) — a
+        context, not the process-global jax flag, so other boosters in
+        the process are unaffected."""
+        import contextlib
+        return jax.debug_nans(True) if self._debug_nans \
+            else contextlib.nullcontext()
+
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration (ref: basic.py Booster.update →
         LGBM_BoosterUpdateOneIter → GBDT::TrainOneIter)."""
+        with self._nan_check_ctx():
+            return self._update_impl(train_set, fobj)
+
+    def _update_impl(self, train_set: Optional[Dataset] = None,
+                     fobj=None) -> bool:
         if train_set is not None and train_set is not self.train_set:
             self._init_train(train_set)
         fobj = fobj or self._fobj
+        if fobj is not None and self._grower_spec.hist_impl == "packed":
+            # ad-hoc update(fobj=...) on a booster whose grower was
+            # specialized for packed quantized histograms: custom
+            # hessians may be negative, which corrupts the packed field
+            raise LightGBMError(
+                "update(fobj=...) cannot be combined with the packed "
+                "quantized histogram; construct the Booster with "
+                "objective='none' for custom objectives")
         K = self.num_tree_per_iteration
         if self._boost_mode == "dart":
             return self._update_dart(fobj)
@@ -706,14 +837,21 @@ class Booster:
             sw = self._goss_weights(it, grad, hess)
         else:
             sw = self._sample_weights(it)
+        qscales = None
         if cfg.use_quantized_grad and cfg.num_grad_quant_bins > 0:
             # ref: v4 quantized training (cuda_gradient_discretizer.cu);
             # same key derivation as the fused chunk so paths agree
             from .ops.fused import quantize_gradients
             qkey = jax.random.fold_in(self._rng_key0, it * 2 + 1) \
                 if cfg.stochastic_rounding else None
-            grad, hess = quantize_gradients(grad, hess,
-                                            cfg.num_grad_quant_bins, qkey)
+            if self._grower_spec.hist_impl == "packed":
+                grad, hess, qs = quantize_gradients(
+                    grad, hess, cfg.num_grad_quant_bins, qkey,
+                    return_scales=True)
+                qscales = jnp.stack(qs)
+            else:
+                grad, hess = quantize_gradients(
+                    grad, hess, cfg.num_grad_quant_bins, qkey)
         dd = self._dd
         lr = 1.0 if self._boost_mode == "rf" else cfg.learning_rate
         all_const = True
@@ -729,6 +867,8 @@ class Booster:
                 # ops/fused.py chunk_step
                 feat = {**feat, "ff_key": jax.random.fold_in(
                     jax.random.fold_in(self._ff_key0, 2 ** 20 + it), k)}
+            if qscales is not None:
+                feat = {**feat, "qscales": qscales}
             dev = self._grower(self._train_bins, gk.astype(jnp.float32),
                                hk.astype(jnp.float32), sw,
                                feat, allowed)
@@ -1027,8 +1167,11 @@ class Booster:
         ok = (self._fobj is None and self.objective_ is not None
               and self._boost_mode in ("gbdt", "rf")
               # CEGB coupled penalties mutate per-model host state;
-              # linear-leaf ridge fits run on the host raw matrix
+              # linear-leaf ridge fits run on the host raw matrix;
+              # stateful objectives (position-debiased lambdarank) update
+              # propensities per iteration on the host side
               and not self._cegb_active()
+              and not getattr(self.objective_, "has_state", False)
               and not cfg.linear_tree
               and cfg.pos_bagging_fraction >= 1.0
               and cfg.neg_bagging_fraction >= 1.0)
@@ -1101,11 +1244,12 @@ class Booster:
         trainer = self._bulk_trainer(spec)
         dd = self._dd
         valid_bins = tuple(v.bins_fm for v in self._valid_dd[:spec.n_valid])
-        score, vfinal, stacked, v_iter, t_iter = trainer(
-            self._train_score, tuple(self._valid_scores[:spec.n_valid]),
-            jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
-            self._grad_key0, self._train_bins, self._feat,
-            jnp.asarray(dd.base_allowed), valid_bins)
+        with self._nan_check_ctx():
+            score, vfinal, stacked, v_iter, t_iter = trainer(
+                self._train_score, tuple(self._valid_scores[:spec.n_valid]),
+                jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
+                self._grad_key0, self._train_bins, self._feat,
+                jnp.asarray(dd.base_allowed), valid_bins)
         self._train_score = score
         if spec.n_valid:
             self._valid_scores[:spec.n_valid] = list(vfinal)

@@ -66,7 +66,7 @@ def goss_weights(it, key0: Array, grad: Array, hess: Array, n: int, *,
 
 
 def quantize_gradients(grad: Array, hess: Array, n_bins: int,
-                       key: Array = None):
+                       key: Array = None, return_scales: bool = False):
     """Gradient discretization (ref: cuda_gradient_discretizer.cu /
     v4 quantized training `use_quantized_grad`): gradients snap to
     `n_bins` signed levels, hessians to `n_bins` unsigned levels, with
@@ -91,6 +91,9 @@ def quantize_gradients(grad: Array, hess: Array, n_bins: int,
     else:
         gq = jnp.round(vg)
         hq = jnp.round(vh)
+    if return_scales:
+        return gq * s_g, hq * s_h, (s_g.astype(jnp.float32),
+                                    s_h.astype(jnp.float32))
     return gq * s_g, hq * s_h
 
 
@@ -195,8 +198,13 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None,
             # odd stream ids — bagging/GOSS use even fold_in ids on key0
             qkey = jax.random.fold_in(key0, it * 2 + 1) \
                 if spec.quant_stochastic else None
-            grad, hess = quantize_gradients(grad, hess, spec.quant_bins,
-                                            qkey)
+            if spec.grower.hist_impl == "packed":
+                grad, hess, qs = quantize_gradients(
+                    grad, hess, spec.quant_bins, qkey, return_scales=True)
+                feat = {**feat, "qscales": jnp.stack(qs)}
+            else:
+                grad, hess = quantize_gradients(grad, hess,
+                                                spec.quant_bins, qkey)
         trees = []
         new_score = score
         new_vscores = list(vscores)

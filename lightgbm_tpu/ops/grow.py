@@ -101,6 +101,15 @@ class GrowerSpec(NamedTuple):
     # voting-parallel (PV-Tree) local top-k (ref: config.h top_k /
     # voting_parallel_tree_learner.cpp)
     voting_top_k: int = 20
+    # monotone_constraints_method=intermediate (ref:
+    # monotone_constraints.hpp `IntermediateLeafConstraints`): per-leaf
+    # bounds are recomputed every split from the CURRENT outputs of the
+    # opposite subtrees of each monotone ancestor (instead of the basic
+    # method's one-shot parent midpoint), and leaves whose bounds moved
+    # get their cached best split re-searched — the analog of the
+    # reference's `leaves_to_update` re-search.  Serial, un-pooled
+    # growers only (booster downgrades otherwise).
+    monotone_intermediate: bool = False
 
 
 class DeviceTree(NamedTuple):
@@ -319,6 +328,15 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 if spec.hist_impl == "pallas":
                     from .pallas_hist import pallas_histogram
                     h = pallas_histogram(hist_bins, payload, mask_rows, HB)
+                elif spec.hist_impl == "packed":
+                    # quantized-gradient packed-int scatter (2 sweeps);
+                    # scales ride in feat["qscales"] (booster/fused set
+                    # them right after quantize_gradients)
+                    from .histogram import leaf_histogram_packed
+                    h = leaf_histogram_packed(hist_bins, payload,
+                                              mask_rows, HB,
+                                              feat["qscales"][0],
+                                              feat["qscales"][1])
                 else:
                     h = leaf_histogram(hist_bins, payload, mask_rows, HB)
                 if axis_name is not None:
@@ -540,6 +558,20 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             # features used on each leaf's root path (ref: col_sampler.hpp
             # interaction-constraint filtering; CEGB lazy feature costs)
             state["leaf_used"] = jnp.zeros((L, F), bool)
+        interm = spec.monotone_intermediate
+        if interm:
+            if pooled:
+                raise ValueError("monotone intermediate requires the "
+                                 "un-pooled histogram layout")
+            # ancestor incidence: anc_left[leaf, s] ⇔ leaf lies in the left
+            # subtree of the split made at step s (ref:
+            # monotone_constraints.hpp IntermediateLeafConstraints tracks
+            # the same relation via tree walks)
+            state["anc_left"] = jnp.zeros((L, L - 1), bool)
+            state["anc_right"] = jnp.zeros((L, L - 1), bool)
+            # node id of the search that produced each leaf's cached split
+            # (reproduces per-node column samples on re-search)
+            state["leaf_nid"] = jnp.zeros((L,), jnp.int32)
 
         def cond(st):
             go = (jnp.max(st["leaf_gain"]) > 0.0)
@@ -657,6 +689,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                     st["leaf_c"][best]),
             )
 
+            def put2(arr, a, b):
+                return arr.at[best].set(a).at[new].set(b)
+
             # ---- child outputs: smoothing → monotone clamp ----
             lb, ub = st["leaf_lb"][best], st["leaf_ub"][best]
             parent_out = st["leaf_out"][best]
@@ -665,16 +700,98 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                                  spec.path_smooth)
             r_sm = smooth_output(clamp_output(rg, rh), rc, parent_out,
                                  spec.path_smooth)
-            l_out = jnp.clip(l_sm, lb, ub)
-            r_out = jnp.clip(r_sm, lb, ub)
-            mid = 0.5 * (l_out + r_out)
-            l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
-            r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
-            l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
-            r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
-            # the children's own (final) outputs, clamped to THEIR bounds
-            l_fin = jnp.clip(l_sm, l_lb, l_ub)
-            r_fin = jnp.clip(r_sm, r_lb, r_ub)
+            if not interm:
+                # basic method: one-shot midpoint bounds at creation
+                l_out = jnp.clip(l_sm, lb, ub)
+                r_out = jnp.clip(r_sm, lb, ub)
+                mid = 0.5 * (l_out + r_out)
+                l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
+                r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
+                l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
+                r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
+                # children's own (final) outputs, clamped to THEIR bounds
+                l_fin = jnp.clip(l_sm, l_lb, l_ub)
+                r_fin = jnp.clip(r_sm, r_lb, r_ub)
+            else:
+                # intermediate method: outputs only clip to the parent's
+                # bounds (the split search already enforced the direction
+                # between siblings); bounds for EVERY leaf are then
+                # recomputed from the current outputs of the opposite
+                # subtree of each monotone ancestor
+                l_fin = jnp.clip(l_sm, lb, ub)
+                r_fin = jnp.clip(r_sm, lb, ub)
+                anc_left = st["anc_left"].at[new].set(st["anc_left"][best])\
+                    .at[best, step].set(True)
+                anc_right = st["anc_right"].at[new]\
+                    .set(st["anc_right"][best]).at[new, step].set(True)
+                leaf_out_upd = put2(st["leaf_out"], l_fin, r_fin)
+                leaf_nid = put2(st["leaf_nid"], 2 * step + 1, 2 * step + 2)
+                signs = jnp.where(nodes["split_is_cat"], 0,
+                                  mono[nodes["split_feature"]])    # [L-1]
+                act = jnp.arange(L) < (new + 1)
+                Ml = anc_left & act[:, None]                       # [L,L-1]
+                Mr = anc_right & act[:, None]
+                outs_r = leaf_out_upd[None, :]                     # [1, L]
+                left_max = jnp.max(jnp.where(Ml.T, outs_r, -INF), axis=1)
+                left_min = jnp.min(jnp.where(Ml.T, outs_r, INF), axis=1)
+                right_max = jnp.max(jnp.where(Mr.T, outs_r, -INF), axis=1)
+                right_min = jnp.min(jnp.where(Mr.T, outs_r, INF), axis=1)
+                pos_s = (signs == 1)[None, :]
+                neg_s = (signs == -1)[None, :]
+                new_ub = jnp.minimum(
+                    jnp.min(jnp.where(Ml & pos_s, right_min[None, :], INF),
+                            axis=1),
+                    jnp.min(jnp.where(Mr & neg_s, left_min[None, :], INF),
+                            axis=1))
+                new_lb = jnp.maximum(
+                    jnp.max(jnp.where(Mr & pos_s, left_max[None, :], -INF),
+                            axis=1),
+                    jnp.max(jnp.where(Ml & neg_s, right_max[None, :],
+                                      -INF), axis=1))
+                l_lb, l_ub = new_lb[best], new_ub[best]
+                r_lb, r_ub = new_lb[new], new_ub[new]
+                slotL = jnp.arange(L)
+                bounds_moved = act & (slotL != best) & (slotL != new) & \
+                    ((new_lb != st["leaf_lb"]) | (new_ub != st["leaf_ub"]))
+
+                def reeval(_):
+                    """Re-search cached best splits of leaves whose bounds
+                    moved (ref: IntermediateLeafConstraints
+                    leaves_to_update re-running FindBestSplits)."""
+                    def eval_one(i):
+                        lu = st["leaf_used"][i] if track_used \
+                            else jnp.zeros((F,), bool)
+                        deep = (spec.max_depth <= 0) | \
+                            (st["leaf_depth"][i] < spec.max_depth)
+                        a = allowed & deep
+                        if spec.n_ic_groups:
+                            groups = feat["ic_groups"]
+                            ok_k = ~jnp.any(lu[None, :] & ~groups, axis=1)
+                            a = a & jnp.any(groups & ok_k[:, None], axis=0)
+                        a = a & bynode_mask(st["leaf_nid"][i])
+                        s = split_of(st["hist"][i], st["leaf_g"][i],
+                                     st["leaf_h"][i], st["leaf_c"][i], a,
+                                     new_lb[i], new_ub[i], leaf_out_upd[i],
+                                     cand_mask=extra_mask(st["leaf_nid"][i]),
+                                     penalty=cegb_penalty(st["leaf_c"][i],
+                                                          lu))
+                        return _split_to_arrays(s)
+                    return jax.vmap(eval_one)(jnp.arange(L))
+
+                def keep(_):
+                    return (st["leaf_gain"], st["leaf_feat"],
+                            st["leaf_thr"], st["leaf_dl"], st["leaf_lg"],
+                            st["leaf_lh"], st["leaf_lc"], st["leaf_rg"],
+                            st["leaf_rh"], st["leaf_rc"], st["leaf_iscat"],
+                            st["leaf_catmask"])
+
+                searched = jax.lax.cond(bounds_moved.any(), reeval, keep,
+                                        None)
+                cur = keep(None)
+                upd = tuple(
+                    jnp.where(bounds_moved.reshape((L,) + (1,) *
+                                                   (c.ndim - 1)), s_, c)
+                    for s_, c in zip(searched, cur))
 
             # ---- histogram: smaller child scanned, larger by subtraction ----
             left_smaller = lc <= rc
@@ -724,31 +841,41 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                           cand_mask=extra_mask(2 * step + 2),
                           penalty=cegb_penalty(rc, child_used))
 
-            def put2(arr, a, b):
-                return arr.at[best].set(a).at[new].set(b)
-
             la, ra = _split_to_arrays(ls), _split_to_arrays(rs)
+            if interm:
+                base = upd
+                lb_arr, ub_arr = new_lb, new_ub
+                extra["anc_left"] = anc_left
+                extra["anc_right"] = anc_right
+                extra["leaf_nid"] = leaf_nid
+            else:
+                base = (st["leaf_gain"], st["leaf_feat"], st["leaf_thr"],
+                        st["leaf_dl"], st["leaf_lg"], st["leaf_lh"],
+                        st["leaf_lc"], st["leaf_rg"], st["leaf_rh"],
+                        st["leaf_rc"], st["leaf_iscat"], st["leaf_catmask"])
+                lb_arr = put2(st["leaf_lb"], l_lb, r_lb)
+                ub_arr = put2(st["leaf_ub"], l_ub, r_ub)
             new_state = dict(
                 **extra,
                 step=step + 1, nl=new + 1, leaf_id=leaf_id, hist=hist,
                 leaf_out=put2(st["leaf_out"], l_fin, r_fin),
-                leaf_gain=put2(st["leaf_gain"], la[0], ra[0]),
-                leaf_feat=put2(st["leaf_feat"], la[1], ra[1]),
-                leaf_thr=put2(st["leaf_thr"], la[2], ra[2]),
-                leaf_dl=put2(st["leaf_dl"], la[3], ra[3]),
-                leaf_lg=put2(st["leaf_lg"], la[4], ra[4]),
-                leaf_lh=put2(st["leaf_lh"], la[5], ra[5]),
-                leaf_lc=put2(st["leaf_lc"], la[6], ra[6]),
-                leaf_rg=put2(st["leaf_rg"], la[7], ra[7]),
-                leaf_rh=put2(st["leaf_rh"], la[8], ra[8]),
-                leaf_rc=put2(st["leaf_rc"], la[9], ra[9]),
-                leaf_iscat=put2(st["leaf_iscat"], la[10], ra[10]),
-                leaf_catmask=put2(st["leaf_catmask"], la[11], ra[11]),
+                leaf_gain=put2(base[0], la[0], ra[0]),
+                leaf_feat=put2(base[1], la[1], ra[1]),
+                leaf_thr=put2(base[2], la[2], ra[2]),
+                leaf_dl=put2(base[3], la[3], ra[3]),
+                leaf_lg=put2(base[4], la[4], ra[4]),
+                leaf_lh=put2(base[5], la[5], ra[5]),
+                leaf_lc=put2(base[6], la[6], ra[6]),
+                leaf_rg=put2(base[7], la[7], ra[7]),
+                leaf_rh=put2(base[8], la[8], ra[8]),
+                leaf_rc=put2(base[9], la[9], ra[9]),
+                leaf_iscat=put2(base[10], la[10], ra[10]),
+                leaf_catmask=put2(base[11], la[11], ra[11]),
                 leaf_g=put2(st["leaf_g"], lg, rg),
                 leaf_h=put2(st["leaf_h"], lh, rh),
                 leaf_c=put2(st["leaf_c"], lc, rc),
-                leaf_lb=put2(st["leaf_lb"], l_lb, r_lb),
-                leaf_ub=put2(st["leaf_ub"], l_ub, r_ub),
+                leaf_lb=lb_arr,
+                leaf_ub=ub_arr,
                 leaf_depth=put2(st["leaf_depth"], depth, depth),
                 nodes=nodes,
             )

@@ -17,10 +17,12 @@ Strategy mapping (SURVEY §2.7):
  - feature → bins replicated, per-shard feature-block search, SplitInfo
              allreduce-max, shard-local split apply
              (ref: feature_parallel_tree_learner.cpp).
- - voting  → data-parallel with top-k vote (ref:
-             voting_parallel_tree_learner.cpp); currently served by the
-             data strategy (full reduce over ICI is cheap at in-scope
-             feature counts) — a warning documents the fallback.
+ - voting  → real PV-Tree (ref: voting_parallel_tree_learner.cpp): each
+             shard proposes its local top-k features, a deterministic
+             global election picks ~2·top_k, and only the elected
+             features' histograms are psum-reduced (`mode="voting"` in
+             ops/grow.py; election subset asserted in
+             tests/test_distributed.py).
 
 Row counts need not divide the shard count: rows are padded with
 weight-0 entries inside the jitted wrapper (the fixed-shape analog of the
@@ -123,8 +125,9 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
     def padded(bins_fm, grad, hess, sw, feat, allowed):
         if f_extra:
             # pad the per-feature [F] arrays; ic_groups is [K, F] (axis 1),
-            # ff_key is an RNG key (no feature axis)
-            feat = {k: (v if k == "ff_key"
+            # ff_key (RNG key) and qscales (quantization scales) have no
+            # feature axis
+            feat = {k: (v if k in ("ff_key", "qscales")
                         else jnp.pad(v, ((0, 0), (0, f_extra)))
                         if k == "ic_groups"
                         else jnp.pad(v, (0, f_extra)))

@@ -190,6 +190,12 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),
     "tpu_num_shards": (0, "int", ()),        # 0 = all visible devices
+    # debug mode: enable jax_debug_nans so any NaN/Inf produced inside the
+    # jitted training step raises FloatingPointError at the offending op
+    # (our analog of the reference's USE_SANITIZER builds,
+    # ref: cmake/Sanitizer.cmake — TPU/XLA is functional so memory races
+    # can't happen; numeric poison is the failure class that remains)
+    "tpu_debug_nans": (False, "bool", ()),
     "saved_feature_importance_type": (0, "int", ()),
     "snapshot_freq": (-1, "int", ("save_period",)),
     "output_model": ("LightGBM_model.txt", "str", ("model_output", "model_out")),

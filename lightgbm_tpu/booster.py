@@ -248,7 +248,12 @@ class Booster:
                      "use_missing", "zero_as_missing", "data_random_seed",
                      "max_bin_by_feature", "feature_pre_filter",
                      "enable_bundle", "max_conflict_rate", "linear_tree",
-                     "label_column", "header")}}
+                     "label_column", "header",
+                     # file-ingest column roles + streaming mode must reach
+                     # construct(), or train and predict would drop
+                     # different columns from the same file
+                     "weight_column", "group_column", "ignore_column",
+                     "two_round")}}
         self.train_set = train_set
         self._dd = _DeviceData(train_set)
         self.objective_: Optional[ObjectiveFunction] = \

@@ -56,10 +56,19 @@ def parse_args(argv: List[str]) -> Dict[str, str]:
 
 
 def _sniff_format(path: str) -> Tuple[str, bool]:
-    """Detect csv/tsv/libsvm + header (ref: parser.cpp auto-detection)."""
+    """Detect csv/tsv/space/libsvm + header (ref: parser.cpp
+    auto-detection).  Space is a first-class delimiter — the classic
+    LibSVM layout is space-delimited, and sniffing it as one tsv token
+    would silently dense-parse 'idx:val' fields as bare numbers."""
     with open(path) as f:
         first = f.readline()
-    sep = "\t" if first.count("\t") >= first.count(",") else ","
+    commas, tabs, spaces = (first.count(c) for c in (",", "\t", " "))
+    if commas >= tabs and commas >= spaces:
+        sep, fmt = ",", "csv"
+    elif tabs >= spaces:
+        sep, fmt = "\t", "tsv"
+    else:
+        sep, fmt = " ", "space"
     tokens = first.strip().split(sep)
     if any(":" in t for t in tokens[1:3] if t):
         return "libsvm", False
@@ -70,16 +79,80 @@ def _sniff_format(path: str) -> Tuple[str, bool]:
         except ValueError:
             return False
     has_header = not all(_is_num(t) for t in tokens if t != "")
-    return ("tsv" if sep == "\t" else "csv"), has_header
+    return fmt, has_header
+
+
+def parse_column_spec(spec: str, what: str) -> Optional[int]:
+    """Column-role param → index (ref: dataset_loader.cpp label_idx /
+    weight_idx / group_idx resolution).  'name:' forms need header-name
+    plumbing we don't do — raise with the workaround."""
+    if spec == "":
+        return None
+    if spec.startswith("name:"):
+        raise LightGBMError(
+            f"{what}=name: requires header parsing; use column index "
+            f"form (e.g. {what}=0)")
+    return int(spec)
+
+
+def column_roles(config: Config):
+    """(label, weight, group, drop-list) FILE column indexes from config
+    (ref: config.h + docs/Parameters.rst: `label_column` counts all file
+    columns, but `weight_column`/`group_column`/`ignore_column` indexes
+    "don't count the label column" — e.g. label at column_0 + weight at
+    file column_1 is written `weight_column=0`).  `drop` is the sorted
+    set of file columns to remove from the feature matrix — the ONE
+    place that set is computed (whole-file and streaming ingest must
+    drop identical columns)."""
+    label = parse_column_spec(config.label_column, "label_column") or 0
+
+    def skip_label(idx):
+        return idx if idx is None or idx < label else idx + 1
+
+    weight = skip_label(parse_column_spec(config.weight_column,
+                                          "weight_column"))
+    group = skip_label(parse_column_spec(config.group_column,
+                                         "group_column"))
+    drop = {label}
+    if config.ignore_column:
+        for tok in str(config.ignore_column).split(","):
+            tok = tok.strip()
+            if tok:
+                drop.add(skip_label(parse_column_spec(tok,
+                                                      "ignore_column")))
+    if weight is not None:
+        drop.add(weight)
+    if group is not None:
+        drop.add(group)
+    return label, weight, group, sorted(drop)
+
+
+def group_ids_to_sizes(ids: np.ndarray) -> np.ndarray:
+    """Per-row query ids (contiguous) → group sizes (ref: metadata.cpp
+    Metadata::SetQuery from query ids)."""
+    if len(ids) == 0:
+        return np.zeros(0, np.int64)
+    change = np.nonzero(np.diff(ids))[0] + 1
+    bounds = np.concatenate([[0], change, [len(ids)]])
+    return np.diff(bounds)
 
 
 def load_data_file(path: str, config: Config
                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
     """Load a training/prediction text file → (X, label or None).
+    Column-role extras (weight/group/ignored) via `load_data_file_full`.
 
     ref: src/io/parser.cpp CSVParser/TSVParser/LibSVMParser;
     label_column handling in dataset_loader.cpp.
     """
+    X, y, _ = load_data_file_full(path, config)
+    return X, y
+
+
+def load_data_file_full(path: str, config: Config):
+    """(X, label, extras) where extras holds 'weight' and 'group'
+    (sizes) when weight_column/group_column are configured; ignored
+    columns are dropped from X (ref: dataset_loader.cpp column roles)."""
     fmt, has_header = _sniff_format(path)
     if config.header:
         has_header = True
@@ -90,10 +163,10 @@ def load_data_file(path: str, config: Config
         except ValueError:
             data = None  # malformed for the strict parser → sklearn
         if data is not None:
-            return data[:, 1:].copy(), data[:, 0].copy()
+            return data[:, 1:].copy(), data[:, 0].copy(), {}
         from sklearn.datasets import load_svmlight_file
         X, y = load_svmlight_file(path)
-        return np.asarray(X.todense(), dtype=np.float64), y
+        return np.asarray(X.todense(), dtype=np.float64), y, {}
     try:
         native = parse_dense(path)
     except ValueError:
@@ -105,22 +178,21 @@ def load_data_file(path: str, config: Config
             # the user declared a header the numeric sniff didn't catch
             data = data[1:]
     else:
-        sep = "\t" if fmt == "tsv" else ","
+        sep = {"tsv": "\t", "space": None}.get(fmt, ",")  # None = any ws
         data = np.genfromtxt(path, delimiter=sep,
                              skip_header=1 if has_header else 0,
                              dtype=np.float64)
     if data.ndim == 1:
         data = data.reshape(-1, 1)
-    label_col = 0
-    lc = config.label_column
-    if lc.startswith("name:"):
-        raise LightGBMError("label_column=name: requires header parsing; "
-                            "use column index form (e.g. label_column=0)")
-    if lc != "":
-        label_col = int(lc)
+    label_col, weight_col, group_col, drop = column_roles(config)
     y = data[:, label_col].copy()
-    X = np.delete(data, label_col, axis=1)
-    return X, y
+    extras = {}
+    if weight_col is not None:
+        extras["weight"] = data[:, weight_col].copy()
+    if group_col is not None:
+        extras["group"] = group_ids_to_sizes(data[:, group_col])
+    X = np.delete(data, drop, axis=1)
+    return X, y, extras
 
 
 def run(argv: List[str]) -> int:
@@ -136,13 +208,15 @@ def run(argv: List[str]) -> int:
     if task == "train":
         if not config.data:
             raise LightGBMError("No training data file (set data=...)")
-        X, y = load_data_file(config.data, config)
-        train_set = Dataset(X, label=y, params=dict(params))
+        # the PATH goes straight into Dataset: construct() applies the
+        # column roles itself and, under two_round=true, streams the file
+        # without materializing the raw float64 matrix — loading it here
+        # would defeat exactly that (CLI is two_round's primary interface)
+        train_set = Dataset(config.data, params=dict(params))
         valid_sets = []
         valid_names = []
         for i, vf in enumerate(config.valid):
-            vx, vy = load_data_file(vf, config)
-            valid_sets.append(train_set.create_valid(vx, label=vy))
+            valid_sets.append(train_set.create_valid(vf))
             valid_names.append(f"valid_{i}")
         from .callback import log_evaluation
         booster = engine_train(

@@ -62,6 +62,15 @@ def get_lib():
         lib.lgbtpu_values_to_bins.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p]
+        lib.lgbtpu_stream_open.restype = ctypes.c_void_p
+        lib.lgbtpu_stream_open.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32)]
+        lib.lgbtpu_stream_next.restype = ctypes.c_int64
+        lib.lgbtpu_stream_next.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.lgbtpu_stream_close.restype = None
+        lib.lgbtpu_stream_close.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -111,6 +120,66 @@ def parse_libsvm(path: str) -> Optional[np.ndarray]:
     if rc != 0:
         raise ValueError(f"native libsvm parse failed (rc={rc}): {path}")
     return out
+
+
+class StreamReader:
+    """Chunked dense-text reader over the native streaming API (ref:
+    utils/pipeline_reader.h `PipelineReader`): the file is parsed in
+    caller-sized row chunks and never materialized whole.  Use as an
+    iterator of float64 [<=chunk_rows, n_cols] arrays, or call
+    `next_chunk()` directly.  Raises ValueError if the native library is
+    unavailable (callers fall back to the whole-file path)."""
+
+    def __init__(self, path: str, chunk_rows: int = 65536):
+        lib = get_lib()
+        if lib is None:
+            raise ValueError("native library unavailable")
+        self._lib = lib
+        cols = ctypes.c_int64(0)
+        header = ctypes.c_int32(0)
+        self._h = lib.lgbtpu_stream_open(path.encode(), ctypes.byref(cols),
+                                         ctypes.byref(header))
+        if not self._h:
+            raise ValueError(f"cannot open/parse {path}")
+        self.n_cols = int(cols.value)
+        self.had_header = bool(header.value)
+        self.chunk_rows = int(chunk_rows)
+        self._buf = np.empty((self.chunk_rows, self.n_cols),
+                             dtype=np.float64)
+
+    def next_chunk(self) -> Optional[np.ndarray]:
+        """Next chunk (a VIEW into the reader's reusable buffer — copy if
+        you keep it), or None at EOF."""
+        if self._h is None:
+            return None
+        n = self._lib.lgbtpu_stream_next(
+            self._h, self._buf.ctypes.data_as(ctypes.c_void_p),
+            self.chunk_rows)
+        if n < 0:
+            self.close()
+            raise ValueError(f"malformed row mid-stream (rc={n})")
+        if n == 0:
+            self.close()
+            return None
+        return self._buf[:n]
+
+    def __iter__(self):
+        while True:
+            chunk = self.next_chunk()
+            if chunk is None:
+                return
+            yield chunk
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.lgbtpu_stream_close(self._h)
+            self._h = None
+
+    def __del__(self):  # pragma: no cover
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 def values_to_bins(vals: np.ndarray, bounds: np.ndarray,

@@ -47,6 +47,38 @@ static bool parse_field(const char *s, const char *end, double *out) {
   return true;
 }
 
+// Read one full (possibly >buf-sized) line, stripped of trailing \r\n.
+// Returns false at EOF with nothing read.
+static bool read_line(FILE *f, std::string &line) {
+  char buf[1 << 16];
+  if (!fgets(buf, sizeof(buf), f)) return false;
+  line.assign(buf);
+  while (!line.empty() && line.back() != '\n' &&
+         fgets(buf, sizeof(buf), f)) line += buf;
+  while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
+    line.pop_back();
+  return true;
+}
+
+// Split one data line into fields.  Returns false on any unparsable
+// field (the caller decides header-vs-error).
+static bool split_fields(const std::string &line, char delim,
+                         std::vector<double> &vals) {
+  vals.clear();
+  const char *p = line.c_str();
+  const char *end = p + line.size();
+  while (p <= end) {
+    const char *q = p;
+    while (q < end && *q != delim) q++;
+    double v;
+    if (!parse_field(p, q, &v)) return false;
+    vals.push_back(v);
+    if (q >= end) break;
+    p = q + 1;
+  }
+  return true;
+}
+
 int64_t lgbtpu_parse_dense(const char *path, double *out,
                            int64_t *n_rows, int64_t *n_cols,
                            int32_t *had_header) {
@@ -54,7 +86,6 @@ int64_t lgbtpu_parse_dense(const char *path, double *out,
   if (!f) return -1;
   std::string line;
   line.reserve(1 << 16);
-  char buf[1 << 16];
   char delim = 0;
   int64_t rows = 0, cols = 0;
   bool probing = (out == nullptr);
@@ -63,29 +94,10 @@ int64_t lgbtpu_parse_dense(const char *path, double *out,
   *had_header = 0;
   bool first = true;
   std::vector<double> vals;
-  while (fgets(buf, sizeof(buf), f)) {
-    line.assign(buf);
-    // handle long lines
-    while (!line.empty() && line.back() != '\n' &&
-           fgets(buf, sizeof(buf), f)) line += buf;
-    while (!line.empty() && (line.back() == '\n' || line.back() == '\r'))
-      line.pop_back();
+  while (read_line(f, line)) {
     if (line.empty()) continue;
     if (!delim) delim = detect_delim(line);
-    vals.clear();
-    const char *p = line.c_str();
-    const char *end = p + line.size();
-    bool ok = true;
-    while (p <= end) {
-      const char *q = p;
-      while (q < end && *q != delim) q++;
-      double v;
-      if (!parse_field(p, q, &v)) { ok = false; break; }
-      vals.push_back(v);
-      if (q >= end) break;
-      p = q + 1;
-    }
-    if (!ok) {
+    if (!split_fields(line, delim, vals)) {
       if (first) { *had_header = 1; first = false; continue; }
       fclose(f);
       return -2;  // malformed mid-file
@@ -163,6 +175,74 @@ int64_t lgbtpu_parse_libsvm(const char *path, double *out,
     else *n_cols = saw_zero ? (max_idx + 1) : max_idx;
   }
   return 0;
+}
+
+// ----------------------------------------------------------- streaming read
+// Chunked dense-text reader (ref: include/LightGBM/utils/pipeline_reader.h
+// `PipelineReader` + dataset_loader.cpp two-pass loading): open once,
+// pull row chunks into a caller buffer — the file is never materialized
+// whole.  Backs the Python two_round=true streaming construct, which
+// keeps host peak at O(chunk + binned output) instead of O(N*F*8).
+struct LgbtpuStream {
+  FILE *f;
+  char delim;
+  int64_t cols;
+  std::vector<double> vals;
+};
+
+void *lgbtpu_stream_open(const char *path, int64_t *n_cols,
+                         int32_t *had_header) {
+  FILE *f = fopen(path, "rb");
+  if (!f) return nullptr;
+  LgbtpuStream *s = new LgbtpuStream();
+  s->f = f;
+  s->delim = 0;
+  s->cols = 0;
+  *had_header = 0;
+  // probe the first data line for delimiter/width/header, then rewind
+  std::string line;
+  long data_start = 0;
+  while (read_line(f, line)) {
+    if (line.empty()) { data_start = ftell(f); continue; }
+    if (!s->delim) s->delim = detect_delim(line);
+    if (!split_fields(line, s->delim, s->vals)) {
+      if (!*had_header) {                // header line — skip it
+        *had_header = 1;
+        data_start = ftell(f);
+        continue;
+      }
+      fclose(f); delete s; return nullptr;
+    }
+    s->cols = (int64_t)s->vals.size();
+    break;
+  }
+  if (s->cols == 0) { fclose(f); delete s; return nullptr; }
+  fseek(f, data_start, SEEK_SET);
+  *n_cols = s->cols;
+  return s;
+}
+
+int64_t lgbtpu_stream_next(void *handle, double *out, int64_t max_rows) {
+  LgbtpuStream *s = (LgbtpuStream *)handle;
+  std::string line;
+  int64_t rows = 0;
+  while (rows < max_rows && read_line(s->f, line)) {
+    if (line.empty()) continue;
+    if (!split_fields(line, s->delim, s->vals)) return -2;  // malformed
+    if ((int64_t)s->vals.size() != s->cols) return -3;
+    memcpy(out + rows * s->cols, s->vals.data(),
+           s->cols * sizeof(double));
+    rows++;
+  }
+  return rows;
+}
+
+void lgbtpu_stream_close(void *handle) {
+  LgbtpuStream *s = (LgbtpuStream *)handle;
+  if (s) {
+    fclose(s->f);
+    delete s;
+  }
 }
 
 // ------------------------------------------------------------- bin mapping

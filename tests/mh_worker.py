@@ -1,0 +1,59 @@
+"""Worker for test_multihost.py — one process of a 2-process
+jax.distributed CPU cluster (Gloo collectives over loopback).
+
+Every process executes the IDENTICAL SPMD program (multi-controller jax:
+a conditional collective deadlocks the cluster) and dumps the replicated
+tree fields for the parent test to compare.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    outdir = sys.argv[4]
+
+    import jax
+    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                               num_processes=nproc, process_id=pid)
+    import numpy as np
+
+    import __graft_entry__ as g
+    from lightgbm_tpu.parallel import (get_mesh, make_sharded_train_step,
+                                       shard_dataset)
+
+    assert jax.process_count() == nproc
+    bins, y, spec, feat, allowed = g._toy_problem(n=512, f=8)
+
+    def grad_fn(score, label):
+        p = jax.nn.sigmoid(score)
+        return p - label, p * (1 - p)
+
+    mesh = get_mesh()                 # all global devices
+    step = make_sharded_train_step(spec, mesh, grad_fn, 0.1)
+    dev_bins, dev_label, dev_w, _ = shard_dataset(bins, y, mesh)
+    score = jax.device_put(
+        np.zeros(len(y), np.float32),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec("data")))
+    new_score, tree = step(score, dev_label, dev_w, dev_bins, feat, allowed)
+    jax.block_until_ready(new_score)
+
+    # replicated outputs are fully addressable on every process
+    np.savez(os.path.join(outdir, f"proc{pid}.npz"),
+             n_splits=int(tree.n_splits),
+             split_leaf=np.asarray(tree.split_leaf),
+             split_feature=np.asarray(tree.split_feature),
+             threshold_bin=np.asarray(tree.threshold_bin),
+             leaf_value=np.asarray(tree.leaf_value),
+             n_devices=jax.device_count())
+    print(f"proc {pid}: OK, {int(tree.n_splits)} splits over "
+          f"{jax.device_count()} devices", flush=True)
+
+
+if __name__ == "__main__":
+    main()

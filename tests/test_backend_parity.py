@@ -1,0 +1,74 @@
+"""CPU-vs-TPU backend parity (the analog of the reference's
+tests/python_package_test/test_dual.py CPU-vs-GPU parity suite).
+
+The real chip is reached through a remote tunnel that can be wedged for
+an entire session (it hung in rounds 2 and 3), so the TPU half runs in a
+SUBPROCESS with a hard timeout and the test SKIPS — naming the wedge —
+when the backend does not answer.  When it does answer, the same tiny
+deterministic training job must produce near-identical predictions on
+both backends (f32 accumulation-order differences allowed, nothing
+else).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_JOB = """
+import sys, json
+sys.path.insert(0, {repo!r})
+import numpy as np
+import jax
+devs = jax.devices()
+import lightgbm_tpu as lgb
+rng = np.random.RandomState(5)
+X = rng.randn(1200, 8); X[rng.rand(1200, 8) < 0.05] = np.nan
+y = (np.nan_to_num(X[:, 0]) - 0.6 * np.nan_to_num(X[:, 1]) > 0)\
+    .astype(float)
+bst = lgb.train({{"objective": "binary", "num_leaves": 15,
+                 "deterministic": True, "verbosity": -1}},
+                lgb.Dataset(X, label=y), num_boost_round=8)
+print("RESULT " + json.dumps({{
+    "platform": devs[0].platform,
+    "preds": bst.predict(X[:200]).tolist()}}))
+"""
+
+
+def _run_job(env, timeout):
+    code = _JOB.format(repo=REPO)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       timeout=timeout, env=env, text=True, cwd=REPO)
+    if r.returncode != 0:
+        raise RuntimeError(r.stderr[-2000:])
+    for line in r.stdout.splitlines():
+        if line.startswith("RESULT "):
+            return json.loads(line[len("RESULT "):])
+    raise RuntimeError("no RESULT line:\n" + r.stdout[-1000:])
+
+
+def test_tpu_matches_cpu_when_chip_answers(tmp_path):
+    try:
+        tpu = _run_job(dict(os.environ), timeout=420)
+    except subprocess.TimeoutExpired:
+        pytest.skip("TPU backend did not answer within 420s (wedged axon "
+                    "tunnel — the round-2/3 failure mode); parity "
+                    "unverifiable this session")
+    except RuntimeError as e:
+        pytest.skip(f"TPU job failed to run: {e}")
+    if tpu["platform"] == "cpu":
+        pytest.skip("default backend resolved to CPU — no real chip "
+                    "visible in this session")
+
+    from lightgbm_tpu.utils.env import cleaned_cpu_env
+    cpu = _run_job(cleaned_cpu_env(os.environ, 1), timeout=420)
+    assert cpu["platform"] == "cpu"
+    np.testing.assert_allclose(np.asarray(tpu["preds"]),
+                               np.asarray(cpu["preds"]),
+                               rtol=2e-4, atol=2e-5)

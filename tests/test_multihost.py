@@ -1,0 +1,86 @@
+"""Multi-HOST distributed training simulation: 2 separate processes with
+4 virtual CPU devices each, joined by `jax.distributed.initialize` into
+one 8-device cluster with Gloo collectives over loopback.
+
+This is the analog of the reference's distributed tests
+(tests/distributed/_test_distributed.py spawns N CLI processes on
+localhost with machine_list files and a socket mesh) and closes the
+"multi-host path has no test" gap: the single-process 8-device suite
+(test_distributed.py) validates SPMD semantics, THIS file validates the
+actual cross-process runtime (`parallel.init` / jax.distributed) that
+replaces the reference's machines/ports bootstrap.
+"""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _spawn_cluster(tmp_path, port: int, nproc: int = 2,
+                   local_devices: int = 4, timeout: int = 600):
+    sys.path.insert(0, REPO)
+    from lightgbm_tpu.utils.env import cleaned_cpu_env
+    env = cleaned_cpu_env(os.environ, local_devices)
+    worker = os.path.join(REPO, "tests", "mh_worker.py")
+    procs = [subprocess.Popen(
+        [sys.executable, worker, str(i), str(nproc), str(port),
+         str(tmp_path)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, cwd=REPO) for i in range(nproc)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append(out)
+    return [p.returncode for p in procs], outs
+
+
+def test_two_process_cluster_matches_single_process(tmp_path):
+    rcs, outs = _spawn_cluster(tmp_path, port=12963)
+    assert rcs == [0, 0], "\n---\n".join(outs)[-3000:]
+
+    r0 = np.load(os.path.join(tmp_path, "proc0.npz"))
+    r1 = np.load(os.path.join(tmp_path, "proc1.npz"))
+    assert int(r0["n_devices"]) == 8
+    # both controllers must hold the identical replicated tree
+    for k in ("n_splits", "split_leaf", "split_feature", "threshold_bin"):
+        np.testing.assert_array_equal(r0[k], r1[k], err_msg=k)
+    np.testing.assert_allclose(r0["leaf_value"], r1["leaf_value"])
+    assert int(r0["n_splits"]) > 0
+
+    # and the cross-process cluster must agree with the same program run
+    # single-process on this test's own 8 virtual devices
+    import jax
+    import __graft_entry__ as g
+    from lightgbm_tpu.parallel import (get_mesh, make_sharded_train_step,
+                                      shard_dataset)
+    bins, y, spec, feat, allowed = g._toy_problem(n=512, f=8)
+
+    def grad_fn(score, label):
+        p = jax.nn.sigmoid(score)
+        return p - label, p * (1 - p)
+
+    mesh = get_mesh(8)
+    step = make_sharded_train_step(spec, mesh, grad_fn, 0.1)
+    dev_bins, dev_label, dev_w, _ = shard_dataset(bins, y, mesh)
+    score = jax.device_put(
+        np.zeros(len(y), np.float32),
+        jax.sharding.NamedSharding(mesh,
+                                   jax.sharding.PartitionSpec("data")))
+    _, tree = step(score, dev_label, dev_w, dev_bins, feat, allowed)
+    assert int(r0["n_splits"]) == int(tree.n_splits)
+    np.testing.assert_array_equal(r0["split_feature"],
+                                  np.asarray(tree.split_feature))
+    np.testing.assert_array_equal(r0["threshold_bin"],
+                                  np.asarray(tree.threshold_bin))
+    np.testing.assert_allclose(r0["leaf_value"],
+                               np.asarray(tree.leaf_value), rtol=1e-5,
+                               atol=1e-6)

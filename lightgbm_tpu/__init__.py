@@ -35,3 +35,14 @@ if _ilu.find_spec(".sklearn", __package__) is not None:
     from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                           LGBMRanker, LGBMRegressor)
     __all__ += ["LGBMModel", "LGBMClassifier", "LGBMRegressor", "LGBMRanker"]
+
+if _ilu.find_spec(".plotting", __package__) is not None:
+    # matplotlib/graphviz are imported lazily inside each function, so the
+    # re-export is safe without either installed (stock lightgbm exports
+    # these at package level the same way)
+    from .plotting import (create_tree_digraph,  # noqa: F401
+                           plot_importance, plot_metric,
+                           plot_split_value_histogram, plot_tree)
+    __all__ += ["plot_importance", "plot_metric",
+                "plot_split_value_histogram", "plot_tree",
+                "create_tree_digraph"]

@@ -60,10 +60,14 @@ def train(params: Dict[str, Any], train_set: Dataset,
     if isinstance(valid_sets, Dataset):
         valid_sets = [valid_sets]
     valid_names = valid_names or []
+    train_in_valid = False
     for i, vs in enumerate(valid_sets):
         if vs is train_set:
+            # ref: engine.py — the train set in valid_sets means "report
+            # training metrics", no training_metric param needed
             booster._train_data_name = (valid_names[i] if i < len(valid_names)
                                         else "training")
+            train_in_valid = True
             continue
         name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
         if vs.reference is None:
@@ -88,14 +92,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     begin_iteration = booster.current_iteration()
     end_iteration = begin_iteration + num_boost_round
 
+    want_train_eval = _eval_train_requested(params) or train_in_valid
     # nothing needs the host between iterations → fused device-side chunks
     if (not booster.valid_sets and feval is None and not callbacks_before
-            and not callbacks_after and not _eval_train_requested(params)):
+            and not callbacks_after and not want_train_eval):
         booster.update_many(num_boost_round)
         booster.best_iteration = booster.current_iteration()
         return booster
-
-    want_train_eval = _eval_train_requested(params)
     # eval-driven training also fuses: the chunk trainer emits per-iteration
     # train/valid score snapshots, metrics + callbacks run host-side from
     # those, and the host syncs once per chunk instead of per iteration.

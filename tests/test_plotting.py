@@ -1,0 +1,87 @@
+"""Plotting API (ref: tests/python_package_test/test_plotting.py —
+plot_importance / plot_metric / plot_split_value_histogram /
+create_tree_digraph / plot_tree smoke + semantics checks)."""
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(6)
+    X = rng.randn(500, 5)
+    y = (X[:, 0] - 0.5 * X[:, 1] > 0).astype(float)
+    ds = lgb.Dataset(X, label=y,
+                     feature_name=[f"feat_{i}" for i in range(5)])
+    evals = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=10,
+                    valid_sets=[ds], valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    return bst, evals
+
+
+@pytest.mark.quick
+def test_plot_importance(trained):
+    bst, _ = trained
+    ax = lgb.plot_importance(bst)
+    assert ax is not None
+    labels = [t.get_text() for t in ax.get_yticklabels()]
+    assert any(lab.startswith("feat_") for lab in labels)
+    plt.close("all")
+
+
+@pytest.mark.quick
+def test_plot_metric(trained):
+    bst, evals = trained
+    ax = lgb.plot_metric(evals)
+    assert ax is not None
+    assert len(ax.get_lines()) >= 1
+    # curve length == boosting rounds
+    assert len(ax.get_lines()[0].get_xdata()) == 10
+    plt.close("all")
+
+
+@pytest.mark.quick
+def test_plot_split_value_histogram(trained):
+    bst, _ = trained
+    ax = lgb.plot_split_value_histogram(bst, feature=0)
+    assert ax is not None
+    plt.close("all")
+
+
+@pytest.mark.quick
+def test_create_tree_digraph_and_plot_tree(trained):
+    bst, _ = trained
+    try:
+        g = lgb.create_tree_digraph(bst, tree_index=0)
+    except ImportError:
+        pytest.skip("graphviz python package not installed")
+    src = g.source if hasattr(g, "source") else str(g)
+    assert "split" in src or "leaf" in src
+    try:
+        ax = lgb.plot_tree(bst, tree_index=0)
+    except Exception as e:  # rendering needs the system `dot` binary
+        if "ExecutableNotFound" in type(e).__name__ or "dot" in str(e):
+            pytest.skip("graphviz `dot` executable not installed")
+        raise
+    assert ax is not None
+    plt.close("all")
+
+
+@pytest.mark.quick
+def test_plot_importance_empty_raises():
+    rng = np.random.RandomState(0)
+    X = rng.randn(100, 3)
+    ds = lgb.Dataset(X, label=np.zeros(100))
+    bst = lgb.train({"objective": "regression", "verbosity": -1}, ds,
+                    num_boost_round=1)
+    # constant target → no splits → importance empty
+    with pytest.raises(ValueError):
+        lgb.plot_importance(bst)
+    plt.close("all")

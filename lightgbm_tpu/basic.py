@@ -891,6 +891,42 @@ class Dataset:
             raise LightGBMError("Raw data was freed (free_raw_data=True)")
         return self.data
 
+    def get_params(self) -> Dict[str, Any]:
+        """ref: basic.py Dataset.get_params (the Dataset-relevant params)."""
+        return copy.deepcopy(self.params or {})
+
+    def set_reference(self, reference: "Dataset") -> "Dataset":
+        """Bin this dataset with `reference`'s mappers
+        (ref: basic.py Dataset.set_reference)."""
+        if self._handle_constructed and \
+                self.bin_mappers is not reference.bin_mappers:
+            raise LightGBMError(
+                "Cannot set reference after the Dataset was constructed; "
+                "set free_raw_data=False and create a new Dataset")
+        self.reference = reference
+        return self
+
+    def get_ref_chain(self, ref_limit: int = 100) -> set:
+        """ref: basic.py Dataset.get_ref_chain."""
+        head = self
+        chain = set()
+        while len(chain) < ref_limit:
+            if id(head) in {id(c) for c in chain}:
+                break
+            chain.add(head)
+            if head.reference is None:
+                break
+            head = head.reference
+        return chain
+
+    def feature_num_bin(self, feature: Union[int, str]) -> int:
+        """Bin count of one feature (ref: basic.py Dataset.feature_num_bin
+        → LGBM_DatasetGetFeatureNumBin)."""
+        self.construct()
+        if isinstance(feature, str):
+            feature = self.get_feature_name().index(feature)
+        return int(self.bin_mappers[int(feature)].num_bin)
+
     def num_total_data(self) -> int:
         return self.num_data()
 

@@ -775,6 +775,14 @@ class Booster:
                      fobj=None) -> bool:
         if train_set is not None and train_set is not self.train_set:
             self._init_train(train_set)
+        if getattr(self, "_dd", None) is None:
+            raise LightGBMError(
+                "Cannot train without a train set (was it freed by "
+                "free_dataset()?); prediction and model IO remain "
+                "available")
+        if getattr(self, "_scores_stale", False):
+            # set_leaf_output mutated the model — cached scores are wrong
+            self._rebuild_train_scores()
         fobj = fobj or self._fobj
         if fobj is not None and self._grower_spec.hist_impl == "packed":
             # ad-hoc update(fobj=...) on a booster whose grower was
@@ -1484,14 +1492,25 @@ class Booster:
             s = s / self.cur_iter
         return s
 
+    def _require_train_data(self) -> None:
+        if self.train_set is None or getattr(self, "_dd", None) is None:
+            raise LightGBMError(
+                "No training data attached (was it freed by "
+                "free_dataset()?)")
+        if getattr(self, "_scores_stale", False):
+            # set_leaf_output mutated the model — eval must see it too
+            self._rebuild_train_scores()
+
     def eval_train(self, feval=None) -> List[Tuple[str, str, float, bool]]:
         # ref: basic.py Booster.eval_train reports under _train_data_name
+        self._require_train_data()
         return self._eval_one(self._eval_score(self._train_score),
                               self.train_set,
                               getattr(self, "_train_data_name", "training"),
                               feval)
 
     def eval_valid(self, feval=None) -> List[Tuple[str, str, float, bool]]:
+        self._require_train_data()
         out = []
         for name, ds, score in zip(self.name_valid_sets, self.valid_sets,
                                    self._valid_scores):
@@ -1502,6 +1521,7 @@ class Booster:
     def eval(self, data: Dataset, name: str, feval=None):
         if data is self.train_set:
             return self.eval_train(feval)
+        self._require_train_data()
         for i, vs in enumerate(self.valid_sets):
             if data is vs:
                 return self._eval_one(self._eval_score(self._valid_scores[i]),
@@ -1839,6 +1859,227 @@ class Booster:
         if importance_type == "split":
             return out.astype(np.int32)
         return out
+
+    # -------------------------------------------- remaining stock surface
+    def set_train_data_name(self, name: str) -> "Booster":
+        """ref: basic.py Booster.set_train_data_name."""
+        self._train_data_name = str(name)
+        return self
+
+    def free_dataset(self) -> "Booster":
+        """Release the training/validation data (ref: basic.py
+        `Booster.free_dataset` / LGBM_BoosterFreeDataset): prediction and
+        model IO keep working, further training raises."""
+        if self.train_set is not None:
+            # prediction/model-text need these after the data is gone
+            self._loaded_feature_names = self.train_set.get_feature_name()
+        self.train_set = None
+        self._dd = None
+        self._train_bins = None
+        self._train_score = None   # num_data-sized device arrays
+        self._ones = None
+        self._valid_dd = []
+        self._valid_scores = []
+        self.valid_sets = []
+        return self
+
+    def free_network(self) -> "Booster":
+        """No-op (ref: basic.py Booster.free_network — the socket mesh
+        teardown; XLA collectives over ICI/DCN need none)."""
+        return self
+
+    def set_network(self, *args, **kwargs) -> "Booster":
+        """Accepted for API parity, with a warning (ref: basic.py
+        Booster.set_network/machines — the TPU backend replaces the
+        socket mesh with jax.distributed + device meshes; see
+        lightgbm_tpu.parallel.init)."""
+        log.warning("set_network is inert on the TPU backend — use "
+                    "lightgbm_tpu.parallel.init() + tree_learner=data "
+                    "for distributed training")
+        return self
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """In-memory string attributes (ref: basic.py Booster.set_attr;
+        value None deletes)."""
+        attr = getattr(self, "_attr", {})
+        for k, v in kwargs.items():
+            if v is None:
+                attr.pop(k, None)
+            else:
+                attr[k] = str(v)
+        self._attr = attr
+        return self
+
+    def get_attr(self, name: str) -> Optional[str]:
+        return getattr(self, "_attr", {}).get(name)
+
+    def lower_bound(self) -> float:
+        """Minimum possible raw score (ref: GBDT::GetLowerBoundValue —
+        sum over trees of each tree's smallest leaf output)."""
+        return float(sum(
+            float(np.min(t.leaf_value[:t.num_leaves]))
+            for t in self.trees)) if self.trees else 0.0
+
+    def upper_bound(self) -> float:
+        """ref: GBDT::GetUpperBoundValue."""
+        return float(sum(
+            float(np.max(t.leaf_value[:t.num_leaves]))
+            for t in self.trees)) if self.trees else 0.0
+
+    def get_leaf_output(self, tree_id: int, leaf_id: int) -> float:
+        """ref: LGBM_BoosterGetLeafValue."""
+        return float(self.trees[tree_id].leaf_value[leaf_id])
+
+    def set_leaf_output(self, tree_id: int, leaf_id: int,
+                        value: float) -> "Booster":
+        """Overwrite one leaf's output (ref: basic.py
+        Booster.set_leaf_output / Tree::SetLeafOutput).  Cached training
+        scores are rebuilt lazily before the next update()/eval."""
+        self.trees[tree_id].leaf_value[leaf_id] = float(value)
+        self._scores_stale = True
+        # the rollback cache holds the OLD leaf's contributions
+        self._last_contribs = []
+        return self
+
+    def shuffle_models(self, start_iteration: int = 0,
+                       end_iteration: int = -1) -> "Booster":
+        """Randomly permute whole iterations of trees in
+        [start_iteration, end_iteration) (ref: basic.py
+        Booster.shuffle_models / GBDT::ShuffleModels).  The raw-score sum
+        is order-independent, so predictions are unchanged."""
+        K = self.num_tree_per_iteration
+        n_iter = len(self.trees) // K
+        end = n_iter if end_iteration < 0 else min(end_iteration, n_iter)
+        start = max(0, start_iteration)
+        if end - start > 1:
+            idx = np.arange(start, end)
+            np.random.shuffle(idx)
+            blocks = [self.trees[i * K:(i + 1) * K] for i in range(n_iter)]
+            reordered = blocks[:start] + [blocks[i] for i in idx] + \
+                blocks[end:]
+            self.trees = [t for b in reordered for t in b]
+            # the rollback cache refers to the pre-shuffle last iteration
+            self._last_contribs = []
+        return self
+
+    def get_split_value_histogram(self, feature, bins=None,
+                                  xgboost_style: bool = False):
+        """Histogram of this model's split thresholds for one feature
+        (ref: basic.py Booster.get_split_value_histogram).  Returns
+        (counts, bin_edges) like np.histogram, or a pandas DataFrame /
+        [SplitValue, Count] array when xgboost_style=True."""
+        fnames = self.feature_name()
+        fidx = fnames.index(feature) if isinstance(feature, str) \
+            else int(feature)
+        values = []
+        for t in self.trees:
+            ni = t.num_internal()
+            for i in range(ni):
+                if t.split_feature[i] == fidx and \
+                        not (t.decision_type[i] & 1):
+                    values.append(t.threshold[i])
+        n_unique = len(np.unique(values)) if values else 0
+        if bins is None or (np.isscalar(bins) and bins > n_unique):
+            # ref: basic.py — one bin per distinct split value by default
+            bins = max(n_unique, 1)
+        hist, edges = np.histogram(values, bins=bins)
+        if not xgboost_style:
+            return hist, edges
+        rows = np.column_stack([edges[1:], hist]).astype(np.float64)
+        rows = rows[rows[:, 1] > 0]
+        try:
+            import pandas as pd
+            return pd.DataFrame(rows, columns=["SplitValue", "Count"])
+        except ImportError:
+            return rows
+
+    def trees_to_dataframe(self):
+        """Model structure as one pandas DataFrame (ref: basic.py
+        Booster.trees_to_dataframe; same column set)."""
+        import pandas as pd
+        fnames = self.feature_name()
+        rows = []
+        for ti, t in enumerate(self.trees):
+            ni = t.num_internal()
+            parent = {}
+            depth = {("S", 0): 1} if ni else {("L", 0): 1}
+            for i in range(ni):
+                for child, tag in ((t.left_child[i], None),
+                                   (t.right_child[i], None)):
+                    key = ("L", ~child) if child < 0 else ("S", child)
+                    parent[key] = i
+                    depth[key] = depth.get(("S", i), 1) + 1
+
+            def node_index(key):
+                kind, idx = key
+                return f"{ti}-{'L' if kind == 'L' else 'S'}{idx}"
+
+            for i in range(ni):
+                dt = int(t.decision_type[i])
+                lc, rc = int(t.left_child[i]), int(t.right_child[i])
+                rows.append({
+                    "tree_index": ti,
+                    "node_depth": depth.get(("S", i), 1),
+                    "node_index": node_index(("S", i)),
+                    "left_child": node_index(
+                        ("L", ~lc) if lc < 0 else ("S", lc)),
+                    "right_child": node_index(
+                        ("L", ~rc) if rc < 0 else ("S", rc)),
+                    "parent_index": node_index(("S", parent[("S", i)]))
+                    if ("S", i) in parent else None,
+                    "split_feature": fnames[int(t.split_feature[i])]
+                    if int(t.split_feature[i]) < len(fnames)
+                    else str(int(t.split_feature[i])),
+                    "split_gain": float(t.split_gain[i]),
+                    "threshold": float(t.threshold[i]),
+                    "decision_type": "==" if dt & 1 else "<=",
+                    "missing_direction": "left" if dt & 2 else "right",
+                    "missing_type": {0: "None", 1: "Zero", 2: "NaN"}[
+                        (dt >> 2) & 3],
+                    "value": float(t.internal_value[i]),
+                    "weight": float(t.internal_weight[i]),
+                    "count": int(t.internal_count[i]),
+                })
+            for li in range(t.num_leaves):
+                key = ("L", li)
+                rows.append({
+                    "tree_index": ti,
+                    "node_depth": depth.get(key, 1),
+                    "node_index": node_index(key),
+                    "left_child": None, "right_child": None,
+                    "parent_index": node_index(("S", parent[key]))
+                    if key in parent else None,
+                    "split_feature": None, "split_gain": None,
+                    "threshold": None, "decision_type": None,
+                    "missing_direction": None, "missing_type": None,
+                    "value": float(t.leaf_value[li]),
+                    "weight": float(t.leaf_weight[li]),
+                    "count": int(t.leaf_count[li]),
+                })
+        return pd.DataFrame(rows)
+
+    def _rebuild_train_scores(self) -> None:
+        """Recompute cached train/valid scores from the current trees
+        (after set_leaf_output mutated the model)."""
+        K = self.num_tree_per_iteration
+
+        def replay(dd):
+            score = self._zero_score(dd)
+            if self._boost_from_average_done and \
+                    any(abs(v) > 1e-35 for v in self._init_scores):
+                add = np.asarray(self._init_scores, dtype=np.float32)
+                score = score + (add[0] if K == 1 else add[None, :])
+            for it in range(self.cur_iter):
+                for k in range(K):
+                    t = self.trees[it * K + k]
+                    score = self._apply_tree_to_score(
+                        score, t, dd, k, bias_included=True)
+            return score
+
+        self._train_score = replay(self._dd)
+        for i, dd in enumerate(self._valid_dd):
+            self._valid_scores[i] = replay(dd)
+        self._scores_stale = False
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
         """ref: basic.py Booster.reset_parameter (learning-rate schedules)."""

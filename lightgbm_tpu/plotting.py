@@ -153,22 +153,11 @@ def plot_split_value_histogram(booster, feature, bins=None, ax=None,
     import matplotlib.pyplot as plt
 
     booster = _to_booster(booster)
-    fnames = booster.feature_name()
-    if isinstance(feature, str):
-        fidx = fnames.index(feature)
-    else:
-        fidx = int(feature)
-    values = []
-    for t in booster.trees:
-        ni = t.num_internal()
-        for i in range(ni):
-            if t.split_feature[i] == fidx and not (t.decision_type[i] & 1):
-                values.append(t.threshold[i])
-    if not values:
+    hist, bin_edges = booster.get_split_value_histogram(feature, bins=bins)
+    if not hist.sum():
         raise ValueError(
             f"Cannot plot split value histogram, "
             f"because feature {feature} was not used in splitting")
-    hist, bin_edges = np.histogram(values, bins=bins or "auto")
     if ax is None:
         _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
     width = width_coef * (bin_edges[1] - bin_edges[0])

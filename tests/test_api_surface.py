@@ -1,0 +1,125 @@
+"""Remaining stock Booster/Dataset API surface (ref: basic.py —
+set/get_attr, leaf output access, bounds, shuffle_models,
+trees_to_dataframe, get_split_value_histogram, free_dataset,
+Dataset.get_params/set_reference/get_ref_chain/feature_num_bin)."""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+@pytest.fixture(scope="module")
+def trained():
+    rng = np.random.RandomState(9)
+    X = rng.randn(600, 4)
+    y = X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.randn(600)
+    ds = lgb.Dataset(X, label=y, free_raw_data=False)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1}, ds, num_boost_round=8)
+    return bst, X, y, ds
+
+
+@pytest.mark.quick
+def test_attrs(trained):
+    bst = trained[0]
+    bst.set_attr(foo="bar", n="3")
+    assert bst.get_attr("foo") == "bar" and bst.get_attr("n") == "3"
+    bst.set_attr(foo=None)
+    assert bst.get_attr("foo") is None
+
+
+@pytest.mark.quick
+def test_bounds_enclose_predictions(trained):
+    bst, X, _, _ = trained
+    raw = bst.predict(X, raw_score=True)
+    assert bst.lower_bound() <= raw.min() + 1e-6
+    assert bst.upper_bound() >= raw.max() - 1e-6
+    assert bst.lower_bound() < bst.upper_bound()
+
+
+@pytest.mark.quick
+def test_leaf_output_roundtrip_and_score_rebuild(trained):
+    bst, X, y, ds = trained
+    v = bst.get_leaf_output(0, 0)
+    bst.set_leaf_output(0, 0, v + 1.0)
+    assert bst.get_leaf_output(0, 0) == pytest.approx(v + 1.0)
+    # prediction reflects the mutation
+    p1 = bst.predict(X, raw_score=True)
+    bst.set_leaf_output(0, 0, v)
+    p2 = bst.predict(X, raw_score=True)
+    assert not np.allclose(p1, p2)
+    # training continues correctly after mutation (scores rebuilt)
+    before = bst.current_iteration()
+    bst.update()
+    assert bst.current_iteration() == before + 1
+
+
+@pytest.mark.quick
+def test_shuffle_models_keeps_predictions():
+    # fresh booster: predict() honors best_iteration, so shuffling a
+    # booster whose tree count exceeds best_iteration would legitimately
+    # change which trees the prediction prefix covers
+    rng = np.random.RandomState(2)
+    X = rng.randn(400, 4)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+    bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    p0 = bst.predict(X)
+    np.random.seed(0)
+    bst.shuffle_models()
+    np.testing.assert_allclose(bst.predict(X), p0, rtol=1e-6)
+
+
+@pytest.mark.quick
+def test_trees_to_dataframe(trained):
+    bst = trained[0]
+    df = bst.trees_to_dataframe()
+    assert set(df.columns) >= {"tree_index", "node_index", "left_child",
+                               "right_child", "split_feature", "value",
+                               "count", "node_depth"}
+    n_leaves = sum(t.num_leaves for t in bst.trees)
+    n_internal = sum(t.num_internal() for t in bst.trees)
+    assert len(df) == n_leaves + n_internal
+    # every non-root node's parent exists
+    ids = set(df["node_index"])
+    parents = set(p for p in df["parent_index"] if isinstance(p, str))
+    assert parents <= ids
+
+
+@pytest.mark.quick
+def test_split_value_histogram(trained):
+    bst = trained[0]
+    hist, edges = bst.get_split_value_histogram(0)
+    assert hist.sum() > 0 and len(edges) == len(hist) + 1
+    xgb = bst.get_split_value_histogram(0, xgboost_style=True)
+    assert np.asarray(xgb)[:, 1].sum() == hist.sum()
+
+
+@pytest.mark.quick
+def test_free_dataset_blocks_training_not_predict(trained):
+    rng = np.random.RandomState(3)
+    X = rng.randn(300, 4)
+    y = X[:, 0]
+    bst = lgb.train({"objective": "regression", "num_leaves": 4,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=2)
+    bst.free_dataset()
+    assert np.isfinite(bst.predict(X)).all()
+    assert lgb.Booster(model_str=bst.model_to_string()) is not None
+    with pytest.raises(lgb.LightGBMError, match="free_dataset"):
+        bst.update()
+
+
+@pytest.mark.quick
+def test_dataset_surface(trained):
+    _, X, y, ds = trained
+    assert ds.get_params() is not ds.params
+    assert ds.feature_num_bin(0) > 1
+    v = ds.create_valid(X[:50], label=y[:50])
+    v.construct()
+    assert v in v.get_ref_chain() and ds in v.get_ref_chain()
+    d2 = lgb.Dataset(X[:100], label=y[:100])
+    d2.set_reference(ds)
+    d2.construct()
+    assert d2.bin_mappers is ds.bin_mappers

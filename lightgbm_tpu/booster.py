@@ -1979,7 +1979,8 @@ class Booster:
                         not (t.decision_type[i] & 1):
                     values.append(t.threshold[i])
         n_unique = len(np.unique(values)) if values else 0
-        if bins is None or (np.isscalar(bins) and bins > n_unique):
+        if bins is None or (not isinstance(bins, str)
+                            and np.isscalar(bins) and bins > n_unique):
             # ref: basic.py — one bin per distinct split value by default
             bins = max(n_unique, 1)
         hist, edges = np.histogram(values, bins=bins)
@@ -2064,11 +2065,10 @@ class Booster:
         K = self.num_tree_per_iteration
 
         def replay(dd):
+            # boost_from_average's bias is folded into iteration 0's trees
+            # (add_bias above) — replay onto the bare init-score base, the
+            # same recipe as add_valid's canonical replay
             score = self._zero_score(dd)
-            if self._boost_from_average_done and \
-                    any(abs(v) > 1e-35 for v in self._init_scores):
-                add = np.asarray(self._init_scores, dtype=np.float32)
-                score = score + (add[0] if K == 1 else add[None, :])
             for it in range(self.cur_iter):
                 for k in range(K):
                     t = self.trees[it * K + k]

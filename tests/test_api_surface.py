@@ -40,6 +40,7 @@ def test_bounds_enclose_predictions(trained):
 @pytest.mark.quick
 def test_leaf_output_roundtrip_and_score_rebuild(trained):
     bst, X, y, ds = trained
+    base_eval = bst.eval_train()[0][2]
     v = bst.get_leaf_output(0, 0)
     bst.set_leaf_output(0, 0, v + 1.0)
     assert bst.get_leaf_output(0, 0) == pytest.approx(v + 1.0)
@@ -48,6 +49,11 @@ def test_leaf_output_roundtrip_and_score_rebuild(trained):
     bst.set_leaf_output(0, 0, v)
     p2 = bst.predict(X, raw_score=True)
     assert not np.allclose(p1, p2)
+    # after restoring, the REBUILT cached scores must reproduce the
+    # original metric exactly (a bias double-count in the replay — e.g.
+    # adding init_score on top of bias-folded trees — breaks this)
+    rebuilt_eval = bst.eval_train()[0][2]
+    assert rebuilt_eval == pytest.approx(base_eval, rel=1e-6)
     # training continues correctly after mutation (scores rebuilt)
     before = bst.current_iteration()
     bst.update()

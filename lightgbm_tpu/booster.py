@@ -352,6 +352,7 @@ class Booster:
                 self.config.cegb_penalty_feature_lazy or [])),
             extra_trees=self.config.extra_trees,
             voting_top_k=self.config.top_k,
+            packed_const_hess_level=self._packed_const_hess_level(),
             monotone_intermediate=interm,
         )
         self._rng_key0 = jax.random.PRNGKey(
@@ -403,6 +404,22 @@ class Booster:
                 def _grad(score):
                     return self.objective_.grad_hess(score, lbl, wgt)
                 self._grad_fn = jax.jit(_grad)
+
+    def _packed_const_hess_level(self) -> int:
+        """Nonzero when the packed quantized histogram may derive counts
+        from the hess field (unit-hessian objective, no dataset weights,
+        packed impl selected): every live row quantizes to exactly
+        hq = num_grad_quant_bins, so counts = hess_field / level and the
+        count scatter sweep disappears — ONE sweep per histogram."""
+        from .objectives import UNIT_HESSIAN_OBJECTIVES
+        if self._resolve_hist_impl() != "packed":
+            return 0
+        if getattr(self.objective_, "name", None) \
+                not in UNIT_HESSIAN_OBJECTIVES:
+            return 0
+        if self.train_set.get_weight() is not None:
+            return 0
+        return int(self.config.num_grad_quant_bins)
 
     def _monotone_intermediate(self) -> bool:
         """Whether the grower runs the `intermediate` monotone method

@@ -36,6 +36,14 @@ def _apply_weight(grad, hess, weight):
     return grad * weight, hess * weight
 
 
+#: objectives whose hessian is identically 1 before weighting — an
+#: OPT-IN registry (exact name match) so a future RegressionL2 subclass
+#: with a non-unit hessian cannot silently inherit the packed histogram's
+#: derived-count shortcut (booster._packed_const_hess_level)
+UNIT_HESSIAN_OBJECTIVES = frozenset(
+    {"regression", "regression_l1", "huber", "quantile"})
+
+
 class ObjectiveFunction:
     """Base objective (ref: include/LightGBM/objective_function.h)."""
 

@@ -101,6 +101,9 @@ class GrowerSpec(NamedTuple):
     # voting-parallel (PV-Tree) local top-k (ref: config.h top_k /
     # voting_parallel_tree_learner.cpp)
     voting_top_k: int = 20
+    # packed quantized histogram with constant unit hessian: counts
+    # derive from the hess field (ONE scatter sweep); 0 = off
+    packed_const_hess_level: int = 0
     # monotone_constraints_method=intermediate (ref:
     # monotone_constraints.hpp `IntermediateLeafConstraints`): per-leaf
     # bounds are recomputed every split from the CURRENT outputs of the
@@ -333,10 +336,10 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                     # scales ride in feat["qscales"] (booster/fused set
                     # them right after quantize_gradients)
                     from .histogram import leaf_histogram_packed
-                    h = leaf_histogram_packed(hist_bins, payload,
-                                              mask_rows, HB,
-                                              feat["qscales"][0],
-                                              feat["qscales"][1])
+                    h = leaf_histogram_packed(
+                        hist_bins, payload, mask_rows, HB,
+                        feat["qscales"][0], feat["qscales"][1],
+                        const_hess_level=spec.packed_const_hess_level)
                 else:
                     h = leaf_histogram(hist_bins, payload, mask_rows, HB)
                 if axis_name is not None:

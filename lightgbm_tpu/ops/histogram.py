@@ -69,7 +69,8 @@ PACKED_MAX_QUANT_BINS = (2 ** 15 - 1) // PACKED_TILE
 
 
 def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
-                          max_bin: int, s_g: Array, s_h: Array) -> Array:
+                          max_bin: int, s_g: Array, s_h: Array,
+                          const_hess_level: int = 0) -> Array:
     """Quantized-gradient histogram with packed integer accumulation
     (ref: cuda_gradient_discretizer.cu + the int16/int32 packed histogram
     of v4 `use_quantized_grad`; the CUDA kernel packs (grad, hess) into one
@@ -84,6 +85,12 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
     grad field.  Two scatter sweeps per feature (packed + count) instead
     of the f32 path's three.
 
+    `const_hess_level > 0` declares every live row's hq equal to that
+    level (unit-hessian objectives — L2/L1/Huber/Quantile — with no
+    dataset weights quantize to exactly hq = num_grad_quant_bins): the
+    count scatter is DROPPED and counts derive as hess_field / level,
+    leaving ONE scatter sweep over the bin matrix per histogram.
+
     Returns the same [F, MB, 3] f32 (Σg, Σh, Σcount) as `leaf_histogram`,
     bit-identical-or-better: integer sums are exact where long f32 chains
     round.
@@ -92,6 +99,12 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
     d = jnp.where(row_mask[:, None], payload, 0.0)
     gq = jnp.round(d[:, 0] / s_g).astype(jnp.int32)
     hq = jnp.round(d[:, 1] / s_h).astype(jnp.int32)
+    if const_hess_level > 0:
+        # declared-constant hessian: force live rows to EXACTLY the level
+        # (f32 1/(1/nb) rounds below nb for nb in {7, 13, 14, 15}, where
+        # stochastic rounding would occasionally yield nb-1 and break the
+        # exact count derivation below)
+        hq = jnp.where(hq > 0, const_hess_level, 0)
     w = d[:, 2].astype(jnp.int32)
     packed = (gq << 16) + hq
 
@@ -109,11 +122,15 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
         def per_tile(ids, vals):
             return jax.ops.segment_sum(vals, ids, num_segments=max_bin)
         ph = jax.vmap(per_tile)(colf, pt)              # [T, MB] packed i32
-        cnt = jax.vmap(per_tile)(colf, wt).sum(axis=0)  # [MB]
         h_f = ph & 0xFFFF                              # < 2^15 per tile
         g_f = (ph - h_f) >> 16
+        h_sum = h_f.sum(axis=0)
+        if const_hess_level > 0:
+            cnt = h_sum // const_hess_level            # exact: hq ≡ level
+        else:
+            cnt = jax.vmap(per_tile)(colf, wt).sum(axis=0)  # [MB]
         return jnp.stack([g_f.sum(axis=0).astype(jnp.float32) * s_g,
-                          h_f.sum(axis=0).astype(jnp.float32) * s_h,
+                          h_sum.astype(jnp.float32) * s_h,
                           cnt.astype(jnp.float32)], axis=-1)   # [MB, 3]
 
     return jax.vmap(per_feature)(cols.reshape(F, T, PACKED_TILE))

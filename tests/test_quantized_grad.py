@@ -152,3 +152,90 @@ class TestPackedHistogram:
         from lightgbm_tpu.booster import Booster
         bst = Booster(params=params, train_set=lgb.Dataset(X, label=y))
         assert bst._grower_spec.hist_impl == "segment_sum"
+
+
+class TestPackedConstHess:
+    """Unit-hessian objectives drop the count scatter: counts derive
+    exactly from the hess field (hq == num_grad_quant_bins for every
+    live row)."""
+
+    def test_op_level_counts_exact(self):
+        import jax.numpy as jnp
+        from lightgbm_tpu.ops.histogram import (leaf_histogram,
+                                                leaf_histogram_packed)
+        rng = np.random.RandomState(8)
+        n, f, mb, nb = 4000, 5, 16, 8
+        bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+        gq = rng.randint(-nb // 2, nb // 2 + 1, n).astype(np.float32)
+        s_g, s_h = np.float32(0.037), np.float32(1.0 / nb)
+        w = (rng.rand(n) < 0.7).astype(np.float32)      # bagging 0/1
+        payload = jnp.stack([jnp.asarray(gq * s_g * w),
+                             jnp.asarray(nb * s_h * w),  # unit hessian
+                             jnp.asarray(w)], axis=1)
+        mask = jnp.asarray(rng.rand(n) < 0.5)
+        ref = leaf_histogram(bins, payload, mask, mb)
+        one_sweep = leaf_histogram_packed(bins, payload, mask, mb,
+                                          jnp.float32(s_g),
+                                          jnp.float32(s_h),
+                                          const_hess_level=nb)
+        np.testing.assert_allclose(np.asarray(one_sweep), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(one_sweep[..., 2]),
+                                      np.asarray(ref[..., 2]))
+
+    def test_e2e_l2_single_sweep_selected_and_learns(self):
+        rng = np.random.RandomState(1)
+        X = rng.randn(3000, 6)
+        y = X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.randn(3000)
+        from lightgbm_tpu.booster import Booster
+        import lightgbm_tpu as lgb_
+        bst = Booster(params={"objective": "regression", "num_leaves": 15,
+                              "use_quantized_grad": True,
+                              "num_grad_quant_bins": 8, "verbosity": -1},
+                      train_set=lgb_.Dataset(X, label=y))
+        assert bst._grower_spec.packed_const_hess_level == 8
+        bst.update_many(20)
+        mse = float(np.mean((bst.predict(X) - y) ** 2))
+        assert mse < np.var(y) * 0.2, mse
+
+    def test_weighted_or_nonunit_objectives_keep_count_sweep(self):
+        rng = np.random.RandomState(2)
+        X = rng.randn(500, 4)
+        y = (X[:, 0] > 0).astype(float)
+        from lightgbm_tpu.booster import Booster
+        import lightgbm_tpu as lgb_
+        q = {"use_quantized_grad": True, "num_grad_quant_bins": 8,
+             "verbosity": -1, "num_leaves": 7}
+        b1 = Booster(params={"objective": "binary", **q},
+                     train_set=lgb_.Dataset(X, label=y))
+        assert b1._grower_spec.packed_const_hess_level == 0
+        b2 = Booster(params={"objective": "regression", **q},
+                     train_set=lgb_.Dataset(X, label=y,
+                                            weight=rng.rand(500) + 0.5))
+        assert b2._grower_spec.packed_const_hess_level == 0
+
+    def test_nb7_stochastic_counts_exact(self):
+        """nb=7: f32 1/(1/7) rounds below 7, so stochastic rounding can
+        yield hq=6 — the const-hess clamp must keep derived counts exact
+        (code-review r3 finding)."""
+        import jax
+        import jax.numpy as jnp
+        from lightgbm_tpu.ops.fused import quantize_gradients
+        from lightgbm_tpu.ops.histogram import (leaf_histogram,
+                                                leaf_histogram_packed)
+        rng = np.random.RandomState(11)
+        n, f, mb, nb = 20000, 3, 16, 7
+        bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+        g = rng.randn(n).astype(np.float32)
+        h = np.ones(n, np.float32)
+        gq, hq, (sg, sh) = quantize_gradients(
+            jnp.asarray(g), jnp.asarray(h), nb,
+            key=jax.random.PRNGKey(5), return_scales=True)
+        w = jnp.ones(n, jnp.float32)
+        payload = jnp.stack([gq, hq, w], axis=1)
+        mask = jnp.ones(n, bool)
+        ref = leaf_histogram(bins, payload, mask, mb)
+        packed = leaf_histogram_packed(bins, payload, mask, mb, sg, sh,
+                                       const_hess_level=nb)
+        np.testing.assert_array_equal(np.asarray(packed[..., 2]),
+                                      np.asarray(ref[..., 2]))

@@ -877,7 +877,9 @@ class Booster:
             if self._grower_spec.hist_impl == "packed":
                 grad, hess, qs = quantize_gradients(
                     grad, hess, cfg.num_grad_quant_bins, qkey,
-                    return_scales=True)
+                    return_scales=True,
+                    const_hess_level=self._grower_spec
+                    .packed_const_hess_level)
                 qscales = jnp.stack(qs)
             else:
                 grad, hess = quantize_gradients(
@@ -2110,7 +2112,12 @@ class Booster:
             min_data_in_leaf=float(self.config.min_data_in_leaf),
             min_sum_hessian_in_leaf=self.config.min_sum_hessian_in_leaf,
             min_gain_to_split=self.config.min_gain_to_split,
-            max_delta_step=self.config.max_delta_step)
+            max_delta_step=self.config.max_delta_step,
+            # quantization params may have changed: a stale hist_impl /
+            # const-hess level would silently mis-scale histogram sums
+            hist_impl=self._resolve_hist_impl())
+        self._grower_spec = self._grower_spec._replace(
+            packed_const_hess_level=self._packed_const_hess_level())
         self._grower = make_grower(self._grower_spec)
         self._build_feat()
         self._setup_tree_learner()

@@ -66,7 +66,8 @@ def goss_weights(it, key0: Array, grad: Array, hess: Array, n: int, *,
 
 
 def quantize_gradients(grad: Array, hess: Array, n_bins: int,
-                       key: Array = None, return_scales: bool = False):
+                       key: Array = None, return_scales: bool = False,
+                       const_hess_level: int = 0):
     """Gradient discretization (ref: cuda_gradient_discretizer.cu /
     v4 quantized training `use_quantized_grad`): gradients snap to
     `n_bins` signed levels, hessians to `n_bins` unsigned levels, with
@@ -79,22 +80,35 @@ def quantize_gradients(grad: Array, hess: Array, n_bins: int,
     """
     half = max(n_bins // 2, 1)
     s_g = jnp.max(jnp.abs(grad)) / half
-    s_h = jnp.max(jnp.abs(hess)) / max(n_bins, 1)
     s_g = jnp.where(s_g > 0, s_g, 1.0)
-    s_h = jnp.where(s_h > 0, s_h, 1.0)
     vg = grad / s_g
-    vh = hess / s_h
+    if const_hess_level > 0:
+        # declared-constant hessian (exactly 1 before weighting): skip
+        # hessian quantization entirely so payload sums and the packed
+        # histogram's derived values agree EXACTLY — s_h = 1/level makes
+        # the kernel reconstruct hq = round(1/(1/level)) = level for
+        # every live row (stochastic floor on vh could yield level-1 for
+        # level in {7, 13, 14, 15} where f32 1/(1/nb) rounds below nb)
+        hq_s = hess
+        s_h = jnp.float32(1.0 / const_hess_level)
+    else:
+        s_h = jnp.max(jnp.abs(hess)) / max(n_bins, 1)
+        s_h = jnp.where(s_h > 0, s_h, 1.0)
+        vh = hess / s_h
+        if key is not None:
+            kh = jax.random.split(key)[1]
+            hq_s = jnp.floor(vh + jax.random.uniform(kh, hess.shape)) * s_h
+        else:
+            hq_s = jnp.round(vh) * s_h
     if key is not None:
-        kg, kh = jax.random.split(key)
+        kg = jax.random.split(key)[0]
         gq = jnp.floor(vg + jax.random.uniform(kg, grad.shape))
-        hq = jnp.floor(vh + jax.random.uniform(kh, hess.shape))
     else:
         gq = jnp.round(vg)
-        hq = jnp.round(vh)
     if return_scales:
-        return gq * s_g, hq * s_h, (s_g.astype(jnp.float32),
-                                    s_h.astype(jnp.float32))
-    return gq * s_g, hq * s_h
+        return gq * s_g, hq_s, (s_g.astype(jnp.float32),
+                                jnp.asarray(s_h, jnp.float32))
+    return gq * s_g, hq_s
 
 
 def feature_mask(it, k: int, key0: Array, base_allowed: Array, *,
@@ -200,7 +214,8 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None,
                 if spec.quant_stochastic else None
             if spec.grower.hist_impl == "packed":
                 grad, hess, qs = quantize_gradients(
-                    grad, hess, spec.quant_bins, qkey, return_scales=True)
+                    grad, hess, spec.quant_bins, qkey, return_scales=True,
+                    const_hess_level=spec.grower.packed_const_hess_level)
                 feat = {**feat, "qscales": jnp.stack(qs)}
             else:
                 grad, hess = quantize_gradients(grad, hess,

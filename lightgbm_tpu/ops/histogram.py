@@ -100,12 +100,11 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
     gq = jnp.round(d[:, 0] / s_g).astype(jnp.int32)
     hq = jnp.round(d[:, 1] / s_h).astype(jnp.int32)
     if const_hess_level > 0:
-        # declared-constant hessian: force live rows to EXACTLY the level
-        # (f32 1/(1/nb) rounds below nb for nb in {7, 13, 14, 15}, where
-        # stochastic rounding would occasionally yield nb-1 and break the
-        # exact count derivation below)
+        # declared-constant hessian: the quantizer left hess UNQUANTIZED
+        # with s_h = 1/level, so round(h/s_h) is exactly the level for
+        # every live row; the clamp is a defensive no-op that keeps the
+        # count derivation exact no matter what upstream feeds in
         hq = jnp.where(hq > 0, const_hess_level, 0)
-    w = d[:, 2].astype(jnp.int32)
     packed = (gq << 16) + hq
 
     T = -(-N // PACKED_TILE)
@@ -113,10 +112,14 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
     cols = bins_fm.astype(jnp.int32)
     if pad:
         packed = jnp.pad(packed, (0, pad))
-        w = jnp.pad(w, (0, pad))
         cols = jnp.pad(cols, ((0, 0), (0, pad)))
     pt = packed.reshape(T, PACKED_TILE)
-    wt = w.reshape(T, PACKED_TILE)
+    wt = None
+    if const_hess_level == 0:       # count channel only when scattered
+        w = d[:, 2].astype(jnp.int32)
+        if pad:
+            w = jnp.pad(w, (0, pad))
+        wt = w.reshape(T, PACKED_TILE)
 
     def per_feature(colf: Array) -> Array:             # [T, tile]
         def per_tile(ids, vals):

@@ -309,6 +309,26 @@ class LGBMModel(BaseEstimator):
         self._check_fitted()
         return self._Booster.feature_name()
 
+    @property
+    def feature_names_in_(self) -> np.ndarray:
+        """ref: sklearn.py v4 `feature_names_in_` (sklearn-standard
+        alias of feature_name_)."""
+        self._check_fitted()
+        return np.asarray(self._Booster.feature_name(), dtype=object)
+
+    @property
+    def n_estimators_(self) -> int:
+        """ref: sklearn.py v4 `n_estimators_` — boosting rounds actually
+        trained (early stopping may stop short of n_estimators)."""
+        self._check_fitted()
+        return self._Booster.current_iteration()
+
+    @property
+    def n_iter_(self) -> int:
+        """ref: sklearn.py v4 `n_iter_` (sklearn-standard spelling)."""
+        self._check_fitted()
+        return self._Booster.current_iteration()
+
 
 class LGBMRegressor(RegressorMixin, LGBMModel):
     """ref: sklearn.py `LGBMRegressor`."""

@@ -129,3 +129,20 @@ def test_dataset_surface(trained):
     d2.set_reference(ds)
     d2.construct()
     assert d2.bin_mappers is ds.bin_mappers
+
+
+@pytest.mark.quick
+def test_sklearn_fitted_attributes():
+    """ref: sklearn.py v4 fitted-attribute set (feature_names_in_,
+    n_estimators_, n_iter_ joined the classic block in v4)."""
+    from lightgbm_tpu.sklearn import LGBMClassifier
+    rng = np.random.RandomState(1)
+    X = rng.randn(300, 4)
+    y = (X[:, 0] > 0).astype(int)
+    m = LGBMClassifier(n_estimators=3, num_leaves=4, verbosity=-1)
+    with pytest.raises(Exception):
+        _ = m.n_iter_           # unfitted → raises
+    m.fit(X, y)
+    assert m.n_estimators_ == m.n_iter_ == 3
+    assert list(m.feature_names_in_) == m.feature_name_
+    assert m.n_features_in_ == 4

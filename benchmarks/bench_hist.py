@@ -1,8 +1,16 @@
 """Micro-benchmark: histogram implementations at Higgs shape.
 
 Usage (real TPU):  python benchmarks/bench_hist.py [N] [F] [MB]
-Compares jax.ops.segment_sum vs the Pallas kernel (onehot / hilo) and
-prints ms/call + effective GB/s (bins + payload read per call).
+
+TIMING METHODOLOGY (round 3b): on remote-tunnel TPU backends (axon),
+`block_until_ready` returns before the device has actually executed, so
+naive rep-loop timing reports async-dispatch fantasy numbers (this is how
+round 2 recorded a 0.21 ms scatter that actually takes ~750 ms).  Every
+measurement here forces a real dependency chain through `lax.fori_loop`
+(iteration i+1 consumes a scalar from iteration i's result) and
+materialises the final value with `np.asarray`; per-call time is the
+slope between k=1 and k=K chains, which cancels dispatch + transfer
+overhead.
 """
 import sys
 import time
@@ -19,55 +27,64 @@ def main():
     import jax.numpy as jnp
 
     from lightgbm_tpu.ops.histogram import leaf_histogram
-    from lightgbm_tpu.ops.pallas_hist import pallas_histogram
+    from lightgbm_tpu.ops.pallas_hist import (pallas_histogram,
+                                              pallas_histogram_quantized)
 
     print(f"backend={jax.devices()[0].platform} n={n} f={f} mb={mb}")
     rng = np.random.RandomState(0)
-    bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+    bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(
+        np.uint8 if mb <= 256 else np.uint16))
     payload = jnp.asarray(rng.randn(n, 3).astype(np.float32))
     mask = jnp.asarray(rng.rand(n) < 0.5)
-    seg = jax.jit(lambda b, p, m: leaf_histogram(b, p, m, mb))
 
-    bytes_per_call = n * f + n * 3 * 4 + n  # bins + payload + mask
-
-    impls = {"segment_sum": lambda: seg(bins, payload, mask)}
-
-    # packed-int quantized variant (2 scatter sweeps instead of 3); uses a
-    # quantized payload on the same value lattice the trainer would feed it
     from lightgbm_tpu.ops.fused import quantize_gradients
-    from lightgbm_tpu.ops.histogram import leaf_histogram_packed
     gq, hq, (sg, sh) = quantize_gradients(
         payload[:, 0], jnp.abs(payload[:, 1]) + 0.1, 8, return_scales=True)
     payload_q = jnp.stack([gq, hq, jnp.ones_like(gq)], axis=1)
-    packed = jax.jit(lambda b, p, m: leaf_histogram_packed(b, p, m, mb,
-                                                           sg, sh))
-    impls["packed_quant"] = lambda: packed(bins, payload_q, mask)
 
-    for impl in ("onehot", "hilo"):
-        impls[f"pallas_{impl}"] = (
-            lambda impl=impl: pallas_histogram(bins, payload, mask, mb,
-                                               impl=impl))
+    impls = {
+        "segment_sum": lambda p: leaf_histogram(bins, p, mask, mb),
+        "pallas": lambda p: pallas_histogram(bins, p, mask, mb),
+        "pallas_q": lambda p: pallas_histogram_quantized(
+            bins, payload_q + p[:, :1] * 0, mask, mb, sg, sh),
+    }
+
+    # bins + payload + mask read per call
+    bytes_per_call = n * f * bins.dtype.itemsize + n * 3 * 4 + n
 
     results = {}
     for name, fn in impls.items():
         try:
-            out = jax.block_until_ready(fn())  # compile + warmup
-            reps = 10
+            k = 8
+
+            @jax.jit
+            def chain(p, k_, fn=fn):
+                def body(i, acc):
+                    # consume a scalar of the previous result so calls
+                    # cannot overlap or be elided
+                    return fn(p + acc[0, 0, 0] * 1e-20)
+                return jax.lax.fori_loop(0, k_, body,
+                                         jnp.zeros((f, mb, 3)))
+
+            np.asarray(chain(payload, 1))           # compile + warmup
             t0 = time.perf_counter()
-            for _ in range(reps):
-                out = fn()
-            jax.block_until_ready(out)
-            dt = (time.perf_counter() - t0) / reps
+            np.asarray(chain(payload, 1))
+            t1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            np.asarray(chain(payload, k))
+            tk = time.perf_counter() - t0
+            dt = (tk - t1) / (k - 1)
             results[name] = dt
-            print(f"{name:16s} {dt*1e3:8.2f} ms/call  "
-                  f"{bytes_per_call/dt/1e9:7.1f} GB/s")
-        except Exception as e:
-            print(f"{name:16s} FAILED: {type(e).__name__}: {e}")
+            print(f"{name:<14} {dt * 1e3:8.2f} ms/call "
+                  f"{bytes_per_call / dt / 1e9:8.1f} GB/s")
+        except Exception as e:  # pragma: no cover
+            print(f"{name:<14} FAILED: {type(e).__name__}: {e}")
+
     if "segment_sum" in results:
-        for k, v in results.items():
-            if k != "segment_sum":
-                print(f"{k} speedup vs segment_sum: "
-                      f"{results['segment_sum']/v:.2f}x")
+        base = results["segment_sum"]
+        for name, dt in results.items():
+            if name != "segment_sum":
+                print(f"{name} speedup vs segment_sum: {base / dt:.1f}x")
 
 
 if __name__ == "__main__":

@@ -538,30 +538,37 @@ class Booster:
         segment-sum elsewhere (CPU tests, interpret)."""
         cfg = self.config
         from .ops.histogram import PACKED_MAX_QUANT_BINS
-        if (cfg.use_quantized_grad and not cfg.tpu_use_pallas
-                and 0 < cfg.num_grad_quant_bins <= PACKED_MAX_QUANT_BINS
-                and not self._use_goss
-                and self._fobj is None and self.objective_ is not None):
-            # packed-int scatter: one sweep covers (g, h) — valid only
-            # when payload values are exact integer lattice points with
-            # hq >= 0 (GOSS rescale weights break integrality; custom
-            # objectives may return negative hessians, whose hq < 0
-            # borrows into the packed grad field; more quant bins than
-            # the tile bound would overflow the 16-bit field)
+        # quantized-lattice eligibility: payload values must be exact
+        # integer lattice points with hq >= 0 (GOSS rescale weights break
+        # integrality; custom objectives may return negative hessians,
+        # whose hq < 0 borrows into the packed grad field; more quant
+        # bins than the tile bound would overflow the 16-bit field)
+        quant_ok = (cfg.use_quantized_grad
+                    and 0 < cfg.num_grad_quant_bins <= PACKED_MAX_QUANT_BINS
+                    and not self._use_goss
+                    and self._fobj is None and self.objective_ is not None)
+        on_tpu = False
+        if cfg.tpu_use_pallas:
+            try:
+                on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+            except RuntimeError:
+                on_tpu = False
+        if on_tpu:
+            # XLA lowers the 256-segment scatter-add to a SERIAL update
+            # loop on TPU (~60x slower than the kernel — PROFILE.md round
+            # 3b), so the Pallas one-hot-matmul kernel is the default
+            # there, gated on a tiny compile-and-compare probe so a
+            # Mosaic regression degrades to the XLA path instead of
+            # crashing training
+            from .ops.pallas_hist import probe_cached
+            if probe_cached(self._dd.max_bin, self._dd.num_feature):
+                return "pallas_q" if quant_ok else "pallas"
+            log.warning("Pallas histogram probe failed on this backend; "
+                        "falling back to segment-sum")
+        if quant_ok:
+            # packed-int scatter: one sweep covers (g, h) — the CPU
+            # backend's quantized fast path
             return "packed"
-        if not self.config.tpu_use_pallas:
-            return "segment_sum"
-        try:
-            platform = jax.devices()[0].platform
-        except RuntimeError:
-            return "segment_sum"
-        if platform not in ("tpu", "axon"):
-            return "segment_sum"
-        from .ops.pallas_hist import probe_cached
-        if probe_cached(self._dd.max_bin, self._dd.num_feature):
-            return "pallas"
-        log.warning("Pallas histogram probe failed on this backend; "
-                    "falling back to segment-sum")
         return "segment_sum"
 
     def _build_feat(self) -> None:
@@ -801,7 +808,8 @@ class Booster:
             # set_leaf_output mutated the model — cached scores are wrong
             self._rebuild_train_scores()
         fobj = fobj or self._fobj
-        if fobj is not None and self._grower_spec.hist_impl == "packed":
+        if fobj is not None and self._grower_spec.hist_impl in ("packed",
+                                                                  "pallas_q"):
             # ad-hoc update(fobj=...) on a booster whose grower was
             # specialized for packed quantized histograms: custom
             # hessians may be negative, which corrupts the packed field
@@ -874,7 +882,7 @@ class Booster:
             from .ops.fused import quantize_gradients
             qkey = jax.random.fold_in(self._rng_key0, it * 2 + 1) \
                 if cfg.stochastic_rounding else None
-            if self._grower_spec.hist_impl == "packed":
+            if self._grower_spec.hist_impl in ("packed", "pallas_q"):
                 grad, hess, qs = quantize_gradients(
                     grad, hess, cfg.num_grad_quant_bins, qkey,
                     return_scales=True,

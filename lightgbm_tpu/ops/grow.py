@@ -331,6 +331,13 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 if spec.hist_impl == "pallas":
                     from .pallas_hist import pallas_histogram
                     h = pallas_histogram(hist_bins, payload, mask_rows, HB)
+                elif spec.hist_impl == "pallas_q":
+                    # quantized lattice via ONE bf16 matmul — integer
+                    # exact; scales ride in feat["qscales"]
+                    from .pallas_hist import pallas_histogram_quantized
+                    h = pallas_histogram_quantized(
+                        hist_bins, payload, mask_rows, HB,
+                        feat["qscales"][0], feat["qscales"][1])
                 elif spec.hist_impl == "packed":
                     # quantized-gradient packed-int scatter (2 sweeps);
                     # scales ride in feat["qscales"] (booster/fused set

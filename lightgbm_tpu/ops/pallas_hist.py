@@ -5,28 +5,40 @@ TPU-native replacement for the reference's histogram constructors
 buffers]; src/treelearner/cuda/cuda_histogram_constructor.cu
 `CUDAConstructHistogramKernel` [shared-memory block histograms + atomics]).
 
-TPUs have no atomics, so scatter-add becomes dense compute the VPU/MXU can
-chew:  for each (row-tile, feature) the kernel materialises a one-hot
+TPUs have no atomics, and XLA lowers a 256-segment scatter-add to a SERIAL
+update loop (~750 ms per 1M x 28 histogram on v5e — measured honestly, see
+PROFILE.md "round 3b").  So scatter-add becomes dense compute the MXU can
+chew: for each (row-tile, feature) the kernel materialises a one-hot
 comparison of the bin column against the bin axis and contracts it with the
-(g·w, h·w, w) payload on the MXU.  Per-tile accumulators live in VMEM and
-revisit across the row-tile grid axis, exactly the role of the CUDA kernel's
-shared-memory histograms (grid-level reduction replaces atomicAdd).
+payload in ONE default-precision bf16 matmul.  Per-tile accumulators live
+in VMEM and revisit across the row-tile grid axis, exactly the role of the
+CUDA kernel's shared-memory histograms (grid-level reduction replaces
+atomicAdd).
 
-Two formulations, selectable per call (static):
- - "onehot": one [N_t, MB] equality per feature, one [3,N_t]x[N_t,MB]
-   matmul.  VPU cost ~ MB compares per (row, feature).
- - "hilo":   bin = 16*hi + lo; two [N_t, 16] equalities and a
-   [48,N_t]x[N_t,16] matmul via an oh_hi x payload outer product.  VPU cost
-   ~ 32 compares + 48 mults per (row, feature) — ~3x fewer ops at MB=256,
-   the int8-histogram trick from the reference's quantized path
-   (cuda_gradient_discretizer.cu) applied to lane decomposition instead.
+Precision design (replaces the old Precision.HIGHEST formulation, which
+cost 3-6 MXU passes): the one-hot operand is {0,1} — exact in bf16 at any
+precision — and each f32 payload channel is split into THREE bf16 terms
+(p = p1 + p2 + p3, each the bf16 rounding of the residual), giving >= f32
+accuracy from a single matmul: the LHS is
+[9, N_t] = (g1, g2, g3, h1, h2, h3, w1, w2, w3), and the MXU processes up
+to 128 LHS rows per pass, so the 3-way splits cost nothing over an
+unsplit payload.  Counts accumulate exactly below 2^24 rows, same as the
+segment-sum path.
+
+The quantized variant (`pallas_histogram_quantized`) feeds the integer
+gradient lattice of `use_quantized_grad` (ref:
+cuda_gradient_discretizer.cu + the packed 32-bit histogram atomics of the
+CUDA kernel) directly: LHS [3, N_t] = (gq·w, hq·w, w) — small integers,
+exact in bf16 — one matmul, rescaled to (Σg, Σh, count) afterwards.
 
 Layouts (all chosen for the (sublane, lane=128) tiling):
  - bins stay uint8 [F, N] in HBM — histogramming is bandwidth-bound and
    bins dominate traffic.
- - payload is passed transposed+masked [3, N] f32.
- - the kernel writes [F, 3, MB] (lane dim = bins); the wrapper transposes
-   to the [F, MB, 3] the split finder expects (tiny, fused by XLA).
+ - the payload rows are passed pre-split+masked [R, N] as f32 refs whose
+   VALUES are bf16-representable (see the in-kernel comment: real bf16
+   refs make Mosaic round the RESULT to bf16).
+ - the kernel writes [F, R, MB] (lane dim = bins); the wrapper recombines
+   the split rows to the [F, MB, 3] the split finder expects.
 """
 from __future__ import annotations
 
@@ -35,27 +47,33 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 Array = jax.Array
 
 ROW_TILE = 2048
-LO = 16  # hilo decomposition: bin = LO*hi + lo
 
 
-# the payload side must NOT be truncated to bf16 by the MXU (histogram
-# sums need full f32 — the reference even uses f64 accumulators); Mosaic
-# rejects per-operand precision, so HIGHEST applies to both (the one-hot
-# side is exact in any precision anyway)
-_PREC = jax.lax.Precision.HIGHEST
+def _split3(x: Array):
+    """f32 -> three f32 terms with bf16-REPRESENTABLE values and
+    x == x1 + x2 + x3 to >= f32 accuracy (each term is the bf16 rounding
+    of the remaining residual; bf16 keeps 8 mantissa bits, so three terms
+    carry ~27).  `reduce_precision` rather than astype round-trips: XLA's
+    TPU simplifier elides f32->bf16->f32 conversion pairs, which would
+    silently feed RAW f32 into the kernel's truncating DEFAULT-precision
+    dot (observed: ~2^-9-relative histogram error)."""
+    x1 = jax.lax.reduce_precision(x, 8, 7)
+    r1 = x - x1
+    x2 = jax.lax.reduce_precision(r1, 8, 7)
+    x3 = jax.lax.reduce_precision(r1 - x2, 8, 7)
+    return x1, x2, x3
 
 
-def _hist_kernel(bins_ref, p3_ref, out_ref, *, mb: int, impl: str):
+def _hist_kernel(bins_ref, pw_ref, out_ref, *, mb: int):
     """One (feature-block x row-tile) grid cell.
 
-    bins_ref: [F_t, N_t] uint8; p3_ref: [3, N_t] f32 (pre-masked);
-    out_ref:  [F_t, 3, MB] f32 ("onehot") or [F_t, 3, MB//LO, LO] ("hilo")
-    accumulator, revisited across row tiles.
+    bins_ref: [F_t, N_t] uint8/int32; pw_ref: [R, N_t] f32 with
+    bf16-representable values (pre-masked split payload rows); out_ref:
+    [F_t, R, MB] f32 accumulator, revisited across row tiles.
     """
     r = pl.program_id(1)  # row-tile index (fast axis)
 
@@ -64,63 +82,31 @@ def _hist_kernel(bins_ref, p3_ref, out_ref, *, mb: int, impl: str):
         out_ref[:] = jnp.zeros_like(out_ref)
 
     f_t, n_t = bins_ref.shape
-    p3 = p3_ref[:]                                   # [3, N_t]
-
-    if impl == "onehot":
-        bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, mb), 1)
-        for f in range(f_t):                         # static unroll
-            b = bins_ref[f, :].astype(jnp.int32)     # [N_t]
-            onehot = (b[:, None] == bin_ids).astype(jnp.float32)
-            # [3, N_t] @ [N_t, MB] -> [3, MB]
-            out_ref[f] += jax.lax.dot_general(
-                p3, onehot, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32, precision=_PREC)
-    else:  # hilo
-        hi_n = mb // LO
-        lo_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, LO), 1)
-        hi_ids = jax.lax.broadcasted_iota(jnp.int32, (hi_n, n_t), 0)
-        for f in range(f_t):
-            b = bins_ref[f, :].astype(jnp.int32)     # [N_t]
-            oh_lo = ((b % LO)[:, None] == lo_ids).astype(jnp.float32)
-            oh_hi = ((b // LO)[None, :] == hi_ids).astype(jnp.float32)
-            # per channel: A[hi, n] = p3[c, n] * oh_hi[hi, n];
-            # A @ oh_lo -> [hi_n, LO], written WITHOUT any vector reshape
-            # (Mosaic rejects (3*hi_n, LO) -> (3, mb) register reshapes)
-            for c in range(3):
-                a = oh_hi * p3[c][None, :]            # [hi_n, N_t]
-                part = jax.lax.dot_general(           # [hi_n, LO]
-                    a, oh_lo, (((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32, precision=_PREC)
-                out_ref[f, c] += part
+    # f32 refs whose VALUES are bf16-representable: DEFAULT precision on
+    # TPU truncates f32 operands to bf16 for the MXU (one pass) — exact
+    # here by construction — and accumulates f32.  (Passing actual bf16
+    # refs makes Mosaic emit a bf16 RESULT despite preferred_element_type,
+    # which rounds the sums.)
+    pw = pw_ref[:]                                   # [R, N_t] f32
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, mb), 1)
+    for f in range(f_t):                             # static unroll
+        b = bins_ref[f, :].astype(jnp.int32)         # [N_t]
+        onehot = (b[:, None] == bin_ids).astype(jnp.float32)
+        out_ref[f] += jax.lax.dot_general(
+            pw, onehot, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.jit, static_argnames=("max_bin", "impl", "row_tile",
-                                             "feat_tile", "interpret"))
-def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
-                     max_bin: int, *, impl: str = "hilo",
-                     row_tile: int = ROW_TILE, feat_tile: int = 0,
-                     interpret: bool = False) -> Array:
-    """Drop-in replacement for histogram.leaf_histogram (same contract).
-
-    Args:
-      bins_fm: [F, N] uint8/uint16 bin matrix, feature-major.
-      payload: [N, 3] f32 (grad*w, hess*w, w).
-      row_mask: [N] bool leaf membership.
-      max_bin: padded bin-axis size MB.
-    Returns: [F, MB, 3] f32 — bitwise-comparable to the segment-sum path
-      (both accumulate f32 in row order within tiles; cross-tile order
-      differs so equality is to ~1e-6, exact for counts).
-    """
+def _run_kernel(bins_fm: Array, pw: Array, max_bin: int, row_tile: int,
+                feat_tile: int, interpret: bool) -> Array:
+    """Shared pallas_call driver: [F, N] bins x [R, N] payload rows (f32
+    carrier, bf16-representable values) -> [F, R, MB] f32."""
     f, n = bins_fm.shape
-    mb = max_bin
-    if impl == "hilo" and mb % LO != 0:
-        impl = "onehot"
-    # pad rows to a tile multiple; padded payload is zero so bins value 0
-    # contributes nothing
+    rows = pw.shape[0]
     n_pad = (-n) % row_tile
-    p3 = jnp.where(row_mask, payload.T, 0.0).astype(jnp.float32)  # [3, N]
     if n_pad:
-        p3 = jnp.pad(p3, ((0, 0), (0, n_pad)))
+        pw = jnp.pad(pw, ((0, 0), (0, n_pad)))
         bins_fm = jnp.pad(bins_fm, ((0, 0), (0, n_pad)))
     if feat_tile <= 0 or feat_tile > f:
         feat_tile = f
@@ -130,32 +116,80 @@ def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
     n_rt = (n + n_pad) // row_tile
     n_ft = (f + f_pad) // feat_tile
 
-    if impl == "hilo":
-        # 4-D accumulator [F, 3, MB//LO, LO]; collapsed to [F, 3, MB] by
-        # XLA after the kernel (free), so Mosaic never reshapes registers
-        hi_n = mb // LO
-        out_specs = pl.BlockSpec((feat_tile, 3, hi_n, LO),
-                                 lambda j, r: (j, 0, 0, 0))
-        out_shape = jax.ShapeDtypeStruct((f + f_pad, 3, hi_n, LO),
-                                         jnp.float32)
-    else:
-        out_specs = pl.BlockSpec((feat_tile, 3, mb), lambda j, r: (j, 0, 0))
-        out_shape = jax.ShapeDtypeStruct((f + f_pad, 3, mb), jnp.float32)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, mb=mb, impl=impl),
+        functools.partial(_hist_kernel, mb=max_bin),
         grid=(n_ft, n_rt),  # row tiles iterate fastest -> out revisited
         in_specs=[
-            pl.BlockSpec((feat_tile, row_tile),
-                         lambda j, r: (j, r)),
-            pl.BlockSpec((3, row_tile), lambda j, r: (0, r)),
+            pl.BlockSpec((feat_tile, row_tile), lambda j, r: (j, r)),
+            pl.BlockSpec((rows, row_tile), lambda j, r: (0, r)),
         ],
-        out_specs=out_specs,
-        out_shape=out_shape,
+        out_specs=pl.BlockSpec((feat_tile, rows, max_bin),
+                               lambda j, r: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f + f_pad, rows, max_bin),
+                                       jnp.float32),
         interpret=interpret,
-    )(bins_fm, p3)
-    if impl == "hilo":
-        out = out.reshape(f + f_pad, 3, mb)
-    return out[:f].transpose(0, 2, 1)  # [F, MB, 3]
+    )(bins_fm, pw)
+    return out[:f]
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "impl", "row_tile",
+                                             "feat_tile", "interpret"))
+def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
+                     max_bin: int, *, impl: str = "onehot",
+                     row_tile: int = ROW_TILE, feat_tile: int = 0,
+                     interpret: bool = False) -> Array:
+    """Drop-in replacement for histogram.leaf_histogram (same contract).
+
+    Args:
+      bins_fm: [F, N] uint8/uint16 bin matrix, feature-major.
+      payload: [N, 3] f32 (grad*w, hess*w, w).
+      row_mask: [N] bool leaf membership.
+      max_bin: padded bin-axis size MB.
+      impl: kept for call-site compatibility; every path now runs the
+        single-pass split-bf16 kernel.
+    Returns: [F, MB, 3] f32 — matches the segment-sum path to >= f32
+      accuracy (the 3-term bf16 split carries ~27 mantissa bits per
+      payload element; counts are exact below 2^24 rows).
+    """
+    del impl
+    p3 = jnp.where(row_mask, payload.T, 0.0).astype(jnp.float32)  # [3, N]
+    g1, g2, g3 = _split3(p3[0])
+    h1, h2, h3 = _split3(p3[1])
+    w1, w2, w3 = _split3(p3[2])                      # GOSS weights are f32
+    # [9, N] f32 carrier, every value bf16-representable by construction
+    pw = jnp.stack([g1, g2, g3, h1, h2, h3, w1, w2, w3])
+    out = _run_kernel(bins_fm, pw, max_bin, row_tile, feat_tile, interpret)
+    g = out[:, 0] + out[:, 1] + out[:, 2]
+    h = out[:, 3] + out[:, 4] + out[:, 5]
+    c = out[:, 6] + out[:, 7] + out[:, 8]
+    return jnp.stack([g, h, c], axis=-1)             # [F, MB, 3]
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
+                                             "feat_tile", "interpret"))
+def pallas_histogram_quantized(bins_fm: Array, payload: Array,
+                               row_mask: Array, max_bin: int,
+                               s_g: Array, s_h: Array, *,
+                               row_tile: int = ROW_TILE, feat_tile: int = 0,
+                               interpret: bool = False) -> Array:
+    """Quantized-gradient histogram: ONE bf16 matmul, integer-exact.
+
+    Same contract as histogram.leaf_histogram_packed: payload carries
+    (gq·s_g·w, hq·s_h·w, w) with integer gq/hq on the quantization lattice
+    and w ∈ {0, 1}.  The integers are recovered exactly by division, fed
+    to the MXU as bf16 (|gq| ≤ 2^8 — exactly representable), and the three
+    (Σgq, Σhq, count) rows come out of a single [3, N_t]x[N_t, MB] pass
+    (ref: the packed 32-bit atomics of cuda_histogram_constructor.cu — one
+    operation covering grad+hess; here one matmul covers all three).
+    """
+    d = jnp.where(row_mask[:, None], payload, 0.0)
+    gq = jnp.round(d[:, 0] / s_g)
+    hq = jnp.round(d[:, 1] / s_h)
+    w = jax.lax.reduce_precision(d[:, 2], 8, 7)      # {0,1} — exact
+    pw = jnp.stack([gq, hq, w])   # [3, N] small ints — bf16-exact values
+    out = _run_kernel(bins_fm, pw, max_bin, row_tile, feat_tile, interpret)
+    return jnp.stack([out[:, 0] * s_g, out[:, 1] * s_h, out[:, 2]],
+                     axis=-1)                        # [F, MB, 3]
 
 
 _PROBE_CACHE = {}
@@ -175,7 +209,7 @@ def probe_cached(max_bin: int = 256, num_feature: int = 28) -> bool:
 def probe(interpret: bool = False, max_bin: int = 256,
           num_feature: int = 28) -> bool:
     """Runtime check that the kernel compiles and matches segment-sum on
-    the current backend — used by Booster to gate `tpu_use_pallas`.
+    the current backend — used by Booster to gate the TPU histogram path.
     Probes at the PRODUCTION bin count / feature count / ROW_TILE (Mosaic
     regressions are usually shape-specific, so a toy-shape probe would
     pass and the real call would still crash), with a single row tile to
@@ -196,6 +230,19 @@ def probe(interpret: bool = False, max_bin: int = 256,
                                row_tile=min(n, ROW_TILE),
                                interpret=interpret)
         want = leaf_histogram(bins, payload, mask, max_bin)
-        return bool(jnp.allclose(got, want, rtol=1e-4, atol=1e-4))
+        if not bool(jnp.allclose(got, want, rtol=1e-4, atol=1e-4)):
+            return False
+        # the quantized kernel runs DIFFERENT block shapes (3-row payload)
+        # — probe it too, or a Mosaic regression there would crash the
+        # pallas_q path that this probe is supposed to gate
+        s = jnp.float32(0.25)
+        pq = jnp.stack([jnp.round(payload[:, 0] * 8) * s,
+                        jnp.abs(jnp.round(payload[:, 1] * 8)) * s,
+                        jnp.ones((n,), jnp.float32)], axis=1)
+        gotq = pallas_histogram_quantized(bins, pq, mask, max_bin, s, s,
+                                          row_tile=min(n, ROW_TILE),
+                                          interpret=interpret)
+        wantq = leaf_histogram(bins, pq, mask, max_bin)
+        return bool(jnp.allclose(gotq, wantq, rtol=1e-4, atol=1e-4))
     except Exception:  # pragma: no cover - backend-specific failures
         return False

@@ -88,20 +88,33 @@ class Tree:
         (split leaf keeps its index as left child, new leaf = step+1 as right
         child) is reproduced on host where it is O(num_leaves).
         """
-        ns = int(dev.n_splits)
+        # ONE device_get for every model field: each individual transfer
+        # pays a full host<->device round trip (dozens of ms on a remote
+        # tunnel), and leaf_id — per-row TRAIN state, not model state —
+        # must never ride along (it is N-sized)
+        import jax
+        (n_splits_h, split_leaf, feat, thr_bin, dl, is_cat, cat_masks,
+         gains, ig, ih, ic, leaf_value_h, leaf_h_h, leaf_cnt_h) = \
+            jax.device_get((dev.n_splits, dev.split_leaf, dev.split_feature,
+                            dev.threshold_bin, dev.default_left,
+                            dev.split_is_cat, dev.split_cat_mask,
+                            dev.split_gain, dev.internal_g, dev.internal_h,
+                            dev.internal_cnt, dev.leaf_value, dev.leaf_h,
+                            dev.leaf_cnt))
+        ns = int(n_splits_h)
         nl = ns + 1
         t = cls(nl)
         t.shrinkage = shrinkage
-        split_leaf = np.asarray(dev.split_leaf)[:ns]
-        feat = np.asarray(dev.split_feature)[:ns]
-        thr_bin = np.asarray(dev.threshold_bin)[:ns]
-        dl = np.asarray(dev.default_left)[:ns]
-        is_cat = np.asarray(dev.split_is_cat)[:ns]
-        cat_masks = np.asarray(dev.split_cat_mask)[:ns]
-        gains = np.asarray(dev.split_gain)[:ns]
-        ig = np.asarray(dev.internal_g)[:ns]
-        ih = np.asarray(dev.internal_h)[:ns]
-        ic = np.asarray(dev.internal_cnt)[:ns]
+        split_leaf = split_leaf[:ns]
+        feat = feat[:ns]
+        thr_bin = thr_bin[:ns]
+        dl = dl[:ns]
+        is_cat = is_cat[:ns]
+        cat_masks = cat_masks[:ns]
+        gains = gains[:ns]
+        ig = ig[:ns]
+        ih = ih[:ns]
+        ic = ic[:ns]
 
         mb = cat_masks.shape[1] if ns else 0
         t.cat_bin_masks = np.zeros((0, mb), dtype=bool)
@@ -163,10 +176,10 @@ class Tree:
             t.cat_boundaries = np.asarray(cat_bounds, dtype=np.int64)
             t.cat_threshold = np.concatenate(cat_words).astype(np.uint32)
 
-        lv = np.asarray(dev.leaf_value)[:nl] * learner_output_scale
+        lv = np.asarray(leaf_value_h)[:nl] * learner_output_scale
         t.leaf_value = (lv * shrinkage).astype(np.float64)
-        t.leaf_weight = np.asarray(dev.leaf_h)[:nl].astype(np.float64)
-        t.leaf_count = np.asarray(dev.leaf_cnt)[:nl].astype(np.float64)
+        t.leaf_weight = np.asarray(leaf_h_h)[:nl].astype(np.float64)
+        t.leaf_count = np.asarray(leaf_cnt_h)[:nl].astype(np.float64)
         return t
 
     def leaf_path_features(self) -> list:

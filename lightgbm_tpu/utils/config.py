@@ -180,12 +180,15 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "stochastic_rounding": (True, "bool", ()),
     # ---- TPU-specific (new; no reference counterpart) ----
     "tpu_row_tile": (0, "int", ()),          # 0 = auto
-    # opt-in: measured on v5e (2026-07-30), XLA's native scatter
-    # (segment_sum) runs the Higgs-shape histogram at ~416 GB/s (~51% of
-    # HBM peak) while the matmul-formulated Pallas kernel is MXU-bound at
-    # 3 output rows (~2% utilization) and ~190x slower; the kernel stays
-    # correctness-tested as the CUDA-kernel-parity artifact
-    "tpu_use_pallas": (False, "bool", ()),
+    # default-on: measured HONESTLY on v5e (2026-07-31, dependency-chained
+    # timing — see PROFILE.md round 3b; the round-2 numbers were async
+    # artifacts), XLA lowers the 256-segment scatter-add to a serial
+    # update loop (~750 ms per 1M x 28 histogram) while the one-hot
+    # matmul Pallas kernel runs the same histogram in ~12 ms with BETTER
+    # than f32-scatter accuracy (split-bf16 operands, f32 accumulation).
+    # Only consulted on TPU backends (CPU keeps segment-sum), and probe-
+    # gated so a Mosaic regression degrades to the XLA path
+    "tpu_use_pallas": (True, "bool", ()),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -76,7 +76,12 @@ def training_report(booster: Any, rounds: int, seconds: float) -> Dict:
 
 def timeit_rounds(booster: Any, rounds: int) -> Dict:
     """Warm up one chunk, then time `rounds` fused rounds (compile
-    excluded) and return `training_report` metrics."""
+    excluded) and return `training_report` metrics.
+
+    Honest on remote-tunnel backends where `block_until_ready` returns
+    early (see PROFILE.md round 3b): every chunk ends in a real
+    `device_get` of the stacked trees (`Booster._decode_stacked`), which
+    cannot complete before the device work has."""
     import jax
     chunk = booster._BULK_CHUNK
     booster.update_many(chunk)  # warmup incl. compile

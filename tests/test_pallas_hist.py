@@ -8,7 +8,8 @@ import numpy as np
 import pytest
 
 from lightgbm_tpu.ops.histogram import leaf_histogram
-from lightgbm_tpu.ops.pallas_hist import pallas_histogram, probe
+from lightgbm_tpu.ops.pallas_hist import (pallas_histogram,
+                                          pallas_histogram_quantized, probe)
 
 
 def _case(n, f, mb, seed, weights=True):
@@ -61,3 +62,44 @@ class TestPallasHistogram:
 
     def test_probe(self):
         assert probe(interpret=True)
+
+
+class TestPallasHistogramQuantized:
+    def _quant_case(self, n, f, mb, bins_q, seed, all_ones_w=True):
+        rng = np.random.RandomState(seed)
+        bins = rng.randint(0, mb, (f, n)).astype(np.uint8)
+        s_g = np.float32(0.37)
+        s_h = np.float32(0.11)
+        gq = rng.randint(-bins_q, bins_q + 1, n).astype(np.float32)
+        hq = rng.randint(0, bins_q + 1, n).astype(np.float32)
+        w = np.ones(n, np.float32) if all_ones_w else \
+            (rng.rand(n) < 0.8).astype(np.float32)
+        payload = np.stack([gq * s_g * w, hq * s_h * w, w], axis=1)
+        mask = rng.rand(n) < 0.6
+        return (jnp.asarray(bins), jnp.asarray(payload), jnp.asarray(mask),
+                jnp.float32(s_g), jnp.float32(s_h))
+
+    @pytest.mark.parametrize("n,f,mb,bins_q", [
+        (512, 4, 16, 8), (1000, 7, 32, 15), (2048, 3, 256, 4),
+    ])
+    def test_matches_segment_sum(self, n, f, mb, bins_q):
+        bins, payload, mask, s_g, s_h = self._quant_case(
+            n, f, mb, bins_q, seed=n + mb)
+        want = np.asarray(leaf_histogram(bins, payload, mask, mb))
+        got = np.asarray(pallas_histogram_quantized(
+            bins, payload, mask, mb, s_g, s_h, row_tile=256,
+            interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        # counts and the recovered integer sums are exact
+        np.testing.assert_array_equal(got[..., 2], want[..., 2])
+
+    def test_bagging_zero_weights(self):
+        # w in {0, 1}: zero-weight rows must vanish from every channel
+        bins, payload, mask, s_g, s_h = self._quant_case(
+            700, 5, 64, 8, seed=9, all_ones_w=False)
+        want = np.asarray(leaf_histogram(bins, payload, mask, 64))
+        got = np.asarray(pallas_histogram_quantized(
+            bins, payload, mask, 64, s_g, s_h, row_tile=256,
+            interpret=True))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(got[..., 2], want[..., 2])

@@ -354,12 +354,14 @@ class Booster:
             voting_top_k=self.config.top_k,
             packed_const_hess_level=self._packed_const_hess_level(),
             monotone_intermediate=interm,
+            wave_width=self._wave_width(),
         )
+        self._grow_policy = self._resolve_grow_policy()
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
         self._ff_key0 = jax.random.PRNGKey(
             self.config.feature_fraction_seed % (2 ** 31))
-        self._grower = make_grower(self._grower_spec)
+        self._grower = self._make_serial_grower()
         self._build_feat()
         self._setup_tree_learner()
         self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
@@ -531,6 +533,90 @@ class Booster:
         slots = max(2, slots)
         return slots if slots < self.config.num_leaves else 0
 
+    def _wave_width(self) -> int:
+        """Leaves per batched histogram pass for the wave policy.  Keyed
+        by QUANTIZED-or-not (3 vs 9 payload rows per leaf in the MXU's
+        128-row LHS), not by impl name, so CPU (packed/segment_sum) and
+        TPU (pallas_q/pallas) backends grow IDENTICAL tree shapes for the
+        same params — the backend-parity contract."""
+        from .ops.pallas_hist import MULTI_CHUNK, MULTI_CHUNK_Q
+        return MULTI_CHUNK_Q \
+            if self._resolve_hist_impl() in ("pallas_q", "packed") \
+            else MULTI_CHUNK
+
+    def _final_learner_kind(self) -> str:
+        """The learner kind that `_setup_tree_learner` will ACTUALLY use:
+        resolves aliases + EFB/2-level downgrades and the one-device
+        serial fallback, without building the mesh."""
+        from .parallel.learner import resolve_tree_learner
+        cfg = self.config
+        bundled = self._dd.efb is not None
+        kind = resolve_tree_learner(cfg.tree_learner or "serial",
+                                    bundled=bundled, quiet=True)
+        if kind == "serial":
+            return "serial"
+        try:
+            n_dev = len(jax.devices())
+        except RuntimeError:
+            n_dev = 1
+        shards = cfg.num_machines if (cfg.num_machines or 0) > 1 else n_dev
+        shards = min(shards, n_dev)
+        if shards <= 1:
+            return "serial"
+        dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
+        use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
+        return resolve_tree_learner(cfg.tree_learner or "serial",
+                                    bundled=bundled, two_level=use_2level,
+                                    quiet=True)
+
+    def _resolve_grow_policy(self) -> str:
+        """Resolve `tree_grow_policy` with eligibility downgrades (see
+        ops/grow_wave.py module docstring for the supported scope)."""
+        pol = str(self.config.tree_grow_policy or "leafwise").lower()
+        if pol in ("leafwise", "leaf", "strict"):
+            return "leafwise"
+        if pol not in ("wave", "batched"):
+            raise LightGBMError(
+                f"Unknown tree_grow_policy {pol!r} "
+                "(expected 'leafwise' or 'wave')")
+        spec = self._grower_spec
+        reasons = []
+        if spec.forced_splits:
+            reasons.append("forced splits")
+        if spec.cegb_tradeoff > 0.0:
+            reasons.append("CEGB")
+        if spec.monotone_intermediate:
+            reasons.append("monotone_constraints_method=intermediate")
+        if spec.hist_pool_slots:
+            reasons.append("histogram_pool_size (bounded histogram pool)")
+        if spec.n_ic_groups:
+            reasons.append("interaction constraints")
+        kind = self._final_learner_kind()
+        if kind not in ("serial", "data"):
+            reasons.append(f"tree_learner={kind} (wave supports serial "
+                           "and data-parallel)")
+        if spec.hist_impl in ("pallas", "pallas_q"):
+            # the wave path runs the full-M multi-leaf kernel shapes —
+            # gate on THEIR probe (the single-leaf probe gating hist_impl
+            # says nothing about the [126, N_t] blocks)
+            from .ops.pallas_hist import probe_cached
+            if not probe_cached(self._dd.max_bin, self._dd.num_feature,
+                                multi=True):
+                reasons.append("a failing multi-leaf Pallas kernel probe "
+                               "on this backend")
+        if reasons:
+            log.warning("tree_grow_policy=wave is not supported with "
+                        + "; ".join(reasons)
+                        + " — using the strict leafwise policy")
+            return "leafwise"
+        return "wave"
+
+    def _make_serial_grower(self):
+        if getattr(self, "_grow_policy", "leafwise") == "wave":
+            from .ops.grow_wave import make_wave_grower
+            return make_wave_grower(self._grower_spec)
+        return make_grower(self._grower_spec)
+
     def _resolve_hist_impl(self) -> str:
         """Pick the histogram implementation: the Pallas kernel on real TPU
         backends (gated on a tiny compile-and-compare probe so a Mosaic
@@ -646,7 +732,9 @@ class Booster:
                                     quiet=True)
         # reset_parameter (lr schedules) calls this every iteration — reuse
         # the compiled grower and placed bins when nothing changed
-        key = (self._grower_spec, kind, shards, dcn if use_2level else 1)
+        wave = self._grow_policy == "wave"
+        key = (self._grower_spec, kind, shards, dcn if use_2level else 1,
+               wave)
         if getattr(self, "_learner_cache_key", None) == key:
             return
         # cache miss → emit the one-time configuration warnings
@@ -680,10 +768,10 @@ class Booster:
         self._train_bins = place_training_data(
             np.asarray(train_src), self._mesh, kind,
             pad_features=(kind in ("data", "feature")
-                          and self._dd.efb is None))
+                          and self._dd.efb is None and not wave))
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
-            self._dd.num_feature, self._dd.num_data)
+            self._dd.num_feature, self._dd.num_data, wave=wave)
         self._learner_cache_key = key
         log.info(f"tree_learner={kind}: training sharded over "
                  f"{shards} device(s)")
@@ -1261,17 +1349,23 @@ class Booster:
 
     def _bulk_trainer(self, spec):
         from .ops.fused import make_bulk_trainer
-        # the cache key includes the learner so switching tree_learner /
-        # mesh via reset_parameter rebuilds the trainer closure
-        key = (spec, getattr(self, "_learner_cache_key", None))
+        # the cache key includes the learner AND grow policy so switching
+        # tree_learner / mesh / tree_grow_policy via reset_parameter
+        # rebuilds the trainer closure
+        key = (spec, getattr(self, "_learner_cache_key", None),
+               self._grow_policy)
         if getattr(self, "_bulk_key", None) != key:
             grad = self._grad_rng_fn if spec.needs_rng else self._grad_fn
             renew_args = None
             if spec.renew_alpha >= 0.0:
                 renew_args = (self._dd.label, self._renew_base()[1])
             # distributed meshes plug the shard_map'ped grower into the
-            # chunk trainer — multi-chip training also fuses
-            grow_fn = self._grower if self._mesh is not None else None
+            # chunk trainer — multi-chip training also fuses; the wave
+            # policy's grower likewise rides in explicitly (the trainer's
+            # default is the strict serial grower)
+            grow_fn = self._grower \
+                if (self._mesh is not None
+                    or self._grow_policy == "wave") else None
             self._bulk_trainer_cache = make_bulk_trainer(spec, grad,
                                                          renew_args,
                                                          grow_fn)
@@ -2125,8 +2219,10 @@ class Booster:
             # const-hess level would silently mis-scale histogram sums
             hist_impl=self._resolve_hist_impl())
         self._grower_spec = self._grower_spec._replace(
-            packed_const_hess_level=self._packed_const_hess_level())
-        self._grower = make_grower(self._grower_spec)
+            packed_const_hess_level=self._packed_const_hess_level(),
+            wave_width=self._wave_width())
+        self._grow_policy = self._resolve_grow_policy()
+        self._grower = self._make_serial_grower()
         self._build_feat()
         self._setup_tree_learner()
         return self

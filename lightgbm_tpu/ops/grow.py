@@ -104,6 +104,10 @@ class GrowerSpec(NamedTuple):
     # packed quantized histogram with constant unit hessian: counts
     # derive from the hess field (ONE scatter sweep); 0 = off
     packed_const_hess_level: int = 0
+    # wave growth policy (ops/grow_wave.py): max smaller-child histograms
+    # per batched kernel pass; 0 = strict policy (field inert here, rides
+    # the spec so the two growers share one cache key space)
+    wave_width: int = 0
     # monotone_constraints_method=intermediate (ref:
     # monotone_constraints.hpp `IntermediateLeafConstraints`): per-leaf
     # bounds are recomputed every split from the CURRENT outputs of the

@@ -1,0 +1,424 @@
+"""Wave-batched leaf-wise growth — the TPU-first growth policy.
+
+Motivation (PROFILE.md round 3c): the strict best-first loop in
+`ops/grow.py` needs ONE new histogram per split, and each histogram is a
+full pass over the bin matrix whose MXU cost is IDENTICAL whether the LHS
+carries one leaf's payload (9 rows) or fourteen (126 rows) — the MXU pads
+the M axis to 128 either way.  Strict order therefore wastes ~93% of every
+pass, and its serial chain (the next split depends on the previous split's
+child histograms) cannot be batched without changing the growth order.
+
+The wave policy changes the order, not the split math: each wave splits
+EVERY current leaf whose cached best gain is positive (best-first within
+the wave, up to the `wave_width` batch capacity), then computes all the
+new smaller-children histograms in ONE batched kernel pass
+(`pallas_histogram_multi`), derives the larger children by subtraction,
+and re-searches the new leaves' best splits vmapped.  A 31-leaf tree costs
+~7 histogram passes instead of 30.
+
+Relation to the reference: LightGBM grows strictly best-first
+(ref: serial_tree_learner.cpp `SerialTreeLearner::Train` — one
+`FindBestSplits` per split); XGBoost exposes the same trade as
+`grow_policy=depthwise|lossguide`.  Wave order sits between the two: it
+is best-first over the frontier but fills each level before descending,
+so trees are more balanced than strict leaf-wise on skewed data and
+identical on data where the frontier's gains dominate the children's
+(always identical for num_leaves <= 3).  Accuracy on benchmark-scale data
+matches strict to within noise (tests/test_wave.py); the default policy
+remains `leafwise` for stock-exact trees.
+
+Feature scope (the booster downgrades to the strict grower otherwise):
+numerical + categorical splits, missing handling, monotone basic,
+path smoothing, per-tree/per-node column sampling, extra_trees,
+max_depth/min_* constraints, EFB bundling, all histogram impls, and
+distributed data-parallel training (full-histogram psum).  Forced splits,
+CEGB, interaction constraints, monotone intermediate, and the bounded
+histogram pool keep the strict grower.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from .grow import DeviceTree, GrowerSpec, _split_to_arrays
+from .histogram import leaf_histogram_multi, leaf_histogram_packed_multi
+from .split import NEG_INF, find_best_split, leaf_output, smooth_output
+
+Array = jax.Array
+
+INF = jnp.inf
+
+
+@functools.lru_cache(maxsize=64)
+def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
+    """Build (and cache) the jitted wave grower for a static spec.
+
+    Same signature/contract as `ops.grow.make_grower`; with `axis_name`
+    the grower runs the data-parallel strategy only (rows sharded,
+    batched histograms `psum`med — ref: data_parallel_tree_learner.cpp;
+    the block/voting strategies keep the strict grower)."""
+    L = spec.num_leaves
+    MB = spec.max_bin
+    W = max(1, min(spec.wave_width or 14, L - 1))
+    find = functools.partial(
+        find_best_split,
+        l1=spec.lambda_l1, l2=spec.lambda_l2,
+        min_data_in_leaf=spec.min_data_in_leaf,
+        min_sum_hessian=spec.min_sum_hessian_in_leaf,
+        min_gain_to_split=spec.min_gain_to_split,
+        max_delta_step=spec.max_delta_step,
+        cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
+        max_cat_threshold=spec.max_cat_threshold,
+        max_cat_to_onehot=spec.max_cat_to_onehot,
+        path_smooth=spec.path_smooth)
+
+    def clamp_output(g, h):
+        return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
+                           spec.max_delta_step)
+
+    axes_all = axis_name if isinstance(axis_name, tuple) else \
+        ((axis_name,) if axis_name is not None else None)
+    HB = spec.bundle_max_bin if spec.bundled else spec.max_bin
+
+    def grow(bins_fm: Array,       # [F, N] (or [G, N] bundled) feature-major
+             grad: Array,          # [N] f32
+             hess: Array,          # [N] f32
+             sample_weight: Array,  # [N] f32 bagging/GOSS weights (0 = out)
+             feat: Dict[str, Array],  # per-feature metadata pytree
+             allowed: Array,       # [F] bool
+             ) -> DeviceTree:
+        N = bins_fm.shape[1]
+        F = feat["nb"].shape[0]
+        payload = jnp.stack([grad * sample_weight, hess * sample_weight,
+                             sample_weight], axis=1)  # [N, 3]
+        mono = feat.get("mono")
+        if mono is None:
+            mono = jnp.zeros((F,), jnp.int32)
+
+        if spec.bundled:
+            bcol = feat["bundle_col"]
+            boff = feat["bundle_off"]
+            bident = feat["bundle_identity"]
+            b_ar_mb = jnp.arange(MB, dtype=jnp.int32)
+            src_bins = boff[:, None] + b_ar_mb[None, :] - 1        # [F, MB]
+            valid_b = (b_ar_mb[None, :] >= 1) \
+                & (b_ar_mb[None, :] < feat["nb"][:, None])
+
+            def expand_bundled(histg, pg, ph, pc):
+                """[G, HB, 3] bundle histogram → per-feature [F, MB, 3]
+                (same identity as ops/grow.py)."""
+                gath = histg[bcol[:, None],
+                             jnp.clip(src_bins, 0, HB - 1)]        # [F,MB,3]
+                hist = jnp.where(valid_b[..., None], gath, 0.0)
+                rest = hist.sum(axis=1)                            # [F, 3]
+                parent = jnp.stack([pg, ph, pc]).astype(jnp.float32)
+                zero_row = jnp.where(bident[:, None],
+                                     histg[bcol, 0, :],
+                                     parent[None, :] - rest)
+                return hist.at[:, 0, :].set(zero_row)
+
+        def hist_multi(leaf_id, slots):
+            """[S, F|G, HB, 3] histograms of the listed leaf slots in one
+            batched sweep; pad slots (value L) yield zeros."""
+            with jax.named_scope("histogram_wave"):
+                if spec.hist_impl == "pallas":
+                    from .pallas_hist import pallas_histogram_multi
+                    h = pallas_histogram_multi(bins_fm, payload, leaf_id,
+                                               slots, HB)
+                elif spec.hist_impl == "pallas_q":
+                    from .pallas_hist import pallas_histogram_multi_quantized
+                    h = pallas_histogram_multi_quantized(
+                        bins_fm, payload, leaf_id, slots, HB,
+                        feat["qscales"][0], feat["qscales"][1])
+                elif spec.hist_impl == "packed":
+                    h = leaf_histogram_packed_multi(
+                        bins_fm, payload, leaf_id, slots, HB,
+                        feat["qscales"][0], feat["qscales"][1],
+                        const_hess_level=spec.packed_const_hess_level)
+                else:
+                    h = leaf_histogram_multi(bins_fm, payload, leaf_id,
+                                             slots, HB)
+                if axes_all is not None:
+                    h = jax.lax.psum(h, axes_all)
+            return h
+
+        # per-node column sampling / extra_trees (same derivations as the
+        # strict grower so both policies draw identical per-node samples)
+        if spec.feature_fraction_bynode < 1.0:
+            f_real = spec.num_features_hint or F
+            n_pick = max(1, int(spec.feature_fraction_bynode * f_real
+                                + 1e-9))
+
+            def bynode_mask(node_idx):
+                key = jax.random.fold_in(feat["ff_key"], node_idx)
+                perm = jax.random.permutation(key, f_real)
+                return jnp.zeros((F,), bool).at[perm[:n_pick]].set(True)
+        else:
+            def bynode_mask(node_idx):
+                return jnp.ones((F,), bool)
+
+        if spec.extra_trees:
+            def extra_mask(node_idx):
+                key = jax.random.fold_in(feat["ff_key"],
+                                         (1 << 24) + node_idx)
+                r = jax.random.uniform(key, (F,))
+                t_max = jnp.maximum(feat["nb"] - 2, 0)
+                pick = (r * (t_max + 1).astype(jnp.float32))\
+                    .astype(jnp.int32)
+                m = jnp.zeros((F, MB), bool)\
+                    .at[jnp.arange(F), jnp.clip(pick, 0, MB - 1)].set(True)
+                return m | feat["is_cat"][:, None]
+        else:
+            def extra_mask(node_idx):
+                return None
+
+        def split_of(hist, g, h, c, node_allowed, lb, ub, p_out, nid):
+            if spec.bundled:
+                hist = expand_bundled(hist, g, h, c)
+            return find(hist, g, h, c, feat["nb"], feat["missing"],
+                        feat["default"], node_allowed & bynode_mask(nid),
+                        feat["is_cat"], mono=mono, out_lb=lb, out_ub=ub,
+                        parent_output=p_out, cand_mask=extra_mask(nid))
+
+        # ---- root ----
+        leaf_id0 = jnp.zeros((N,), jnp.int32)
+        hist0 = hist_multi(leaf_id0, jnp.zeros((1,), jnp.int32))[0]
+        root_g = payload[:, 0].sum()
+        root_h = payload[:, 1].sum()
+        root_c = payload[:, 2].sum()
+        if axes_all is not None:
+            root_g = jax.lax.psum(root_g, axes_all)
+            root_h = jax.lax.psum(root_h, axes_all)
+            root_c = jax.lax.psum(root_c, axes_all)
+        root_out = clamp_output(root_g, root_h)
+        s0 = split_of(hist0, root_g, root_h, root_c, allowed,
+                      jnp.float32(-INF), jnp.float32(INF), root_out, 0)
+
+        hist = jnp.zeros((L,) + hist0.shape, dtype=jnp.float32)\
+            .at[0].set(hist0)
+        leaf_best = [jnp.zeros((L,) + a.shape, dtype=a.dtype)
+                     .at[0].set(a) for a in _split_to_arrays(s0)]
+        leaf_best[0] = jnp.full((L,), NEG_INF, dtype=jnp.float32).at[0]\
+            .set(s0.gain)
+
+        nodes = dict(
+            split_leaf=jnp.zeros((L - 1,), jnp.int32),
+            split_feature=jnp.zeros((L - 1,), jnp.int32),
+            threshold_bin=jnp.zeros((L - 1,), jnp.int32),
+            default_left=jnp.zeros((L - 1,), bool),
+            split_is_cat=jnp.zeros((L - 1,), bool),
+            split_cat_mask=jnp.zeros((L - 1, MB), bool),
+            split_gain=jnp.zeros((L - 1,), jnp.float32),
+            internal_g=jnp.zeros((L - 1,), jnp.float32),
+            internal_h=jnp.zeros((L - 1,), jnp.float32),
+            internal_cnt=jnp.zeros((L - 1,), jnp.float32),
+        )
+
+        state = dict(
+            step=jnp.int32(0), nl=jnp.int32(1),
+            leaf_id=leaf_id0, hist=hist,
+            leaf_gain=leaf_best[0], leaf_feat=leaf_best[1],
+            leaf_thr=leaf_best[2], leaf_dl=leaf_best[3],
+            leaf_lg=leaf_best[4], leaf_lh=leaf_best[5],
+            leaf_lc=leaf_best[6], leaf_rg=leaf_best[7],
+            leaf_rh=leaf_best[8], leaf_rc=leaf_best[9],
+            leaf_iscat=leaf_best[10], leaf_catmask=leaf_best[11],
+            leaf_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
+            leaf_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
+            leaf_c=jnp.zeros((L,), jnp.float32).at[0].set(root_c),
+            leaf_lb=jnp.full((L,), -INF, jnp.float32),
+            leaf_ub=jnp.full((L,), INF, jnp.float32),
+            leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
+            leaf_depth=jnp.zeros((L,), jnp.int32),
+            nodes=nodes,
+        )
+
+        LEAF_KEYS = ("leaf_gain", "leaf_feat", "leaf_thr", "leaf_dl",
+                     "leaf_lg", "leaf_lh", "leaf_lc", "leaf_rg", "leaf_rh",
+                     "leaf_rc", "leaf_iscat", "leaf_catmask")
+
+        def cond(st):
+            return (st["step"] < L - 1) & (jnp.max(st["leaf_gain"]) > 0.0)
+
+        def body(st):
+            # ---- split phase: best-first among READY leaves (leaves
+            # created this wave have no histogram yet and wait for the
+            # next wave), up to the batch capacity W ----
+            istate = {k: st[k] for k in
+                      ("step", "nl", "leaf_id", "nodes", "leaf_g",
+                       "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
+                       "leaf_out", "leaf_depth") + LEAF_KEYS}
+            istate["ready"] = jnp.arange(L) < st["nl"]
+            istate["w"] = jnp.int32(0)
+            # per-wave pair records; pad slot L drops out of every scatter
+            istate["p_small"] = jnp.full((W,), L, jnp.int32)
+            istate["p_left"] = jnp.full((W,), L, jnp.int32)
+            istate["p_new"] = jnp.full((W,), L, jnp.int32)
+            istate["p_step"] = jnp.zeros((W,), jnp.int32)
+
+            def icond(s):
+                rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
+                return (s["w"] < W) & (s["step"] < L - 1) & \
+                    (jnp.max(rg) > 0.0)
+
+            def ibody(s):
+                step = s["step"]
+                new = step + 1           # nl == step + 1 invariant
+                rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
+                best = jnp.argmax(rg).astype(jnp.int32)
+                (gain_s, f, t, dl, lg, lh, lc, rg_, rh, rc, node_cat,
+                 node_mask) = tuple(s[k][best] for k in LEAF_KEYS)
+                in_leaf = s["leaf_id"] == best
+
+                # ---- partition (same decode as the strict grower) ----
+                if spec.bundled:
+                    col = feat["bundle_col"][f]
+                    off = feat["bundle_off"][f]
+                    raw_col = jnp.take(bins_fm, col, axis=0)\
+                        .astype(jnp.int32)
+                    in_range = (raw_col >= off) & \
+                        (raw_col < off + feat["nb"][f] - 1)
+                    fbins = jnp.where(in_range, raw_col - off + 1, 0)
+                else:
+                    fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)
+                is_nan_bin = (feat["missing"][f] == 2) & \
+                    (fbins == feat["nb"][f] - 1)
+                go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
+                go_left = jnp.where(node_cat, node_mask[fbins],
+                                    go_left_num)
+                leaf_id = jnp.where(in_leaf & ~go_left, new, s["leaf_id"])
+
+                nodes = s["nodes"]
+                nodes = dict(
+                    split_leaf=nodes["split_leaf"].at[step].set(best),
+                    split_feature=nodes["split_feature"].at[step].set(f),
+                    threshold_bin=nodes["threshold_bin"].at[step].set(t),
+                    default_left=nodes["default_left"].at[step].set(dl),
+                    split_is_cat=nodes["split_is_cat"].at[step]
+                    .set(node_cat),
+                    split_cat_mask=nodes["split_cat_mask"].at[step]
+                    .set(node_mask),
+                    split_gain=nodes["split_gain"].at[step].set(gain_s),
+                    internal_g=nodes["internal_g"].at[step]
+                    .set(s["leaf_g"][best]),
+                    internal_h=nodes["internal_h"].at[step]
+                    .set(s["leaf_h"][best]),
+                    internal_cnt=nodes["internal_cnt"].at[step]
+                    .set(s["leaf_c"][best]),
+                )
+
+                def put2(arr, a, b):
+                    return arr.at[best].set(a).at[new].set(b)
+
+                # ---- child outputs: smoothing → monotone basic clamp ----
+                lb, ub = s["leaf_lb"][best], s["leaf_ub"][best]
+                parent_out = s["leaf_out"][best]
+                mc_f = jnp.where(node_cat, 0, mono[f])
+                l_sm = smooth_output(clamp_output(lg, lh), lc, parent_out,
+                                     spec.path_smooth)
+                r_sm = smooth_output(clamp_output(rg_, rh), rc, parent_out,
+                                     spec.path_smooth)
+                l_out = jnp.clip(l_sm, lb, ub)
+                r_out = jnp.clip(r_sm, lb, ub)
+                mid = 0.5 * (l_out + r_out)
+                l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
+                r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
+                l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
+                r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
+                l_fin = jnp.clip(l_sm, l_lb, l_ub)
+                r_fin = jnp.clip(r_sm, r_lb, r_ub)
+
+                left_smaller = lc <= rc
+                small = jnp.where(left_smaller, best, new)
+                depth = s["leaf_depth"][best] + 1
+
+                out = dict(s)
+                out.update(
+                    step=step + 1, nl=new + 1, leaf_id=leaf_id,
+                    nodes=nodes, w=s["w"] + 1,
+                    ready=s["ready"].at[best].set(False)
+                    .at[new].set(False),
+                    p_small=s["p_small"].at[s["w"]].set(small),
+                    p_left=s["p_left"].at[s["w"]].set(best),
+                    p_new=s["p_new"].at[s["w"]].set(new),
+                    p_step=s["p_step"].at[s["w"]].set(step),
+                    leaf_gain=put2(s["leaf_gain"], NEG_INF, NEG_INF),
+                    leaf_g=put2(s["leaf_g"], lg, rg_),
+                    leaf_h=put2(s["leaf_h"], lh, rh),
+                    leaf_c=put2(s["leaf_c"], lc, rc),
+                    leaf_lb=put2(s["leaf_lb"], l_lb, r_lb),
+                    leaf_ub=put2(s["leaf_ub"], l_ub, r_ub),
+                    leaf_out=put2(s["leaf_out"], l_fin, r_fin),
+                    leaf_depth=put2(s["leaf_depth"], depth, depth),
+                )
+                return out
+
+            s1 = jax.lax.while_loop(icond, ibody, istate)
+
+            # ---- histogram phase: ONE batched pass for all smaller
+            # children; larger children by subtraction (the parent
+            # histogram still lives in the left child's slot) ----
+            small_h = hist_multi(s1["leaf_id"], s1["p_small"])
+            parents = st["hist"][jnp.clip(s1["p_left"], 0, L - 1)]
+            large_h = parents - small_h
+            p_large = jnp.where(s1["p_small"] == s1["p_left"],
+                                s1["p_new"], s1["p_left"])
+            hist = st["hist"].at[s1["p_small"]].set(small_h, mode="drop")
+            hist = hist.at[p_large].set(large_h, mode="drop")
+
+            # ---- find phase: best splits of all new children, vmapped ----
+            child_slots = jnp.concatenate([s1["p_left"], s1["p_new"]])
+            node_ids = jnp.concatenate([2 * s1["p_step"] + 1,
+                                        2 * s1["p_step"] + 2])
+
+            def eval_child(slot, nid):
+                sl = jnp.clip(slot, 0, L - 1)
+                g, h, c = s1["leaf_g"][sl], s1["leaf_h"][sl], \
+                    s1["leaf_c"][sl]
+                deep_ok = (spec.max_depth <= 0) | \
+                    (s1["leaf_depth"][sl] < spec.max_depth)
+                sr = split_of(hist[sl], g, h, c, allowed & deep_ok,
+                              s1["leaf_lb"][sl], s1["leaf_ub"][sl],
+                              s1["leaf_out"][sl], nid)
+                return _split_to_arrays(sr)
+
+            res = jax.vmap(eval_child)(child_slots, node_ids)
+
+            new_state = {k: s1[k] for k in
+                         ("step", "nl", "leaf_id", "nodes", "leaf_g",
+                          "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
+                          "leaf_out", "leaf_depth")}
+            new_state["hist"] = hist
+            for k, r in zip(LEAF_KEYS, res):
+                new_state[k] = s1[k].at[child_slots].set(r, mode="drop")
+            return new_state
+
+        st = jax.lax.while_loop(cond, body, state)
+
+        n_splits = st["step"]
+        slot = jnp.arange(L)
+        active = slot < st["nl"]
+        values = jnp.where(active & (st["nl"] > 1), st["leaf_out"], 0.0)
+
+        return DeviceTree(
+            n_splits=n_splits,
+            split_leaf=st["nodes"]["split_leaf"],
+            split_feature=st["nodes"]["split_feature"],
+            threshold_bin=st["nodes"]["threshold_bin"],
+            default_left=st["nodes"]["default_left"],
+            split_is_cat=st["nodes"]["split_is_cat"],
+            split_cat_mask=st["nodes"]["split_cat_mask"],
+            split_gain=st["nodes"]["split_gain"],
+            internal_g=st["nodes"]["internal_g"],
+            internal_h=st["nodes"]["internal_h"],
+            internal_cnt=st["nodes"]["internal_cnt"],
+            leaf_value=values,
+            leaf_g=st["leaf_g"], leaf_h=st["leaf_h"],
+            leaf_cnt=st["leaf_c"],
+            leaf_id=st["leaf_id"],
+        )
+
+    return jax.jit(grow)

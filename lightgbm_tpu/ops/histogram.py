@@ -61,6 +61,53 @@ def root_histogram(bins_fm: Array, payload: Array, max_bin: int) -> Array:
                           jnp.ones((n,), dtype=bool), max_bin)
 
 
+def slot_positions(leaf_id: Array, slots: Array) -> Array:
+    """[N] position of each row's leaf in `slots`, or S for rows whose
+    leaf is not listed (histogram-drop sentinel).  `slots` may carry the
+    pad value L (matches no leaf_id) for unused wave entries."""
+    eq = slots[:, None] == leaf_id[None, :]          # [S, N]
+    return jnp.where(eq.any(axis=0), jnp.argmax(eq, axis=0),
+                     slots.shape[0])
+
+
+def leaf_histogram_multi(bins_fm: Array, payload: Array, leaf_id: Array,
+                         slots: Array, max_bin: int) -> Array:
+    """Histograms of SEVERAL leaves in one sweep over the bin matrix.
+
+    The wave grower's batched analog of `leaf_histogram`: rows are keyed by
+    `slot_index * MB + bin` and one segment-sum per (feature, channel)
+    accumulates every listed leaf at once — the bin matrix is read ONCE for
+    the whole wave instead of once per leaf (ref: the reference's
+    `ConstructHistograms` loops leaves serially; on TPU one sweep is the
+    only formulation that amortizes the scatter).
+
+    Args:
+      bins_fm: [F, N] integer bin matrix, feature-major.
+      payload: [N, 3] f32 (grad*w, hess*w, w).
+      leaf_id: [N] i32 current row→leaf assignment.
+      slots: [S] i32 leaf slots to histogram; entries that match no row
+        (e.g. the pad value num_leaves) yield all-zero histograms.
+      max_bin: padded bin-axis size MB.
+
+    Returns: [S, F, MB, 3] f32.
+    """
+    S = slots.shape[0]
+    F = bins_fm.shape[0]
+    pos = slot_positions(leaf_id, slots)             # [N] in [0, S]
+    cols = bins_fm.astype(jnp.int32) + (pos * max_bin)[None, :]
+
+    def per_channel(vals: Array) -> Array:           # vals [N]
+        def per_feature(col: Array) -> Array:
+            return jax.ops.segment_sum(vals, col,
+                                       num_segments=(S + 1) * max_bin)
+        return jax.vmap(per_feature)(cols)           # [F, (S+1)*MB]
+
+    out = jnp.stack([per_channel(payload[:, c]) for c in range(3)],
+                    axis=-1)                         # [F, (S+1)*MB, 3]
+    return out.reshape(F, S + 1, max_bin, 3)[:, :S]\
+        .transpose(1, 0, 2, 3)                       # [S, F, MB, 3]
+
+
 PACKED_TILE = 2048  # rows per int16-field accumulation tile
 # largest num_grad_quant_bins whose per-tile hess-field sum stays below
 # 2^15 (no carry into the packed grad field); the booster gate imports
@@ -137,3 +184,60 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
                           cnt.astype(jnp.float32)], axis=-1)   # [MB, 3]
 
     return jax.vmap(per_feature)(cols.reshape(F, T, PACKED_TILE))
+
+
+def leaf_histogram_packed_multi(bins_fm: Array, payload: Array,
+                                leaf_id: Array, slots: Array, max_bin: int,
+                                s_g: Array, s_h: Array,
+                                const_hess_level: int = 0) -> Array:
+    """Multi-leaf variant of `leaf_histogram_packed` (see there for the
+    packing invariants): rows are keyed `slot_index * MB + bin` so one
+    packed scatter sweep accumulates every wave leaf at once.  The
+    per-tile hess-field bound is unchanged — segment COUNT grows with S,
+    per-segment tile sums do not.
+
+    Returns [S, F, MB, 3] f32.
+    """
+    S = slots.shape[0]
+    F, N = bins_fm.shape
+    NS = (S + 1) * max_bin
+    pos = slot_positions(leaf_id, slots)               # [N] in [0, S]
+    gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int32)
+    hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int32)
+    if const_hess_level > 0:
+        hq = jnp.where(hq > 0, const_hess_level, 0)
+    packed = (gq << 16) + hq
+
+    T = -(-N // PACKED_TILE)
+    pad = T * PACKED_TILE - N
+    cols = bins_fm.astype(jnp.int32) + (pos * max_bin)[None, :]
+    if pad:
+        # padded rows key to the dropped S-th block
+        packed = jnp.pad(packed, (0, pad))
+        cols = jnp.pad(cols, ((0, 0), (0, pad)),
+                       constant_values=S * max_bin)
+    pt = packed.reshape(T, PACKED_TILE)
+    wt = None
+    if const_hess_level == 0:
+        w = payload[:, 2].astype(jnp.int32)
+        if pad:
+            w = jnp.pad(w, (0, pad))
+        wt = w.reshape(T, PACKED_TILE)
+
+    def per_feature(colf: Array) -> Array:             # [T, tile]
+        def per_tile(ids, vals):
+            return jax.ops.segment_sum(vals, ids, num_segments=NS)
+        ph = jax.vmap(per_tile)(colf, pt)              # [T, NS] packed i32
+        h_f = ph & 0xFFFF
+        g_f = (ph - h_f) >> 16
+        h_sum = h_f.sum(axis=0)
+        if const_hess_level > 0:
+            cnt = h_sum // const_hess_level
+        else:
+            cnt = jax.vmap(per_tile)(colf, wt).sum(axis=0)
+        return jnp.stack([g_f.sum(axis=0).astype(jnp.float32) * s_g,
+                          h_sum.astype(jnp.float32) * s_h,
+                          cnt.astype(jnp.float32)], axis=-1)   # [NS, 3]
+
+    out = jax.vmap(per_feature)(cols.reshape(F, T, PACKED_TILE))
+    return out.reshape(F, S + 1, max_bin, 3)[:, :S].transpose(1, 0, 2, 3)

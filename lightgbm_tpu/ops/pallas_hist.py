@@ -165,6 +165,98 @@ def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
     return jnp.stack([g, h, c], axis=-1)             # [F, MB, 3]
 
 
+# MXU LHS capacity is 128 rows; leaves per kernel pass at 9 / 3 rows each
+MULTI_CHUNK = 14        # f32 split-payload path: 14 * 9 = 126 rows
+MULTI_CHUNK_Q = 42      # quantized path:         42 * 3 = 126 rows
+
+
+def _split_payload9(payload: Array) -> Array:
+    """[N, 3] f32 payload -> [9, N] bf16-representable carrier rows
+    (g1..g3, h1..h3, w1..w3) — the split step of `pallas_histogram`,
+    hoisted so multi-leaf callers split once and mask per leaf."""
+    p3 = payload.T.astype(jnp.float32)
+    g1, g2, g3 = _split3(p3[0])
+    h1, h2, h3 = _split3(p3[1])
+    w1, w2, w3 = _split3(p3[2])
+    return jnp.stack([g1, g2, g3, h1, h2, h3, w1, w2, w3])
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
+                                             "feat_tile", "interpret"))
+def pallas_histogram_multi(bins_fm: Array, payload: Array, leaf_id: Array,
+                           slots: Array, max_bin: int, *,
+                           row_tile: int = ROW_TILE, feat_tile: int = 0,
+                           interpret: bool = False) -> Array:
+    """Histograms of up to `len(slots)` leaves, filling the MXU.
+
+    The economics that make this THE wave-grower kernel: the MXU processes
+    up to 128 LHS rows per pass at the same cost as one, so the
+    single-leaf kernel (9 payload rows) wastes ~93% of each pass on
+    padding.  Packing `MULTI_CHUNK` leaves' masked payloads into one
+    [126, N_t] LHS computes 14 histograms for the price of one — the
+    reference's CUDA learner amortizes differently (per-leaf row subsets);
+    on TPU amortizing across leaves in the M axis is the native form.
+
+    Masking AFTER the 3-way split is exact: each split term is zeroed or
+    kept whole, so per-leaf sums still reconstruct >= f32 accuracy.
+
+    Args:
+      slots: [S] i32 leaf ids; pad entries (any value absent from
+        leaf_id, canonically num_leaves) produce zero histograms.
+    Returns: [S, F, MB, 3] f32.
+    """
+    S = slots.shape[0]
+    pw9 = _split_payload9(payload)                   # [9, N]
+    eq = leaf_id[None, :] == slots[:, None]          # [S, N]
+    pws = jnp.where(eq[:, None, :], pw9[None], 0.0)\
+        .reshape(S * 9, pw9.shape[1])                # [S*9, N]
+    outs = []
+    for c0 in range(0, S, MULTI_CHUNK):
+        c1 = min(S, c0 + MULTI_CHUNK)
+        out = _run_kernel(bins_fm, pws[c0 * 9:c1 * 9], max_bin, row_tile,
+                          feat_tile, interpret)      # [F, (c1-c0)*9, MB]
+        f = out.shape[0]
+        # rows per leaf are (channel, split-term) major → sum the terms
+        out = out.reshape(f, c1 - c0, 3, 3, max_bin).sum(axis=3)
+        outs.append(out.transpose(1, 0, 3, 2))       # [c, F, MB, 3]
+    return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
+                                             "feat_tile", "interpret"))
+def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
+                                     leaf_id: Array, slots: Array,
+                                     max_bin: int, s_g: Array, s_h: Array,
+                                     *, row_tile: int = ROW_TILE,
+                                     feat_tile: int = 0,
+                                     interpret: bool = False) -> Array:
+    """Multi-leaf quantized histogram: up to 42 leaves x 3 integer rows
+    fill one MXU pass (see `pallas_histogram_quantized` for the lattice
+    invariants, `pallas_histogram_multi` for the batching economics).
+
+    Returns: [S, F, MB, 3] f32.
+    """
+    S = slots.shape[0]
+    gq = jnp.round(payload[:, 0] / s_g)
+    hq = jnp.round(payload[:, 1] / s_h)
+    w = jax.lax.reduce_precision(payload[:, 2], 8, 7)    # {0,1} — exact
+    pw3 = jnp.stack([gq, hq, w])                         # [3, N]
+    eq = leaf_id[None, :] == slots[:, None]              # [S, N]
+    pws = jnp.where(eq[:, None, :], pw3[None], 0.0)\
+        .reshape(S * 3, pw3.shape[1])                    # [S*3, N]
+    outs = []
+    for c0 in range(0, S, MULTI_CHUNK_Q):
+        c1 = min(S, c0 + MULTI_CHUNK_Q)
+        out = _run_kernel(bins_fm, pws[c0 * 3:c1 * 3], max_bin, row_tile,
+                          feat_tile, interpret)          # [F, (c1-c0)*3, MB]
+        f = out.shape[0]
+        out = out.reshape(f, c1 - c0, 3, max_bin)
+        outs.append(out.transpose(1, 0, 3, 2))           # [c, F, MB, 3]
+    out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+    return jnp.stack([out[..., 0] * s_g, out[..., 1] * s_h, out[..., 2]],
+                     axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
                                              "feat_tile", "interpret"))
 def pallas_histogram_quantized(bins_fm: Array, payload: Array,
@@ -195,25 +287,32 @@ def pallas_histogram_quantized(bins_fm: Array, payload: Array,
 _PROBE_CACHE = {}
 
 
-def probe_cached(max_bin: int = 256, num_feature: int = 28) -> bool:
-    """probe(), memoised per (backend platform, shape)."""
+def probe_cached(max_bin: int = 256, num_feature: int = 28,
+                 multi: bool = False) -> bool:
+    """probe(), memoised per (backend platform, shape, multi)."""
     try:
-        key = (jax.devices()[0].platform, max_bin, num_feature)
+        key = (jax.devices()[0].platform, max_bin, num_feature, multi)
     except RuntimeError:
         return False
     if key not in _PROBE_CACHE:
-        _PROBE_CACHE[key] = probe(max_bin=max_bin, num_feature=num_feature)
+        _PROBE_CACHE[key] = probe(max_bin=max_bin,
+                                  num_feature=num_feature, multi=multi)
     return _PROBE_CACHE[key]
 
 
 def probe(interpret: bool = False, max_bin: int = 256,
-          num_feature: int = 28) -> bool:
+          num_feature: int = 28, multi: bool = False) -> bool:
     """Runtime check that the kernel compiles and matches segment-sum on
     the current backend — used by Booster to gate the TPU histogram path.
     Probes at the PRODUCTION bin count / feature count / ROW_TILE (Mosaic
     regressions are usually shape-specific, so a toy-shape probe would
     pass and the real call would still crash), with a single row tile to
-    keep the probe cheap."""
+    keep the probe cheap.
+
+    `multi=False` covers the single-leaf block shapes gating `hist_impl`;
+    `multi=True` covers ONLY the full-M multi-leaf shapes gating the wave
+    policy — kept separate so a wave-shape regression degrades the wave
+    policy, not every strict-policy user's histogram path."""
     import numpy as np
 
     from .histogram import leaf_histogram
@@ -225,7 +324,38 @@ def probe(interpret: bool = False, max_bin: int = 256,
         rng.randint(0, max_bin, (num_feature, n)).astype(np.uint16))
     payload = jnp.asarray(rng.randn(n, 3).astype(np.float32))
     mask = jnp.asarray(rng.rand(n) < 0.7)
+    s = jnp.float32(0.25)
+    pq = jnp.stack([jnp.round(payload[:, 0] * 8) * s,
+                    jnp.abs(jnp.round(payload[:, 1] * 8)) * s,
+                    jnp.ones((n,), jnp.float32)], axis=1)
     try:
+        if multi:
+            # the wave grower's FULL-M multi-leaf block shapes
+            # ([126, N_t] LHS) — a full chunk of each
+            leaf_id = jnp.asarray(
+                rng.randint(0, MULTI_CHUNK + 2, (n,)).astype(np.int32))
+            slots = jnp.arange(MULTI_CHUNK, dtype=jnp.int32)
+            gotm = pallas_histogram_multi(bins, payload, leaf_id, slots,
+                                          max_bin,
+                                          row_tile=min(n, ROW_TILE),
+                                          interpret=interpret)
+            wantm = jnp.stack([leaf_histogram(bins, payload,
+                                              leaf_id == sl, max_bin)
+                               for sl in range(3)])
+            if not bool(jnp.allclose(gotm[:3], wantm, rtol=1e-4,
+                                     atol=1e-4)):
+                return False
+            lid_q = jnp.asarray(
+                rng.randint(0, MULTI_CHUNK_Q + 2, (n,)).astype(np.int32))
+            slots_q = jnp.arange(MULTI_CHUNK_Q, dtype=jnp.int32)
+            gotmq = pallas_histogram_multi_quantized(
+                bins, pq, lid_q, slots_q, max_bin, s, s,
+                row_tile=min(n, ROW_TILE), interpret=interpret)
+            wantmq = jnp.stack([leaf_histogram(bins, pq, lid_q == sl,
+                                               max_bin)
+                                for sl in range(3)])
+            return bool(jnp.allclose(gotmq[:3], wantmq, rtol=1e-4,
+                                     atol=1e-4))
         got = pallas_histogram(bins, payload, mask, max_bin,
                                row_tile=min(n, ROW_TILE),
                                interpret=interpret)
@@ -235,10 +365,6 @@ def probe(interpret: bool = False, max_bin: int = 256,
         # the quantized kernel runs DIFFERENT block shapes (3-row payload)
         # — probe it too, or a Mosaic regression there would crash the
         # pallas_q path that this probe is supposed to gate
-        s = jnp.float32(0.25)
-        pq = jnp.stack([jnp.round(payload[:, 0] * 8) * s,
-                        jnp.abs(jnp.round(payload[:, 1] * 8)) * s,
-                        jnp.ones((n,), jnp.float32)], axis=1)
         gotq = pallas_histogram_quantized(bins, pq, mask, max_bin, s, s,
                                           row_tile=min(n, ROW_TILE),
                                           interpret=interpret)

@@ -73,13 +73,18 @@ def resolve_tree_learner(name: str, bundled: bool = False,
 
 
 def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
-                            num_feature: int, num_data: int):
+                            num_feature: int, num_data: int,
+                            wave: bool = False):
     """Grower with the serial signature, running SPMD over `mesh`.
 
     Expects `bins_fm` already padded + placed by `place_training_data`
     ([f_pad, n_pad] — the one-time cost); pads the per-iteration [N]
     vectors itself.  Returns `grow(bins_fm, grad [N], hess [N], sw [N],
     feat, allowed) -> DeviceTree` with `leaf_id` of length N.
+
+    `wave=True` plugs in the wave-batched grower (ops/grow_wave.py) —
+    data-parallel only (rows sharded, batched histograms psummed; the
+    booster downgrades other kinds before reaching here).
     """
     axes = tuple(mesh.axis_names)     # ("data",) or ("dcn", "ici")
     S_last = int(mesh.shape[axes[-1]])
@@ -97,6 +102,10 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         assert kind != "feature", \
             "feature kind must be downgraded before placement (EFB)"
         mode = "data"
+    if wave:
+        assert kind == "data", \
+            "wave policy must be downgraded for non-data learners"
+        mode = "data"
     # feature blocks split over the LAST (ICI) axis only; rows shard over
     # the whole mesh
     f_extra = (padded_feature_count(num_feature, S_last) - num_feature) \
@@ -105,10 +114,17 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         if mode != "feature" else 0
     # block modes split features over the last (ICI) axis; voting's local
     # vote scales size constraints by the TOTAL shard count
-    grow = make_grower(spec,
-                       axis_name=axes if len(axes) > 1 else axes[0],
-                       mode=mode,
-                       n_shards=S_total if mode == "voting" else S_last)
+    if wave:
+        from ..ops.grow_wave import make_wave_grower
+        grow = make_wave_grower(spec,
+                                axis_name=axes if len(axes) > 1
+                                else axes[0],
+                                n_shards=S_total)
+    else:
+        grow = make_grower(spec,
+                           axis_name=axes if len(axes) > 1 else axes[0],
+                           mode=mode,
+                           n_shards=S_total if mode == "voting" else S_last)
 
     row_sp = P(axes) if mode != "feature" else P(None)
     tree_specs = DeviceTree(

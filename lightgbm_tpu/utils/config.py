@@ -189,6 +189,14 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # Only consulted on TPU backends (CPU keeps segment-sum), and probe-
     # gated so a Mosaic regression degrades to the XLA path
     "tpu_use_pallas": (True, "bool", ()),
+    # growth policy (ops/grow_wave.py): "leafwise" = stock-exact strict
+    # best-first (ref: serial_tree_learner.cpp Train); "wave" = TPU-first
+    # wave-batched best-first — each wave splits every positive-gain
+    # frontier leaf and computes all new histograms in ONE full-MXU
+    # batched kernel pass (~4-6x fewer histogram passes per tree; tree
+    # SHAPE may differ from strict on skewed data, accuracy matches to
+    # within noise — see tests/test_wave.py)
+    "tree_grow_policy": ("leafwise", "str", ("grow_policy",)),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -63,6 +63,30 @@ class TestPallasHistogram:
     def test_probe(self):
         assert probe(interpret=True)
 
+    def test_probe_multi(self):
+        # the wave-policy gate: full-M multi-leaf block shapes
+        assert probe(interpret=True, multi=True)
+
+    def test_multi_matches_per_leaf_interpret(self):
+        rng = np.random.RandomState(21)
+        n, f, mb = 512, 4, 16
+        bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+        payload = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+        leaf_id = jnp.asarray(rng.randint(0, 6, n).astype(np.int32))
+        slots = jnp.asarray(np.array([2, 0, 6, 4], np.int32))  # 6 = pad
+        from lightgbm_tpu.ops.pallas_hist import pallas_histogram_multi
+        got = np.asarray(pallas_histogram_multi(
+            bins, payload, leaf_id, slots, mb, row_tile=256,
+            interpret=True))
+        for i, sl in enumerate([2, 0, None, 4]):
+            if sl is None:
+                assert np.all(got[i] == 0.0)
+            else:
+                want = np.asarray(leaf_histogram(bins, payload,
+                                                 leaf_id == sl, mb))
+                np.testing.assert_allclose(got[i], want, rtol=1e-5,
+                                           atol=1e-5)
+
 
 class TestPallasHistogramQuantized:
     def _quant_case(self, n, f, mb, bins_q, seed, all_ones_w=True):

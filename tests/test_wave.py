@@ -1,0 +1,232 @@
+"""Wave-batched growth policy (ops/grow_wave.py, tree_grow_policy=wave).
+
+Covers: the batched multi-leaf histogram primitives against per-leaf
+references, exact equivalence to the strict policy where the orders
+coincide (num_leaves <= 3), accuracy parity at benchmark-ish settings,
+constraint handling (max_depth / min_data / monotone basic), the
+quantized + EFB + bagging paths, distributed data-parallel parity on the
+8-virtual-device CPU mesh, and the eligibility downgrades.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.ops.histogram import (leaf_histogram,
+                                        leaf_histogram_multi,
+                                        leaf_histogram_packed,
+                                        leaf_histogram_packed_multi)
+
+
+def make_binary(n=3000, f=8, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    score = X[:, 0] + X[:, 1] * X[:, 2] + 0.5 * np.sin(3 * X[:, 3])
+    y = (score + 0.5 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+def auc_of(bst, X, y):
+    from lightgbm_tpu.metrics import _auc
+    return float(_auc(bst.predict(X, raw_score=True), y, None, None))
+
+
+@pytest.mark.quick
+class TestMultiHistogram:
+    def test_multi_matches_per_leaf(self):
+        rng = np.random.RandomState(0)
+        n, f, mb, L = 5000, 6, 32, 9
+        bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+        payload = jnp.asarray(rng.randn(n, 3).astype(np.float32))
+        leaf_id = jnp.asarray(rng.randint(0, L, n).astype(np.int32))
+        # slots include a pad entry (L) that matches no row
+        slots = jnp.asarray(np.array([4, 0, 7, L, 2], np.int32))
+        got = leaf_histogram_multi(bins, payload, leaf_id, slots, mb)
+        for i, sl in enumerate([4, 0, 7, None, 2]):
+            if sl is None:
+                assert float(jnp.abs(got[i]).max()) == 0.0
+            else:
+                want = leaf_histogram(bins, payload, leaf_id == sl, mb)
+                np.testing.assert_allclose(np.asarray(got[i]),
+                                           np.asarray(want),
+                                           rtol=1e-5, atol=1e-5)
+
+    def test_packed_multi_matches_per_leaf(self):
+        rng = np.random.RandomState(1)
+        n, f, mb, L = 4000, 5, 16, 6
+        bins = jnp.asarray(rng.randint(0, mb, (f, n)).astype(np.uint8))
+        s_g, s_h = jnp.float32(0.5), jnp.float32(0.25)
+        gq = rng.randint(-8, 9, n).astype(np.float32)
+        hq = rng.randint(0, 9, n).astype(np.float32)
+        w = (rng.rand(n) < 0.8).astype(np.float32)
+        payload = jnp.asarray(
+            np.stack([gq * 0.5 * w, hq * 0.25 * w, w], axis=1))
+        leaf_id = jnp.asarray(rng.randint(0, L, n).astype(np.int32))
+        slots = jnp.asarray(np.array([3, 1, L, 0], np.int32))
+        got = leaf_histogram_packed_multi(bins, payload, leaf_id, slots,
+                                          mb, s_g, s_h)
+        for i, sl in enumerate([3, 1, None, 0]):
+            if sl is None:
+                assert float(jnp.abs(got[i]).max()) == 0.0
+            else:
+                want = leaf_histogram_packed(bins, payload, leaf_id == sl,
+                                             mb, s_g, s_h)
+                np.testing.assert_allclose(np.asarray(got[i]),
+                                           np.asarray(want),
+                                           rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.quick
+class TestWavePolicy:
+    def test_small_tree_exact_match(self):
+        """For num_leaves <= 3 wave order IS strict order — trees must be
+        byte-identical (only the params dump in the model text differs)."""
+        X, y = make_binary(2000)
+        dumps = {}
+        for pol in ("leafwise", "wave"):
+            bst = lgb.train({"objective": "binary", "num_leaves": 3,
+                             "verbosity": -1, "tree_grow_policy": pol},
+                            lgb.Dataset(X, label=y), num_boost_round=8)
+            txt = bst.model_to_string()
+            body = "\n".join(ln for ln in txt.splitlines()
+                             if not ln.startswith("[tree_grow_policy"))
+            dumps[pol] = (body, bst.predict(X))
+        assert dumps["leafwise"][0] == dumps["wave"][0]
+        np.testing.assert_array_equal(dumps["leafwise"][1],
+                                      dumps["wave"][1])
+
+    def test_accuracy_parity_with_strict(self):
+        X, y = make_binary(4000)
+        Xe, ye = make_binary(2000, seed=11)
+        aucs = {}
+        for pol in ("leafwise", "wave"):
+            bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                             "verbosity": -1, "tree_grow_policy": pol},
+                            lgb.Dataset(X, label=y), num_boost_round=30)
+            aucs[pol] = auc_of(bst, Xe, ye)
+        assert aucs["wave"] > aucs["leafwise"] - 0.01, aucs
+
+    def test_constraints_respected(self):
+        X, y = make_binary(2500)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "max_depth": 3, "min_data_in_leaf": 50,
+                         "verbosity": -1, "tree_grow_policy": "wave"},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        d = bst.dump_model()
+        for t in d["tree_info"]:
+            def walk(node, depth):
+                if "leaf_value" in node:
+                    assert depth <= 3
+                    assert node.get("leaf_count", 50) >= 50
+                    return 1
+                return walk(node["left_child"], depth + 1) + \
+                    walk(node["right_child"], depth + 1)
+            assert walk(t["tree_structure"], 0) <= 8   # depth-3 cap
+
+    def test_monotone_basic(self):
+        rng = np.random.RandomState(5)
+        n = 2500
+        X = rng.rand(n, 3).astype(np.float32)
+        y = 2 * X[:, 0] - X[:, 1] + 0.2 * rng.randn(n)
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "monotone_constraints": [1, -1, 0]},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+        grid = np.tile(np.float32([[0.5, 0.5, 0.5]]), (41, 1))
+        grid[:, 0] = np.linspace(0, 1, 41)
+        assert np.all(np.diff(bst.predict(grid)) >= -1e-9)
+        grid[:, 0] = 0.5
+        grid[:, 1] = np.linspace(0, 1, 41)
+        assert np.all(np.diff(bst.predict(grid)) <= 1e-9)
+
+    def test_quantized_and_bagging(self):
+        X, y = make_binary(3000)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "use_quantized_grad": True,
+                         "bagging_fraction": 0.7, "bagging_freq": 1},
+                        lgb.Dataset(X, label=y), num_boost_round=25)
+        assert auc_of(bst, X, y) > 0.85
+
+    def test_efb_bundled(self):
+        rng = np.random.RandomState(9)
+        n = 2500
+        dense = rng.randn(n, 3).astype(np.float32)
+        sparse = np.zeros((n, 6), np.float32)
+        for j in range(6):
+            idx = rng.choice(n, n // 10, replace=False)
+            sparse[idx, j] = rng.randn(n // 10)
+        X = np.hstack([dense, sparse])
+        y = (dense[:, 0] + sparse[:, 0] - sparse[:, 3]
+             + 0.3 * rng.randn(n) > 0).astype(np.float64)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "enable_bundle": True},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+        assert auc_of(bst, X, y) > 0.85
+
+    def test_categorical(self):
+        rng = np.random.RandomState(13)
+        n = 2500
+        cat = rng.randint(0, 8, n)
+        num = rng.randn(n).astype(np.float32)
+        y = ((cat % 3 == 0).astype(float) + 0.5 * num
+             + 0.3 * rng.randn(n) > 0.4).astype(np.float64)
+        X = np.stack([cat.astype(np.float32), num], axis=1)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave"},
+                        lgb.Dataset(X, label=y,
+                                    categorical_feature=[0]),
+                        num_boost_round=20)
+        assert auc_of(bst, X, y) > 0.8
+
+    def test_reset_parameter_flips_bulk_trainer(self):
+        """The fused chunk trainer must be rebuilt when reset_parameter
+        switches tree_grow_policy (its cache key includes the policy)."""
+        from lightgbm_tpu.booster import Booster
+        X, y = make_binary(1500)
+        bst = Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1},
+                      train_set=lgb.Dataset(X, label=y))
+        bst.update_many(bst._BULK_CHUNK)
+        key_leafwise = bst._bulk_key
+        assert bst._grow_policy == "leafwise"
+        bst.reset_parameter({"tree_grow_policy": "wave"})
+        assert bst._grow_policy == "wave"
+        bst.update_many(bst._BULK_CHUNK)
+        assert bst._bulk_key != key_leafwise
+        assert bst.current_iteration() == 2 * bst._BULK_CHUNK
+
+    def test_downgrade_reasons(self):
+        X, y = make_binary(1500)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "cegb_tradeoff": 1.0,
+                         "cegb_penalty_split": 0.1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert bst._grow_policy == "leafwise"
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1, "tree_grow_policy": "wave"},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert bst._grow_policy == "wave"
+
+
+class TestWaveDistributed:
+    def test_data_parallel_matches_serial(self):
+        """Wave + tree_learner=data over the 8-device CPU mesh: per-shard
+        partial histograms psum to EXACTLY the serial sums (same f32
+        add order per segment), so trees must match the serial wave's."""
+        assert len(jax.devices()) == 8
+        X, y = make_binary(3000)
+        preds = {}
+        for learner in ("serial", "data"):
+            bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                             "verbosity": -1, "tree_grow_policy": "wave",
+                             "tree_learner": learner},
+                            lgb.Dataset(X, label=y), num_boost_round=10)
+            assert bst._grow_policy == "wave"
+            preds[learner] = bst.predict(X, raw_score=True)
+        np.testing.assert_allclose(preds["serial"], preds["data"],
+                                   rtol=1e-4, atol=1e-5)

@@ -355,6 +355,7 @@ class Booster:
             packed_const_hess_level=self._packed_const_hess_level(),
             monotone_intermediate=interm,
             wave_width=self._wave_width(),
+            has_cat=bool(np.asarray(self._dd.is_cat).any()),
         )
         self._grow_policy = self._resolve_grow_policy()
         self._rng_key0 = jax.random.PRNGKey(
@@ -544,30 +545,34 @@ class Booster:
             if self._resolve_hist_impl() in ("pallas_q", "packed") \
             else MULTI_CHUNK
 
-    def _final_learner_kind(self) -> str:
-        """The learner kind that `_setup_tree_learner` will ACTUALLY use:
-        resolves aliases + EFB/2-level downgrades and the one-device
-        serial fallback, without building the mesh."""
+    def _learner_topology(self):
+        """ONE resolver for the learner kind + mesh shape — consumed by
+        both `_setup_tree_learner` (which builds it) and
+        `_resolve_grow_policy` (which judges wave eligibility), so the
+        two can never drift.  Quiet: emits no warnings.
+
+        Returns (kind, shards, n_dev, dcn, use_2level); `kind` includes
+        alias + EFB/2-level downgrades but NOT the one-device serial
+        fallback — callers apply `shards <= 1` themselves (the setup
+        path wants to warn, the policy path just wants the answer)."""
         from .parallel.learner import resolve_tree_learner
         cfg = self.config
         bundled = self._dd.efb is not None
-        kind = resolve_tree_learner(cfg.tree_learner or "serial",
-                                    bundled=bundled, quiet=True)
+        name = cfg.tree_learner or "serial"
+        kind = resolve_tree_learner(name, bundled=bundled, quiet=True)
         if kind == "serial":
-            return "serial"
+            return "serial", 1, 1, 1, False
         try:
             n_dev = len(jax.devices())
         except RuntimeError:
             n_dev = 1
         shards = cfg.num_machines if (cfg.num_machines or 0) > 1 else n_dev
         shards = min(shards, n_dev)
-        if shards <= 1:
-            return "serial"
         dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
         use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
-        return resolve_tree_learner(cfg.tree_learner or "serial",
-                                    bundled=bundled, two_level=use_2level,
-                                    quiet=True)
+        kind = resolve_tree_learner(name, bundled=bundled,
+                                    two_level=use_2level, quiet=True)
+        return kind, shards, n_dev, dcn, use_2level
 
     def _resolve_grow_policy(self) -> str:
         """Resolve `tree_grow_policy` with eligibility downgrades (see
@@ -591,17 +596,22 @@ class Booster:
             reasons.append("histogram_pool_size (bounded histogram pool)")
         if spec.n_ic_groups:
             reasons.append("interaction constraints")
-        kind = self._final_learner_kind()
+        kind, shards, _, _, _ = self._learner_topology()
+        if shards <= 1:
+            kind = "serial"      # the one-device fallback (wave-eligible)
         if kind not in ("serial", "data"):
             reasons.append(f"tree_learner={kind} (wave supports serial "
                            "and data-parallel)")
         if spec.hist_impl in ("pallas", "pallas_q"):
-            # the wave path runs the full-M multi-leaf kernel shapes —
-            # gate on THEIR probe (the single-leaf probe gating hist_impl
-            # says nothing about the [126, N_t] blocks)
+            # the wave path runs exactly ONE multi-leaf kernel block
+            # shape (root pass padded to the wave width) — gate on a
+            # probe of THAT shape (the single-leaf probe gating
+            # hist_impl says nothing about the multi blocks)
             from .ops.pallas_hist import probe_cached
+            w = max(1, min(spec.wave_width or 14, spec.num_leaves - 1))
             if not probe_cached(self._dd.max_bin, self._dd.num_feature,
-                                multi=True):
+                                multi=True, width=w,
+                                quantized=spec.hist_impl == "pallas_q"):
                 reasons.append("a failing multi-leaf Pallas kernel probe "
                                "on this backend")
         if reasons:
@@ -709,9 +719,9 @@ class Booster:
         from .parallel.learner import resolve_tree_learner
         cfg = self.config
         bundled = self._dd.efb is not None
-        # quiet resolution first — warnings fire once, after the cache check
-        kind = resolve_tree_learner(cfg.tree_learner or "serial",
-                                    bundled=bundled, quiet=True)
+        # quiet resolution via the shared topology resolver — warnings
+        # fire once, after the cache check
+        kind, shards, n_dev, dcn, use_2level = self._learner_topology()
         # EFB: training reads the bundled matrix (see _DeviceData)
         train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
         if kind == "serial":
@@ -719,17 +729,6 @@ class Booster:
             self._train_bins = train_src
             self._learner_cache_key = None
             return
-        try:
-            n_dev = len(jax.devices())
-        except RuntimeError:
-            n_dev = 1
-        shards = cfg.num_machines if (cfg.num_machines or 0) > 1 else n_dev
-        shards = min(shards, n_dev)
-        dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
-        use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
-        kind = resolve_tree_learner(cfg.tree_learner or "serial",
-                                    bundled=bundled, two_level=use_2level,
-                                    quiet=True)
         # reset_parameter (lr schedules) calls this every iteration — reuse
         # the compiled grower and placed bins when nothing changed
         wave = self._grow_policy == "wave"

@@ -108,6 +108,9 @@ class GrowerSpec(NamedTuple):
     # per batched kernel pass; 0 = strict policy (field inert here, rides
     # the spec so the two growers share one cache key space)
     wave_width: int = 0
+    # False = every feature is numerical (static): the split finder skips
+    # the categorical cases — four [F, MB] argsorts per call
+    has_cat: bool = True
     # monotone_constraints_method=intermediate (ref:
     # monotone_constraints.hpp `IntermediateLeafConstraints`): per-leaf
     # bounds are recomputed every split from the CURRENT outputs of the
@@ -180,6 +183,123 @@ def _merge_split_across_shards(s: SplitResult, axis_name: str,
     return jax.tree_util.tree_map(pick, s)
 
 
+# --------------------------------------------------------------------------
+# helpers shared by the strict (below) and wave (ops/grow_wave.py) growers —
+# one definition so the two policies can never drift on partition decode,
+# per-node sampling, EFB expansion, or monotone-basic child bounds
+# --------------------------------------------------------------------------
+
+def make_bundled_expander(spec: GrowerSpec, feat: Dict[str, Array]):
+    """(expand_bundled, decode_bins) for EFB bundle matrices.
+
+    expand_bundled: [G, HB, 3] bundle histogram → per-feature [F, MB, 3]
+    view — member bins are a gather; the default bin 0 is parent −
+    Σ(nonzero bins), the sparse-bin identity the reference exploits the
+    same way (dense_bin vs sparse_bin zero handling).
+    decode_bins: the split feature's original bin column from its bundle
+    column (off..off+nb-2 ↔ original bins 1..nb-1, else 0)."""
+    MB = spec.max_bin
+    HB = spec.bundle_max_bin
+    bcol = feat["bundle_col"]
+    boff = feat["bundle_off"]
+    bident = feat["bundle_identity"]
+    b_ar_mb = jnp.arange(MB, dtype=jnp.int32)
+    src_bins = boff[:, None] + b_ar_mb[None, :] - 1            # [F, MB]
+    valid_b = (b_ar_mb[None, :] >= 1) \
+        & (b_ar_mb[None, :] < feat["nb"][:, None])
+
+    def expand_bundled(histg, pg, ph, pc):
+        gath = histg[bcol[:, None],
+                     jnp.clip(src_bins, 0, HB - 1)]            # [F, MB, 3]
+        hist = jnp.where(valid_b[..., None], gath, 0.0)
+        rest = hist.sum(axis=1)                                # [F, 3]
+        parent = jnp.stack([pg, ph, pc]).astype(jnp.float32)
+        zero_row = jnp.where(bident[:, None],
+                             histg[bcol, 0, :],
+                             parent[None, :] - rest)
+        return hist.at[:, 0, :].set(zero_row)
+
+    def decode_bins(bins_fm, f):
+        col = bcol[f]
+        off = boff[f]
+        raw_col = jnp.take(bins_fm, col, axis=0).astype(jnp.int32)
+        in_range = (raw_col >= off) & \
+            (raw_col < off + feat["nb"][f] - 1)
+        return jnp.where(in_range, raw_col - off + 1, 0)
+
+    return expand_bundled, decode_bins
+
+
+def make_node_samplers(spec: GrowerSpec, feat: Dict[str, Array], F: int):
+    """(bynode_mask, extra_mask) — per-node column sampling (ref:
+    col_sampler.hpp `GetByNode`) and extra_trees random-threshold masks.
+    Node index derives the RNG key, so both growers draw IDENTICAL
+    per-node samples for the same tree."""
+    MB = spec.max_bin
+    if spec.feature_fraction_bynode < 1.0:
+        f_real = spec.num_features_hint or F
+        n_pick = max(1, int(spec.feature_fraction_bynode * f_real + 1e-9))
+
+        def bynode_mask(node_idx):
+            key = jax.random.fold_in(feat["ff_key"], node_idx)
+            perm = jax.random.permutation(key, f_real)
+            return jnp.zeros((F,), bool).at[perm[:n_pick]].set(True)
+    else:
+        def bynode_mask(node_idx):
+            return jnp.ones((F,), bool)
+
+    if spec.extra_trees:
+        def extra_mask(node_idx):
+            """One random numerical threshold per feature per node (ref:
+            extra_trees); categorical features keep their candidates."""
+            key = jax.random.fold_in(feat["ff_key"], (1 << 24) + node_idx)
+            r = jax.random.uniform(key, (F,))
+            t_max = jnp.maximum(feat["nb"] - 2, 0)
+            pick = (r * (t_max + 1).astype(jnp.float32)).astype(jnp.int32)
+            m = jnp.zeros((F, MB), bool)\
+                .at[jnp.arange(F), jnp.clip(pick, 0, MB - 1)].set(True)
+            return m | feat["is_cat"][:, None]
+    else:
+        def extra_mask(node_idx):
+            return None
+
+    return bynode_mask, extra_mask
+
+
+def split_go_left(spec: GrowerSpec, feat: Dict[str, Array], bins_fm: Array,
+                  decode_bins, f, t, dl, node_cat, node_mask) -> Array:
+    """[N] left/right routing of one applied split (bundled decode +
+    missing handling + the categorical mask gather, which is gated behind
+    `lax.cond` — the [MB]-table gather at N indices is ~7 ms per split at
+    1M rows on TPU, VMEM-read bound, so it only runs for cat splits)."""
+    if spec.bundled:
+        fbins = decode_bins(bins_fm, f)
+    else:
+        fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)
+    is_nan_bin = (feat["missing"][f] == 2) & (fbins == feat["nb"][f] - 1)
+    go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
+    if spec.has_cat:
+        return jax.lax.cond(node_cat, lambda: node_mask[fbins],
+                            lambda: go_left_num)
+    return go_left_num
+
+
+def child_bounds_basic(mono_f, l_sm, r_sm, lb, ub):
+    """Monotone "basic" method at one split (ref:
+    monotone_constraints.hpp `BasicLeafConstraints`): one-shot midpoint
+    bounds at child creation, children clamped to THEIR bounds.
+    Returns (l_fin, r_fin, l_lb, l_ub, r_lb, r_ub)."""
+    l_out = jnp.clip(l_sm, lb, ub)
+    r_out = jnp.clip(r_sm, lb, ub)
+    mid = 0.5 * (l_out + r_out)
+    l_ub = jnp.where(mono_f == 1, jnp.minimum(ub, mid), ub)
+    r_lb = jnp.where(mono_f == 1, jnp.maximum(lb, mid), lb)
+    l_lb = jnp.where(mono_f == -1, jnp.maximum(lb, mid), lb)
+    r_ub = jnp.where(mono_f == -1, jnp.minimum(ub, mid), ub)
+    return (jnp.clip(l_sm, l_lb, l_ub), jnp.clip(r_sm, r_lb, r_ub),
+            l_lb, l_ub, r_lb, r_ub)
+
+
 @functools.lru_cache(maxsize=64)
 def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 n_shards: int = 1):
@@ -229,7 +349,7 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
         max_cat_threshold=spec.max_cat_threshold,
         max_cat_to_onehot=spec.max_cat_to_onehot,
-        path_smooth=spec.path_smooth)
+        path_smooth=spec.path_smooth, has_cat=spec.has_cat)
     # voting: local votes use the shard's row subset, so size constraints
     # scale by 1/shards (ref: VotingParallelTreeLearner ctor divides
     # min_data_in_leaf / min_sum_hessian by num_machines)
@@ -243,7 +363,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
         max_cat_threshold=spec.max_cat_threshold,
         max_cat_to_onehot=spec.max_cat_to_onehot,
-        path_smooth=spec.path_smooth, want_feature_gains=True)
+        path_smooth=spec.path_smooth, want_feature_gains=True,
+        has_cat=spec.has_cat)
 
     def clamp_output(g, h):
         return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
@@ -282,29 +403,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
             mono = jnp.zeros((F,), jnp.int32)
 
         if spec.bundled:
-            bcol = feat["bundle_col"]
-            boff = feat["bundle_off"]
-            bident = feat["bundle_identity"]
-            b_ar_mb = jnp.arange(MB, dtype=jnp.int32)
-            src_bins = boff[:, None] + b_ar_mb[None, :] - 1        # [F, MB]
-            valid_b = (b_ar_mb[None, :] >= 1) \
-                & (b_ar_mb[None, :] < feat["nb"][:, None])
-
-            def expand_bundled(histg, pg, ph, pc):
-                """[G, HB, 3] bundle histogram → per-feature [F, MB, 3]
-                view: member bins are a gather; the default bin 0 is
-                parent − Σ(nonzero bins) — the sparse-bin identity the
-                reference exploits the same way (dense_bin vs sparse_bin
-                zero handling)."""
-                gath = histg[bcol[:, None],
-                             jnp.clip(src_bins, 0, HB - 1)]        # [F,MB,3]
-                hist = jnp.where(valid_b[..., None], gath, 0.0)
-                rest = hist.sum(axis=1)                            # [F, 3]
-                parent = jnp.stack([pg, ph, pc]).astype(jnp.float32)
-                zero_row = jnp.where(bident[:, None],
-                                     histg[bcol, 0, :],
-                                     parent[None, :] - rest)
-                return hist.at[:, 0, :].set(zero_row)
+            expand_bundled, decode_bins = make_bundled_expander(spec, feat)
+        else:
+            decode_bins = None
 
         if block:
             # this shard owns feature block [offset, offset + Fb) for split
@@ -449,40 +550,9 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 s = _merge_split_across_shards(s, axis_last, n_shards)
             return s
 
-        # per-node column sampling (ref: col_sampler.hpp GetByNode); node
-        # index derives the key so every node draws a fresh subset.  The
-        # permutation runs over the REAL feature count so padded dummy
-        # columns (distributed modes) don't dilute the sample.
-        if spec.feature_fraction_bynode < 1.0:
-            f_real = spec.num_features_hint or F
-            n_pick = max(1, int(spec.feature_fraction_bynode * f_real
-                                + 1e-9))
-
-            def bynode_mask(node_idx):
-                key = jax.random.fold_in(feat["ff_key"], node_idx)
-                perm = jax.random.permutation(key, f_real)
-                return jnp.zeros((F,), bool).at[perm[:n_pick]].set(True)
-        else:
-            def bynode_mask(node_idx):
-                return jnp.ones((F,), bool)
-
-        if spec.extra_trees:
-            def extra_mask(node_idx):
-                """One random numerical threshold per feature per node
-                (ref: extra_trees — extremely randomized split search);
-                categorical features keep their full candidate sets."""
-                key = jax.random.fold_in(feat["ff_key"],
-                                         (1 << 24) + node_idx)
-                r = jax.random.uniform(key, (F,))
-                t_max = jnp.maximum(feat["nb"] - 2, 0)
-                pick = (r * (t_max + 1).astype(jnp.float32))\
-                    .astype(jnp.int32)
-                m = jnp.zeros((F, MB), bool)\
-                    .at[jnp.arange(F), jnp.clip(pick, 0, MB - 1)].set(True)
-                return m | feat["is_cat"][:, None]
-        else:
-            def extra_mask(node_idx):
-                return None
+        # per-node column sampling + extra_trees (shared derivations —
+        # the wave grower draws IDENTICAL per-node samples)
+        bynode_mask, extra_mask = make_node_samplers(spec, feat, F)
 
         # forced splits (BFS order), applied before best-gain growth
         n_forced = len(spec.forced_splits)
@@ -670,21 +740,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
              node_mask) = chosen
 
             # ---- partition: dense leaf_id update (no row movement) ----
-            if spec.bundled:
-                # decode the split feature's original bin from its bundle
-                # column: off..off+nb-2 ↔ original bins 1..nb-1, else 0
-                col = feat["bundle_col"][f]
-                off = feat["bundle_off"][f]
-                raw_col = jnp.take(bins_fm, col, axis=0).astype(jnp.int32)
-                in_range = (raw_col >= off) & \
-                    (raw_col < off + feat["nb"][f] - 1)
-                fbins = jnp.where(in_range, raw_col - off + 1, 0)
-            else:
-                fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)
-            is_nan_bin = (feat["missing"][f] == 2) & \
-                (fbins == feat["nb"][f] - 1)
-            go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
-            go_left = jnp.where(node_cat, node_mask[fbins], go_left_num)
+            go_left = split_go_left(spec, feat, bins_fm, decode_bins,
+                                    f, t, dl, node_cat, node_mask)
             leaf_id = jnp.where(in_leaf & ~go_left, new, st["leaf_id"])
 
             # ---- record the internal node ----
@@ -716,16 +773,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                                  spec.path_smooth)
             if not interm:
                 # basic method: one-shot midpoint bounds at creation
-                l_out = jnp.clip(l_sm, lb, ub)
-                r_out = jnp.clip(r_sm, lb, ub)
-                mid = 0.5 * (l_out + r_out)
-                l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
-                r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
-                l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
-                r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
-                # children's own (final) outputs, clamped to THEIR bounds
-                l_fin = jnp.clip(l_sm, l_lb, l_ub)
-                r_fin = jnp.clip(r_sm, r_lb, r_ub)
+                (l_fin, r_fin, l_lb, l_ub, r_lb, r_ub) = \
+                    child_bounds_basic(mc_f, l_sm, r_sm, lb, ub)
             else:
                 # intermediate method: outputs only clip to the parent's
                 # bounds (the split search already enforced the direction

@@ -43,7 +43,9 @@ from typing import Dict
 import jax
 import jax.numpy as jnp
 
-from .grow import DeviceTree, GrowerSpec, _split_to_arrays
+from .grow import (DeviceTree, GrowerSpec, _split_to_arrays,
+                   child_bounds_basic, make_bundled_expander,
+                   make_node_samplers, split_go_left)
 from .histogram import leaf_histogram_multi, leaf_histogram_packed_multi
 from .split import NEG_INF, find_best_split, leaf_output, smooth_output
 
@@ -53,13 +55,15 @@ INF = jnp.inf
 
 
 @functools.lru_cache(maxsize=64)
-def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
+def make_wave_grower(spec: GrowerSpec, axis_name=None):
     """Build (and cache) the jitted wave grower for a static spec.
 
-    Same signature/contract as `ops.grow.make_grower`; with `axis_name`
-    the grower runs the data-parallel strategy only (rows sharded,
-    batched histograms `psum`med — ref: data_parallel_tree_learner.cpp;
-    the block/voting strategies keep the strict grower)."""
+    Same contract as `ops.grow.make_grower`; with `axis_name` the grower
+    runs the data-parallel strategy only (rows sharded, batched
+    histograms `psum`med — ref: data_parallel_tree_learner.cpp; the
+    block/voting strategies keep the strict grower).  Histograms are
+    globally summed before split finding, so size constraints need no
+    per-shard rescaling (unlike the voting learner's local vote)."""
     L = spec.num_leaves
     MB = spec.max_bin
     W = max(1, min(spec.wave_width or 14, L - 1))
@@ -73,7 +77,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
         cat_smooth=spec.cat_smooth, cat_l2=spec.cat_l2,
         max_cat_threshold=spec.max_cat_threshold,
         max_cat_to_onehot=spec.max_cat_to_onehot,
-        path_smooth=spec.path_smooth)
+        path_smooth=spec.path_smooth, has_cat=spec.has_cat)
 
     def clamp_output(g, h):
         return leaf_output(g, h, spec.lambda_l1, spec.lambda_l2,
@@ -99,26 +103,9 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
             mono = jnp.zeros((F,), jnp.int32)
 
         if spec.bundled:
-            bcol = feat["bundle_col"]
-            boff = feat["bundle_off"]
-            bident = feat["bundle_identity"]
-            b_ar_mb = jnp.arange(MB, dtype=jnp.int32)
-            src_bins = boff[:, None] + b_ar_mb[None, :] - 1        # [F, MB]
-            valid_b = (b_ar_mb[None, :] >= 1) \
-                & (b_ar_mb[None, :] < feat["nb"][:, None])
-
-            def expand_bundled(histg, pg, ph, pc):
-                """[G, HB, 3] bundle histogram → per-feature [F, MB, 3]
-                (same identity as ops/grow.py)."""
-                gath = histg[bcol[:, None],
-                             jnp.clip(src_bins, 0, HB - 1)]        # [F,MB,3]
-                hist = jnp.where(valid_b[..., None], gath, 0.0)
-                rest = hist.sum(axis=1)                            # [F, 3]
-                parent = jnp.stack([pg, ph, pc]).astype(jnp.float32)
-                zero_row = jnp.where(bident[:, None],
-                                     histg[bcol, 0, :],
-                                     parent[None, :] - rest)
-                return hist.at[:, 0, :].set(zero_row)
+            expand_bundled, decode_bins = make_bundled_expander(spec, feat)
+        else:
+            decode_bins = None
 
         def hist_multi(leaf_id, slots):
             """[S, F|G, HB, 3] histograms of the listed leaf slots in one
@@ -145,35 +132,10 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
                     h = jax.lax.psum(h, axes_all)
             return h
 
-        # per-node column sampling / extra_trees (same derivations as the
-        # strict grower so both policies draw identical per-node samples)
-        if spec.feature_fraction_bynode < 1.0:
-            f_real = spec.num_features_hint or F
-            n_pick = max(1, int(spec.feature_fraction_bynode * f_real
-                                + 1e-9))
-
-            def bynode_mask(node_idx):
-                key = jax.random.fold_in(feat["ff_key"], node_idx)
-                perm = jax.random.permutation(key, f_real)
-                return jnp.zeros((F,), bool).at[perm[:n_pick]].set(True)
-        else:
-            def bynode_mask(node_idx):
-                return jnp.ones((F,), bool)
-
-        if spec.extra_trees:
-            def extra_mask(node_idx):
-                key = jax.random.fold_in(feat["ff_key"],
-                                         (1 << 24) + node_idx)
-                r = jax.random.uniform(key, (F,))
-                t_max = jnp.maximum(feat["nb"] - 2, 0)
-                pick = (r * (t_max + 1).astype(jnp.float32))\
-                    .astype(jnp.int32)
-                m = jnp.zeros((F, MB), bool)\
-                    .at[jnp.arange(F), jnp.clip(pick, 0, MB - 1)].set(True)
-                return m | feat["is_cat"][:, None]
-        else:
-            def extra_mask(node_idx):
-                return None
+        # per-node column sampling / extra_trees — the SAME shared
+        # derivations as the strict grower (ops/grow.py), so both
+        # policies draw identical per-node samples for the same tree
+        bynode_mask, extra_mask = make_node_samplers(spec, feat, F)
 
         def split_of(hist, g, h, c, node_allowed, lb, ub, p_out, nid):
             if spec.bundled:
@@ -184,8 +146,13 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
                         parent_output=p_out, cand_mask=extra_mask(nid))
 
         # ---- root ----
+        # the root pass uses the SAME [W]-slot call shape as every wave
+        # (pad slots L match nothing), so exactly ONE multi-kernel block
+        # shape is ever compiled/run per spec — the shape the booster's
+        # probe gate checks
         leaf_id0 = jnp.zeros((N,), jnp.int32)
-        hist0 = hist_multi(leaf_id0, jnp.zeros((1,), jnp.int32))[0]
+        root_slots = jnp.full((W,), L, jnp.int32).at[0].set(0)
+        hist0 = hist_multi(leaf_id0, root_slots)[0]
         root_g = payload[:, 0].sum()
         root_h = payload[:, 1].sum()
         root_c = payload[:, 2].sum()
@@ -273,22 +240,9 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
                  node_mask) = tuple(s[k][best] for k in LEAF_KEYS)
                 in_leaf = s["leaf_id"] == best
 
-                # ---- partition (same decode as the strict grower) ----
-                if spec.bundled:
-                    col = feat["bundle_col"][f]
-                    off = feat["bundle_off"][f]
-                    raw_col = jnp.take(bins_fm, col, axis=0)\
-                        .astype(jnp.int32)
-                    in_range = (raw_col >= off) & \
-                        (raw_col < off + feat["nb"][f] - 1)
-                    fbins = jnp.where(in_range, raw_col - off + 1, 0)
-                else:
-                    fbins = jnp.take(bins_fm, f, axis=0).astype(jnp.int32)
-                is_nan_bin = (feat["missing"][f] == 2) & \
-                    (fbins == feat["nb"][f] - 1)
-                go_left_num = jnp.where(is_nan_bin, dl, fbins <= t)
-                go_left = jnp.where(node_cat, node_mask[fbins],
-                                    go_left_num)
+                # ---- partition (shared decode with the strict grower) --
+                go_left = split_go_left(spec, feat, bins_fm, decode_bins,
+                                        f, t, dl, node_cat, node_mask)
                 leaf_id = jnp.where(in_leaf & ~go_left, new, s["leaf_id"])
 
                 nodes = s["nodes"]
@@ -321,15 +275,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, n_shards: int = 1):
                                      spec.path_smooth)
                 r_sm = smooth_output(clamp_output(rg_, rh), rc, parent_out,
                                      spec.path_smooth)
-                l_out = jnp.clip(l_sm, lb, ub)
-                r_out = jnp.clip(r_sm, lb, ub)
-                mid = 0.5 * (l_out + r_out)
-                l_ub = jnp.where(mc_f == 1, jnp.minimum(ub, mid), ub)
-                r_lb = jnp.where(mc_f == 1, jnp.maximum(lb, mid), lb)
-                l_lb = jnp.where(mc_f == -1, jnp.maximum(lb, mid), lb)
-                r_ub = jnp.where(mc_f == -1, jnp.minimum(ub, mid), ub)
-                l_fin = jnp.clip(l_sm, l_lb, l_ub)
-                r_fin = jnp.clip(r_sm, r_lb, r_ub)
+                (l_fin, r_fin, l_lb, l_ub, r_lb, r_ub) = \
+                    child_bounds_basic(mc_f, l_sm, r_sm, lb, ub)
 
                 left_smaller = lc <= rc
                 small = jnp.where(left_smaller, best, new)

@@ -98,6 +98,83 @@ def _hist_kernel(bins_ref, pw_ref, out_ref, *, mb: int):
             preferred_element_type=jnp.float32)
 
 
+def _hist_kernel_multi(bins_ref, pw_ref, lid_ref, slots_ref, out_ref, *,
+                       mb: int):
+    """Multi-leaf grid cell with IN-KERNEL leaf masking.
+
+    bins_ref: [F_t, N_t]; pw_ref: [R0, N_t] base payload rows (9 f32-split
+    or 3 quantized-lattice); lid_ref: [1, N_t] i32 row→leaf; slots_ref:
+    [1, S] i32 leaf slots; out_ref: [F_t, S*R0, MB] accumulator.
+
+    Building the [S*R0, N_t] masked LHS in VMEM (instead of materialising
+    it in HBM as the first multi formulation did) removes ~5.5 ms of
+    reshape/pad/select HBM traffic per 1M-row pass — the mask compare and
+    select are VPU work overlapping the MXU dots.
+    """
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    f_t, n_t = bins_ref.shape
+    pw = pw_ref[:]                                   # [R0, N_t]
+    lid = lid_ref[0, :]                              # [N_t] i32
+    s_n = slots_ref.shape[1]
+    lhs = jnp.concatenate(
+        [jnp.where((lid == slots_ref[0, s])[None, :], pw, 0.0)
+         for s in range(s_n)], axis=0)               # [S*R0, N_t]
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, mb), 1)
+    for f in range(f_t):                             # static unroll
+        b = bins_ref[f, :].astype(jnp.int32)
+        onehot = (b[:, None] == bin_ids).astype(jnp.float32)
+        out_ref[f] += jax.lax.dot_general(
+            lhs, onehot, (((1,), (0,)), ((), ())),
+            precision=jax.lax.Precision.DEFAULT,
+            preferred_element_type=jnp.float32)
+
+
+def _run_kernel_multi(bins_fm: Array, pw0: Array, leaf_id: Array,
+                      slots: Array, max_bin: int, row_tile: int,
+                      feat_tile: int, interpret: bool) -> Array:
+    """pallas_call driver for the in-kernel-masked multi-leaf kernel:
+    [F, N] bins x [R0, N] payload x [N] leaf ids x [S] slots ->
+    [F, S*R0, MB] f32."""
+    f, n = bins_fm.shape
+    r0 = pw0.shape[0]
+    s_n = slots.shape[0]
+    n_pad = (-n) % row_tile
+    if n_pad:
+        pw0 = jnp.pad(pw0, ((0, 0), (0, n_pad)))
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, n_pad)))
+        # padded rows carry leaf -1: matches no slot, contributes nothing
+        leaf_id = jnp.pad(leaf_id, (0, n_pad), constant_values=-1)
+    if feat_tile <= 0 or feat_tile > f:
+        feat_tile = f
+    f_pad = (-f) % feat_tile
+    if f_pad:
+        bins_fm = jnp.pad(bins_fm, ((0, f_pad), (0, 0)))
+    n_rt = (n + n_pad) // row_tile
+    n_ft = (f + f_pad) // feat_tile
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_multi, mb=max_bin),
+        grid=(n_ft, n_rt),  # row tiles iterate fastest -> out revisited
+        in_specs=[
+            pl.BlockSpec((feat_tile, row_tile), lambda j, r: (j, r)),
+            pl.BlockSpec((r0, row_tile), lambda j, r: (0, r)),
+            pl.BlockSpec((1, row_tile), lambda j, r: (0, r)),
+            pl.BlockSpec((1, s_n), lambda j, r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((feat_tile, s_n * r0, max_bin),
+                               lambda j, r: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f + f_pad, s_n * r0, max_bin),
+                                       jnp.float32),
+        interpret=interpret,
+    )(bins_fm, pw0, leaf_id.astype(jnp.int32)[None, :], slots[None, :])
+    return out[:f]
+
+
 def _run_kernel(bins_fm: Array, pw: Array, max_bin: int, row_tile: int,
                 feat_tile: int, interpret: bool) -> Array:
     """Shared pallas_call driver: [F, N] bins x [R, N] payload rows (f32
@@ -207,14 +284,12 @@ def pallas_histogram_multi(bins_fm: Array, payload: Array, leaf_id: Array,
     """
     S = slots.shape[0]
     pw9 = _split_payload9(payload)                   # [9, N]
-    eq = leaf_id[None, :] == slots[:, None]          # [S, N]
-    pws = jnp.where(eq[:, None, :], pw9[None], 0.0)\
-        .reshape(S * 9, pw9.shape[1])                # [S*9, N]
     outs = []
     for c0 in range(0, S, MULTI_CHUNK):
         c1 = min(S, c0 + MULTI_CHUNK)
-        out = _run_kernel(bins_fm, pws[c0 * 9:c1 * 9], max_bin, row_tile,
-                          feat_tile, interpret)      # [F, (c1-c0)*9, MB]
+        out = _run_kernel_multi(bins_fm, pw9, leaf_id, slots[c0:c1],
+                                max_bin, row_tile, feat_tile,
+                                interpret)           # [F, (c1-c0)*9, MB]
         f = out.shape[0]
         # rows per leaf are (channel, split-term) major → sum the terms
         out = out.reshape(f, c1 - c0, 3, 3, max_bin).sum(axis=3)
@@ -241,14 +316,12 @@ def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
     hq = jnp.round(payload[:, 1] / s_h)
     w = jax.lax.reduce_precision(payload[:, 2], 8, 7)    # {0,1} — exact
     pw3 = jnp.stack([gq, hq, w])                         # [3, N]
-    eq = leaf_id[None, :] == slots[:, None]              # [S, N]
-    pws = jnp.where(eq[:, None, :], pw3[None], 0.0)\
-        .reshape(S * 3, pw3.shape[1])                    # [S*3, N]
     outs = []
     for c0 in range(0, S, MULTI_CHUNK_Q):
         c1 = min(S, c0 + MULTI_CHUNK_Q)
-        out = _run_kernel(bins_fm, pws[c0 * 3:c1 * 3], max_bin, row_tile,
-                          feat_tile, interpret)          # [F, (c1-c0)*3, MB]
+        out = _run_kernel_multi(bins_fm, pw3, leaf_id, slots[c0:c1],
+                                max_bin, row_tile, feat_tile,
+                                interpret)           # [F, (c1-c0)*3, MB]
         f = out.shape[0]
         out = out.reshape(f, c1 - c0, 3, max_bin)
         outs.append(out.transpose(1, 0, 3, 2))           # [c, F, MB, 3]
@@ -288,20 +361,24 @@ _PROBE_CACHE = {}
 
 
 def probe_cached(max_bin: int = 256, num_feature: int = 28,
-                 multi: bool = False) -> bool:
-    """probe(), memoised per (backend platform, shape, multi)."""
+                 multi: bool = False, width: int = None,
+                 quantized: bool = None) -> bool:
+    """probe(), memoised per (backend platform, shape, multi params)."""
     try:
-        key = (jax.devices()[0].platform, max_bin, num_feature, multi)
+        key = (jax.devices()[0].platform, max_bin, num_feature, multi,
+               width, quantized)
     except RuntimeError:
         return False
     if key not in _PROBE_CACHE:
         _PROBE_CACHE[key] = probe(max_bin=max_bin,
-                                  num_feature=num_feature, multi=multi)
+                                  num_feature=num_feature, multi=multi,
+                                  width=width, quantized=quantized)
     return _PROBE_CACHE[key]
 
 
 def probe(interpret: bool = False, max_bin: int = 256,
-          num_feature: int = 28, multi: bool = False) -> bool:
+          num_feature: int = 28, multi: bool = False, width: int = None,
+          quantized: bool = None) -> bool:
     """Runtime check that the kernel compiles and matches segment-sum on
     the current backend — used by Booster to gate the TPU histogram path.
     Probes at the PRODUCTION bin count / feature count / ROW_TILE (Mosaic
@@ -310,9 +387,13 @@ def probe(interpret: bool = False, max_bin: int = 256,
     keep the probe cheap.
 
     `multi=False` covers the single-leaf block shapes gating `hist_impl`;
-    `multi=True` covers ONLY the full-M multi-leaf shapes gating the wave
-    policy — kept separate so a wave-shape regression degrades the wave
-    policy, not every strict-policy user's histogram path."""
+    `multi=True` covers ONLY the multi-leaf shapes gating the wave policy
+    — kept separate so a wave-shape regression degrades the wave policy,
+    not every strict-policy user's histogram path.  The wave grower runs
+    exactly ONE multi block shape per spec (its root pass pads to the
+    wave width), so pass `width` = min(wave_width, num_leaves - 1) and
+    `quantized` = (hist_impl == 'pallas_q') to probe that exact shape;
+    the defaults probe a full chunk of both families."""
     import numpy as np
 
     from .histogram import leaf_histogram
@@ -330,32 +411,37 @@ def probe(interpret: bool = False, max_bin: int = 256,
                     jnp.ones((n,), jnp.float32)], axis=1)
     try:
         if multi:
-            # the wave grower's FULL-M multi-leaf block shapes
-            # ([126, N_t] LHS) — a full chunk of each
-            leaf_id = jnp.asarray(
-                rng.randint(0, MULTI_CHUNK + 2, (n,)).astype(np.int32))
-            slots = jnp.arange(MULTI_CHUNK, dtype=jnp.int32)
-            gotm = pallas_histogram_multi(bins, payload, leaf_id, slots,
-                                          max_bin,
-                                          row_tile=min(n, ROW_TILE),
-                                          interpret=interpret)
-            wantm = jnp.stack([leaf_histogram(bins, payload,
-                                              leaf_id == sl, max_bin)
-                               for sl in range(3)])
-            if not bool(jnp.allclose(gotm[:3], wantm, rtol=1e-4,
-                                     atol=1e-4)):
-                return False
-            lid_q = jnp.asarray(
-                rng.randint(0, MULTI_CHUNK_Q + 2, (n,)).astype(np.int32))
-            slots_q = jnp.arange(MULTI_CHUNK_Q, dtype=jnp.int32)
-            gotmq = pallas_histogram_multi_quantized(
-                bins, pq, lid_q, slots_q, max_bin, s, s,
-                row_tile=min(n, ROW_TILE), interpret=interpret)
-            wantmq = jnp.stack([leaf_histogram(bins, pq, lid_q == sl,
-                                               max_bin)
-                                for sl in range(3)])
-            return bool(jnp.allclose(gotmq[:3], wantmq, rtol=1e-4,
-                                     atol=1e-4))
+            # the wave grower's multi-leaf block shapes, at the exact
+            # production width when the caller supplies one
+            if quantized is None:
+                fams = [(False, width or MULTI_CHUNK),
+                        (True, width or MULTI_CHUNK_Q)]
+            else:
+                fams = [(quantized,
+                         width or (MULTI_CHUNK_Q if quantized
+                                   else MULTI_CHUNK))]
+            for quant_f, wdt in fams:
+                lid = jnp.asarray(
+                    rng.randint(0, wdt + 2, (n,)).astype(np.int32))
+                slots = jnp.arange(wdt, dtype=jnp.int32)
+                if quant_f:
+                    got = pallas_histogram_multi_quantized(
+                        bins, pq, lid, slots, max_bin, s, s,
+                        row_tile=min(n, ROW_TILE), interpret=interpret)
+                    ref_payload = pq
+                else:
+                    got = pallas_histogram_multi(
+                        bins, payload, lid, slots, max_bin,
+                        row_tile=min(n, ROW_TILE), interpret=interpret)
+                    ref_payload = payload
+                k = min(3, wdt)
+                want = jnp.stack([leaf_histogram(bins, ref_payload,
+                                                 lid == sl, max_bin)
+                                  for sl in range(k)])
+                if not bool(jnp.allclose(got[:k], want, rtol=1e-4,
+                                         atol=1e-4)):
+                    return False
+            return True
         got = pallas_histogram(bins, payload, mask, max_bin,
                                row_tile=min(n, ROW_TILE),
                                interpret=interpret)

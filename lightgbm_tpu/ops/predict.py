@@ -86,8 +86,12 @@ def replay_leaf_ids(tree, bins_fm: Array, feat_nb: Array,
         is_nan = (feat_missing[f] == 2) & (fbins == feat_nb[f] - 1)
         go_num = jnp.where(is_nan, tree.default_left[i],
                            fbins <= tree.threshold_bin[i])
-        go_left = jnp.where(tree.split_is_cat[i],
-                            tree.split_cat_mask[i][fbins], go_num)
+        # the [MB]-table gather at N indices is VMEM-read bound (~7 ms
+        # per node at 1M rows, see ops/grow.py) — only run it when the
+        # node is actually categorical
+        go_left = jax.lax.cond(
+            tree.split_is_cat[i],
+            lambda: tree.split_cat_mask[i][fbins], lambda: go_num)
         active = (lid == tree.split_leaf[i]) & (i < tree.n_splits)
         return jnp.where(active & ~go_left, i + 1, lid), None
 

@@ -108,7 +108,8 @@ def find_best_split(hist: Array,
                     parent_output: Array = None,
                     cand_mask: Array = None,
                     gain_penalty: Array = None,
-                    want_feature_gains: bool = False):
+                    want_feature_gains: bool = False,
+                    has_cat: bool = True):
     """Best split over all features of one leaf (numerical + categorical).
 
     `mono` [F] in {-1, 0, +1} plus scalar leaf output bounds [out_lb, out_ub]
@@ -124,6 +125,11 @@ def find_best_split(hist: Array,
     `path_smooth` > 0 shrinks candidate child outputs toward
     `parent_output` (ref: USE_SMOOTHING paths in feature_histogram.hpp).
     `cand_mask` [F, MB] restricts the candidate grid (forced splits).
+
+    `has_cat=False` (static) promises every feature is numerical and
+    skips the categorical cases entirely — four [F, MB] argsorts plus
+    three gain grids per call; callers with a static feature inventory
+    (the growers) thread it from their spec.
     """
     F, MB, _ = hist.shape
     bin_ar = jnp.arange(MB, dtype=jnp.int32)
@@ -199,6 +205,11 @@ def find_best_split(hist: Array,
     left1 = cum + nanv[:, None, :]
     right1 = parent[None, None, :] - left1
     gain1 = num_gain(left1, right1, valid_t & has_nan[:, None])
+
+    if not has_cat:
+        return _decide_numerical(
+            gain0, gain1, left0, left1, parent, feat_missing, feat_default,
+            F, MB, gain_penalty, cand_mask, want_feature_gains)
 
     # --------------------------------------------------------- categorical
     # ancestor output bounds clamp categorical candidates too (reference:
@@ -310,6 +321,48 @@ def find_best_split(hist: Array,
         default_left=dl,
         is_cat=best_is_cat & ~no_split,
         cat_mask=cat_mask & ~no_split,
+        left_sum_g=left[0], left_sum_h=left[1], left_cnt=left[2],
+        right_sum_g=right[0], right_sum_h=right[1], right_cnt=right[2],
+    )
+
+
+def _decide_numerical(gain0, gain1, left0, left1, parent, feat_missing,
+                      feat_default, F, MB, gain_penalty, cand_mask,
+                      want_feature_gains):
+    """Decide stage of `find_best_split` for the all-numerical fast path
+    (has_cat=False): identical selection semantics over the two numerical
+    missing-direction cases only."""
+    gains = jnp.stack([gain0, gain1])                            # [2, F, MB]
+    if gain_penalty is not None:
+        gains = gains - gain_penalty[None, :, None]
+    if cand_mask is not None:
+        gains = jnp.where(cand_mask[None, :, :], gains, NEG_INF)
+    if want_feature_gains:
+        return gains.max(axis=(0, 2))
+    flat = gains.reshape(-1)
+    best = jnp.argmax(flat)
+    best_gain = flat[best]
+    case = best // (F * MB)
+    rem = best % (F * MB)
+    feat = (rem // MB).astype(jnp.int32)
+    thr = (rem % MB).astype(jnp.int32)
+
+    left = jnp.stack([left0[feat, thr], left1[feat, thr]])[case]
+    right = parent - left
+
+    mtype = feat_missing[feat]
+    dl = jnp.where(mtype == MISSING_NAN, case == 1,
+                   jnp.where(mtype == MISSING_ZERO,
+                             feat_default[feat] <= thr, False))
+
+    no_split = ~jnp.isfinite(best_gain)
+    return SplitResult(
+        gain=jnp.where(no_split, NEG_INF, best_gain),
+        feature=jnp.where(no_split, -1, feat),
+        threshold_bin=thr,
+        default_left=dl,
+        is_cat=jnp.bool_(False),
+        cat_mask=jnp.zeros((MB,), bool),
         left_sum_g=left[0], left_sum_h=left[1], left_cnt=left[2],
         right_sum_g=right[0], right_sum_h=right[1], right_cnt=right[2],
     )

@@ -118,8 +118,7 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         from ..ops.grow_wave import make_wave_grower
         grow = make_wave_grower(spec,
                                 axis_name=axes if len(axes) > 1
-                                else axes[0],
-                                n_shards=S_total)
+                                else axes[0])
     else:
         grow = make_grower(spec,
                            axis_name=axes if len(axes) > 1 else axes[0],

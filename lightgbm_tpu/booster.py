@@ -355,6 +355,7 @@ class Booster:
             packed_const_hess_level=self._packed_const_hess_level(),
             monotone_intermediate=interm,
             wave_width=self._wave_width(),
+            wave_gain_ratio=self._wave_gain_ratio(),
             has_cat=bool(np.asarray(self._dd.is_cat).any()),
         )
         self._grow_policy = self._resolve_grow_policy()
@@ -534,16 +535,33 @@ class Booster:
         slots = max(2, slots)
         return slots if slots < self.config.num_leaves else 0
 
+    # default wave knobs from the quality/perf sweep (PROFILE.md round
+    # 3c): moderate waves keep the strict policy's deep-where-it-matters
+    # capacity allocation while still batching histogram passes (W=6:
+    # 4x strict rounds/s at ~0.004 held-out AUC of strict on the Higgs
+    # shape; W=14 was 0.016 worse — capacity leaked to breadth)
+    WAVE_WIDTH_DEFAULT = 6
+    WAVE_GAIN_RATIO_DEFAULT = 0.0
+
     def _wave_width(self) -> int:
-        """Leaves per batched histogram pass for the wave policy.  Keyed
-        by QUANTIZED-or-not (3 vs 9 payload rows per leaf in the MXU's
-        128-row LHS), not by impl name, so CPU (packed/segment_sum) and
-        TPU (pallas_q/pallas) backends grow IDENTICAL tree shapes for the
-        same params — the backend-parity contract."""
+        """Leaves per batched histogram pass for the wave policy.
+        `tpu_wave_width=0` (auto) picks the sweep default, capped at the
+        MXU LHS capacity for the payload family (14 f32 / 42 quantized
+        rows-per-leaf chunks).  Deterministic across backends given the
+        same params — the backend-parity contract (CPU packed ↔ TPU
+        pallas_q resolve to the same family)."""
         from .ops.pallas_hist import MULTI_CHUNK, MULTI_CHUNK_Q
-        return MULTI_CHUNK_Q \
+        cap = MULTI_CHUNK_Q \
             if self._resolve_hist_impl() in ("pallas_q", "packed") \
             else MULTI_CHUNK
+        w = int(self.config.tpu_wave_width or 0)
+        if w <= 0:
+            w = self.WAVE_WIDTH_DEFAULT
+        return min(w, cap)
+
+    def _wave_gain_ratio(self) -> float:
+        r = float(self.config.tpu_wave_gain_ratio)
+        return self.WAVE_GAIN_RATIO_DEFAULT if r < 0.0 else min(r, 1.0)
 
     def _learner_topology(self):
         """ONE resolver for the learner kind + mesh shape — consumed by
@@ -2219,7 +2237,8 @@ class Booster:
             hist_impl=self._resolve_hist_impl())
         self._grower_spec = self._grower_spec._replace(
             packed_const_hess_level=self._packed_const_hess_level(),
-            wave_width=self._wave_width())
+            wave_width=self._wave_width(),
+            wave_gain_ratio=self._wave_gain_ratio())
         self._grow_policy = self._resolve_grow_policy()
         self._grower = self._make_serial_grower()
         self._build_feat()

@@ -108,6 +108,11 @@ class GrowerSpec(NamedTuple):
     # per batched kernel pass; 0 = strict policy (field inert here, rides
     # the spec so the two growers share one cache key space)
     wave_width: int = 0
+    # wave depth bias: a ready leaf only splits while its gain >= ratio x
+    # the wave's best gain; weaker leaves wait (and may never split if
+    # capacity runs out first — how the wave policy keeps the strict
+    # policy's deep-where-it-matters capacity allocation).  0 = off
+    wave_gain_ratio: float = 0.0
     # False = every feature is numerical (static): the split finder skips
     # the categorical cases — four [F, MB] argsorts per call
     has_cat: bool = True

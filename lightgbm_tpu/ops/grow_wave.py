@@ -225,11 +225,17 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             istate["p_left"] = jnp.full((W,), L, jnp.int32)
             istate["p_new"] = jnp.full((W,), L, jnp.int32)
             istate["p_step"] = jnp.zeros((W,), jnp.int32)
+            # depth bias (wave_gain_ratio): the wave stops early once the
+            # best remaining ready gain falls below ratio x the wave's
+            # opening gain — weaker leaves wait for a later wave, so
+            # capacity flows to deep high-gain branches like the strict
+            # policy allocates it
+            istate["g_floor"] = jnp.float32(0.0)
 
             def icond(s):
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
                 return (s["w"] < W) & (s["step"] < L - 1) & \
-                    (jnp.max(rg) > 0.0)
+                    (jnp.max(rg) > jnp.maximum(s["g_floor"], 0.0))
 
             def ibody(s):
                 step = s["step"]
@@ -286,6 +292,10 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                 out.update(
                     step=step + 1, nl=new + 1, leaf_id=leaf_id,
                     nodes=nodes, w=s["w"] + 1,
+                    g_floor=jnp.where(
+                        s["w"] == 0,
+                        jnp.float32(spec.wave_gain_ratio) * gain_s,
+                        s["g_floor"]),
                     ready=s["ready"].at[best].set(False)
                     .at[new].set(False),
                     p_small=s["p_small"].at[s["w"]].set(small),
@@ -305,42 +315,56 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
 
             s1 = jax.lax.while_loop(icond, ibody, istate)
 
-            # ---- histogram phase: ONE batched pass for all smaller
-            # children; larger children by subtraction (the parent
-            # histogram still lives in the left child's slot) ----
-            small_h = hist_multi(s1["leaf_id"], s1["p_small"])
-            parents = st["hist"][jnp.clip(s1["p_left"], 0, L - 1)]
-            large_h = parents - small_h
-            p_large = jnp.where(s1["p_small"] == s1["p_left"],
-                                s1["p_new"], s1["p_left"])
-            hist = st["hist"].at[s1["p_small"]].set(small_h, mode="drop")
-            hist = hist.at[p_large].set(large_h, mode="drop")
+            def hist_and_find(_):
+                # ---- histogram phase: ONE batched pass for all smaller
+                # children; larger children by subtraction (the parent
+                # histogram still lives in the left child's slot) ----
+                small_h = hist_multi(s1["leaf_id"], s1["p_small"])
+                parents = st["hist"][jnp.clip(s1["p_left"], 0, L - 1)]
+                large_h = parents - small_h
+                p_large = jnp.where(s1["p_small"] == s1["p_left"],
+                                    s1["p_new"], s1["p_left"])
+                hist = st["hist"].at[s1["p_small"]]\
+                    .set(small_h, mode="drop")
+                hist = hist.at[p_large].set(large_h, mode="drop")
 
-            # ---- find phase: best splits of all new children, vmapped ----
-            child_slots = jnp.concatenate([s1["p_left"], s1["p_new"]])
-            node_ids = jnp.concatenate([2 * s1["p_step"] + 1,
-                                        2 * s1["p_step"] + 2])
+                # ---- find phase: best splits of the new children ----
+                child_slots = jnp.concatenate([s1["p_left"], s1["p_new"]])
+                node_ids = jnp.concatenate([2 * s1["p_step"] + 1,
+                                            2 * s1["p_step"] + 2])
 
-            def eval_child(slot, nid):
-                sl = jnp.clip(slot, 0, L - 1)
-                g, h, c = s1["leaf_g"][sl], s1["leaf_h"][sl], \
-                    s1["leaf_c"][sl]
-                deep_ok = (spec.max_depth <= 0) | \
-                    (s1["leaf_depth"][sl] < spec.max_depth)
-                sr = split_of(hist[sl], g, h, c, allowed & deep_ok,
-                              s1["leaf_lb"][sl], s1["leaf_ub"][sl],
-                              s1["leaf_out"][sl], nid)
-                return _split_to_arrays(sr)
+                def eval_child(slot, nid):
+                    sl = jnp.clip(slot, 0, L - 1)
+                    g, h, c = s1["leaf_g"][sl], s1["leaf_h"][sl], \
+                        s1["leaf_c"][sl]
+                    deep_ok = (spec.max_depth <= 0) | \
+                        (s1["leaf_depth"][sl] < spec.max_depth)
+                    sr = split_of(hist[sl], g, h, c, allowed & deep_ok,
+                                  s1["leaf_lb"][sl], s1["leaf_ub"][sl],
+                                  s1["leaf_out"][sl], nid)
+                    return _split_to_arrays(sr)
 
-            res = jax.vmap(eval_child)(child_slots, node_ids)
+                res = jax.vmap(eval_child)(child_slots, node_ids)
+                return hist, tuple(
+                    s1[k].at[child_slots].set(r, mode="drop")
+                    for k, r in zip(LEAF_KEYS, res))
+
+            def tree_full(_):
+                # capacity reached mid-wave: the children can never be
+                # split, so skip the whole histogram pass + find fan-out
+                # (one full-data pass saved on every capacity-bound tree)
+                return st["hist"], tuple(s1[k] for k in LEAF_KEYS)
+
+            hist, leaf_upd = jax.lax.cond(s1["step"] >= L - 1, tree_full,
+                                          hist_and_find, None)
 
             new_state = {k: s1[k] for k in
                          ("step", "nl", "leaf_id", "nodes", "leaf_g",
                           "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
                           "leaf_out", "leaf_depth")}
             new_state["hist"] = hist
-            for k, r in zip(LEAF_KEYS, res):
-                new_state[k] = s1[k].at[child_slots].set(r, mode="drop")
+            for k, v in zip(LEAF_KEYS, leaf_upd):
+                new_state[k] = v
             return new_state
 
         st = jax.lax.while_loop(cond, body, state)

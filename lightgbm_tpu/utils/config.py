@@ -197,6 +197,13 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # SHAPE may differ from strict on skewed data, accuracy matches to
     # within noise — see tests/test_wave.py)
     "tree_grow_policy": ("leafwise", "str", ("grow_policy",)),
+    # wave policy tuning (ops/grow_wave.py): leaves per batched histogram
+    # pass (0 = auto from the MXU LHS capacity / quality sweep,
+    # PROFILE.md round 3c), and the depth-bias gain ratio — a ready leaf
+    # only splits while its gain >= ratio x the wave's best gain
+    # (< 0 = auto)
+    "tpu_wave_width": (0, "int", ("wave_width",)),
+    "tpu_wave_gain_ratio": (-1.0, "float", ("wave_gain_ratio",)),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

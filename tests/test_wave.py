@@ -199,6 +199,28 @@ class TestWavePolicy:
         assert bst._bulk_key != key_leafwise
         assert bst.current_iteration() == 2 * bst._BULK_CHUNK
 
+    def test_wave_knobs_plumb_through(self):
+        """tpu_wave_width / tpu_wave_gain_ratio reach the grower spec;
+        ratio ~1 degenerates toward one split per wave (strict-like
+        order) and must still produce a working model."""
+        from lightgbm_tpu.booster import Booster
+        X, y = make_binary(1500)
+        bst = Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1, "tree_grow_policy": "wave",
+                              "tpu_wave_width": 2,
+                              "tpu_wave_gain_ratio": 0.99},
+                      train_set=lgb.Dataset(X, label=y))
+        assert bst._grower_spec.wave_width == 2
+        assert bst._grower_spec.wave_gain_ratio == 0.99
+        bst.update_many(4)
+        assert bst.num_trees() == 4
+        # near-1 ratio on a tiny tree: identical to strict order
+        strict = lgb.train({"objective": "binary", "num_leaves": 7,
+                            "verbosity": -1}, lgb.Dataset(X, label=y),
+                           num_boost_round=4)
+        np.testing.assert_allclose(bst.predict(X), strict.predict(X),
+                                   rtol=1e-6, atol=1e-7)
+
     def test_downgrade_reasons(self):
         X, y = make_binary(1500)
         bst = lgb.train({"objective": "binary", "num_leaves": 7,

@@ -175,6 +175,75 @@ def _run_kernel_multi(bins_fm: Array, pw0: Array, leaf_id: Array,
     return out[:f]
 
 
+def _hist_kernel_multi_i8(bins_ref, pw_ref, lid_ref, slots_ref, out_ref, *,
+                          mb: int):
+    """int8 variant of `_hist_kernel_multi` for the quantized lattice:
+    int8 x int8 -> int32 MXU dots run at 2x the bf16 rate (v5e: 394 vs
+    197 TOPS), and the lattice values (|gq|, hq <= num_grad_quant_bins
+    <= 15, w in {0,1}) are exact in int8.  Measured 8.4 ms vs ~15 ms per
+    1M x 28 x 256 pass.  int32 accumulation is exact up to ~134M rows
+    per shard (2^31 / 16)."""
+    r = pl.program_id(1)
+
+    @pl.when(r == 0)
+    def _init():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    f_t, n_t = bins_ref.shape
+    pw = pw_ref[:]                                   # [3, N_t] int8
+    lid = lid_ref[0, :]                              # [N_t] i32
+    s_n = slots_ref.shape[1]
+    lhs = jnp.concatenate(
+        [jnp.where((lid == slots_ref[0, s])[None, :], pw, 0)
+         .astype(jnp.int8) for s in range(s_n)], axis=0)
+    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, mb), 1)
+    for f in range(f_t):                             # static unroll
+        b = bins_ref[f, :].astype(jnp.int32)
+        onehot = (b[:, None] == bin_ids).astype(jnp.int8)
+        out_ref[f] += jax.lax.dot_general(
+            lhs, onehot, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+
+def _run_kernel_multi_i8(bins_fm: Array, pw0: Array, leaf_id: Array,
+                         slots: Array, max_bin: int, row_tile: int,
+                         feat_tile: int, interpret: bool) -> Array:
+    """int8 driver: [F, N] bins x [3, N] int8 lattice x [N] leaf ids x
+    [S] slots -> [F, S*3, MB] int32."""
+    f, n = bins_fm.shape
+    r0 = pw0.shape[0]
+    s_n = slots.shape[0]
+    n_pad = (-n) % row_tile
+    if n_pad:
+        pw0 = jnp.pad(pw0, ((0, 0), (0, n_pad)))
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, n_pad)))
+        leaf_id = jnp.pad(leaf_id, (0, n_pad), constant_values=-1)
+    if feat_tile <= 0 or feat_tile > f:
+        feat_tile = f
+    f_pad = (-f) % feat_tile
+    if f_pad:
+        bins_fm = jnp.pad(bins_fm, ((0, f_pad), (0, 0)))
+    n_rt = (n + n_pad) // row_tile
+    n_ft = (f + f_pad) // feat_tile
+
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel_multi_i8, mb=max_bin),
+        grid=(n_ft, n_rt),
+        in_specs=[
+            pl.BlockSpec((feat_tile, row_tile), lambda j, r: (j, r)),
+            pl.BlockSpec((r0, row_tile), lambda j, r: (0, r)),
+            pl.BlockSpec((1, row_tile), lambda j, r: (0, r)),
+            pl.BlockSpec((1, s_n), lambda j, r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((feat_tile, s_n * r0, max_bin),
+                               lambda j, r: (j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((f + f_pad, s_n * r0, max_bin),
+                                       jnp.int32),
+        interpret=interpret,
+    )(bins_fm, pw0, leaf_id.astype(jnp.int32)[None, :], slots[None, :])
+    return out[:f]
+
+
 def _run_kernel(bins_fm: Array, pw: Array, max_bin: int, row_tile: int,
                 feat_tile: int, interpret: bool) -> Array:
     """Shared pallas_call driver: [F, N] bins x [R, N] payload rows (f32
@@ -312,18 +381,20 @@ def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
     Returns: [S, F, MB, 3] f32.
     """
     S = slots.shape[0]
-    gq = jnp.round(payload[:, 0] / s_g)
-    hq = jnp.round(payload[:, 1] / s_h)
-    w = jax.lax.reduce_precision(payload[:, 2], 8, 7)    # {0,1} — exact
-    pw3 = jnp.stack([gq, hq, w])                         # [3, N]
+    # int8 lattice rows: |gq|, hq <= num_grad_quant_bins (booster-gated
+    # <= 15), w in {0, 1} — exact in int8, 2x MXU rate vs bf16
+    gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int8)
+    hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int8)
+    w = (payload[:, 2] != 0).astype(jnp.int8)
+    pw3 = jnp.stack([gq, hq, w])                         # [3, N] int8
     outs = []
     for c0 in range(0, S, MULTI_CHUNK_Q):
         c1 = min(S, c0 + MULTI_CHUNK_Q)
-        out = _run_kernel_multi(bins_fm, pw3, leaf_id, slots[c0:c1],
-                                max_bin, row_tile, feat_tile,
-                                interpret)           # [F, (c1-c0)*3, MB]
+        out = _run_kernel_multi_i8(bins_fm, pw3, leaf_id, slots[c0:c1],
+                                   max_bin, row_tile, feat_tile,
+                                   interpret)        # [F, (c1-c0)*3, MB]
         f = out.shape[0]
-        out = out.reshape(f, c1 - c0, 3, max_bin)
+        out = out.reshape(f, c1 - c0, 3, max_bin).astype(jnp.float32)
         outs.append(out.transpose(1, 0, 3, 2))           # [c, F, MB, 3]
     out = jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
     return jnp.stack([out[..., 0] * s_g, out[..., 1] * s_h, out[..., 2]],
@@ -347,14 +418,22 @@ def pallas_histogram_quantized(bins_fm: Array, payload: Array,
     (ref: the packed 32-bit atomics of cuda_histogram_constructor.cu — one
     operation covering grad+hess; here one matmul covers all three).
     """
-    d = jnp.where(row_mask[:, None], payload, 0.0)
-    gq = jnp.round(d[:, 0] / s_g)
-    hq = jnp.round(d[:, 1] / s_h)
-    w = jax.lax.reduce_precision(d[:, 2], 8, 7)      # {0,1} — exact
-    pw = jnp.stack([gq, hq, w])   # [3, N] small ints — bf16-exact values
-    out = _run_kernel(bins_fm, pw, max_bin, row_tile, feat_tile, interpret)
-    return jnp.stack([out[:, 0] * s_g, out[:, 1] * s_h, out[:, 2]],
-                     axis=-1)                        # [F, MB, 3]
+    # single-leaf = the int8 multi driver with a mask-derived leaf id
+    # (slot 0 = in-leaf, -1 = masked out): |gq|, hq <= 15, w in {0, 1}
+    # are exact in int8 and the int8 x int8 -> int32 dot runs at 2x the
+    # bf16 MXU rate
+    gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int8)
+    hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int8)
+    w = (payload[:, 2] != 0).astype(jnp.int8)
+    pw = jnp.stack([gq, hq, w])                      # [3, N] int8
+    lid = jnp.where(row_mask, 0, -1).astype(jnp.int32)
+    out = _run_kernel_multi_i8(bins_fm, pw, lid,
+                               jnp.zeros((1,), jnp.int32), max_bin,
+                               row_tile, feat_tile, interpret)
+    out = out.reshape(out.shape[0], 3, max_bin).transpose(0, 2, 1)\
+        .astype(jnp.float32)                         # [F, MB, 3]
+    return jnp.stack([out[..., 0] * s_g, out[..., 1] * s_h, out[..., 2]],
+                     axis=-1)
 
 
 _PROBE_CACHE = {}

@@ -109,9 +109,11 @@ class GrowerSpec(NamedTuple):
     # the spec so the two growers share one cache key space)
     wave_width: int = 0
     # wave depth bias: a ready leaf only splits while its gain >= ratio x
-    # the wave's best gain; weaker leaves wait (and may never split if
-    # capacity runs out first — how the wave policy keeps the strict
-    # policy's deep-where-it-matters capacity allocation).  0 = off
+    # the wave's best gain x tree-fullness (leaves-used / num_leaves) —
+    # capacity-aware, so early waves run at full width and the late,
+    # capacity-scarce waves become selective; weaker leaves wait (and may
+    # never split if capacity runs out — how the wave policy keeps the
+    # strict policy's deep-where-it-matters allocation).  0 = off
     wave_gain_ratio: float = 0.0
     # False = every feature is numerical (static): the split finder skips
     # the categorical cases — four [F, MB] argsorts per call

@@ -107,18 +107,31 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
         else:
             decode_bins = None
 
+        # the kernel payload carrier is loop-INVARIANT: prepare it once
+        # per tree here, not inside every wave's while_loop body (XLA's
+        # loop-invariant code motion does not reliably hoist the f32
+        # 3-way split / int8 lattice conversion out of the loop)
+        if spec.hist_impl == "pallas":
+            from .pallas_hist import (_split_payload9,
+                                      pallas_histogram_multi_rows)
+            pw_prep = _split_payload9(payload)
+        elif spec.hist_impl == "pallas_q":
+            from .pallas_hist import (
+                pallas_histogram_multi_quantized_rows,
+                quantized_lattice_rows)
+            pw_prep = quantized_lattice_rows(payload, feat["qscales"][0],
+                                             feat["qscales"][1])
+
         def hist_multi(leaf_id, slots):
             """[S, F|G, HB, 3] histograms of the listed leaf slots in one
             batched sweep; pad slots (value L) yield zeros."""
             with jax.named_scope("histogram_wave"):
                 if spec.hist_impl == "pallas":
-                    from .pallas_hist import pallas_histogram_multi
-                    h = pallas_histogram_multi(bins_fm, payload, leaf_id,
-                                               slots, HB)
+                    h = pallas_histogram_multi_rows(bins_fm, pw_prep,
+                                                    leaf_id, slots, HB)
                 elif spec.hist_impl == "pallas_q":
-                    from .pallas_hist import pallas_histogram_multi_quantized
-                    h = pallas_histogram_multi_quantized(
-                        bins_fm, payload, leaf_id, slots, HB,
+                    h = pallas_histogram_multi_quantized_rows(
+                        bins_fm, pw_prep, leaf_id, slots, HB,
                         feat["qscales"][0], feat["qscales"][1])
                 elif spec.hist_impl == "packed":
                     h = leaf_histogram_packed_multi(
@@ -226,11 +239,16 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             istate["p_new"] = jnp.full((W,), L, jnp.int32)
             istate["p_step"] = jnp.zeros((W,), jnp.int32)
             # depth bias (wave_gain_ratio): the wave stops early once the
-            # best remaining ready gain falls below ratio x the wave's
-            # opening gain — weaker leaves wait for a later wave, so
-            # capacity flows to deep high-gain branches like the strict
-            # policy allocates it
+            # best remaining ready gain falls below the floor — weaker
+            # leaves wait for a later wave, so capacity flows to deep
+            # high-gain branches like the strict policy allocates it.
+            # The floor is CAPACITY-AWARE: ratio x opening gain x
+            # (leaves used / num_leaves), so early waves (capacity
+            # plentiful — splitting weak leaves costs nothing yet) run at
+            # full width and only the late, capacity-scarce waves become
+            # selective.
             istate["g_floor"] = jnp.float32(0.0)
+            fullness = st["nl"].astype(jnp.float32) / L
 
             def icond(s):
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
@@ -294,7 +312,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                     nodes=nodes, w=s["w"] + 1,
                     g_floor=jnp.where(
                         s["w"] == 0,
-                        jnp.float32(spec.wave_gain_ratio) * gain_s,
+                        jnp.float32(spec.wave_gain_ratio) * gain_s
+                        * fullness,
                         s["g_floor"]),
                     ready=s["ready"].at[best].set(False)
                     .at[new].set(False),

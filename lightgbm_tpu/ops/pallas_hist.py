@@ -351,8 +351,23 @@ def pallas_histogram_multi(bins_fm: Array, payload: Array, leaf_id: Array,
         leaf_id, canonically num_leaves) produce zero histograms.
     Returns: [S, F, MB, 3] f32.
     """
+    return pallas_histogram_multi_rows(
+        bins_fm, _split_payload9(payload), leaf_id, slots, max_bin,
+        row_tile=row_tile, feat_tile=feat_tile, interpret=interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
+                                             "feat_tile", "interpret"))
+def pallas_histogram_multi_rows(bins_fm: Array, pw9: Array, leaf_id: Array,
+                                slots: Array, max_bin: int, *,
+                                row_tile: int = ROW_TILE,
+                                feat_tile: int = 0,
+                                interpret: bool = False) -> Array:
+    """`pallas_histogram_multi` with the payload ALREADY split to [9, N]
+    carrier rows (`_split_payload9`) — the wave grower prepares the rows
+    once per tree and reuses them for every wave's call, instead of
+    re-splitting the loop-invariant payload inside the while_loop body."""
     S = slots.shape[0]
-    pw9 = _split_payload9(payload)                   # [9, N]
     outs = []
     for c0 in range(0, S, MULTI_CHUNK):
         c1 = min(S, c0 + MULTI_CHUNK)
@@ -380,13 +395,31 @@ def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
 
     Returns: [S, F, MB, 3] f32.
     """
-    S = slots.shape[0]
-    # int8 lattice rows: |gq|, hq <= num_grad_quant_bins (booster-gated
-    # <= 15), w in {0, 1} — exact in int8, 2x MXU rate vs bf16
+    return pallas_histogram_multi_quantized_rows(
+        bins_fm, quantized_lattice_rows(payload, s_g, s_h), leaf_id,
+        slots, max_bin, s_g, s_h, row_tile=row_tile, feat_tile=feat_tile,
+        interpret=interpret)
+
+
+def quantized_lattice_rows(payload: Array, s_g: Array, s_h: Array) -> Array:
+    """[N, 3] quantized payload -> [3, N] int8 lattice rows: |gq|, hq <=
+    num_grad_quant_bins (booster-gated <= 15), w in {0, 1} — exact in
+    int8, 2x MXU rate vs bf16."""
     gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int8)
     hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int8)
     w = (payload[:, 2] != 0).astype(jnp.int8)
-    pw3 = jnp.stack([gq, hq, w])                         # [3, N] int8
+    return jnp.stack([gq, hq, w])
+
+
+@functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
+                                             "feat_tile", "interpret"))
+def pallas_histogram_multi_quantized_rows(
+        bins_fm: Array, pw3: Array, leaf_id: Array, slots: Array,
+        max_bin: int, s_g: Array, s_h: Array, *, row_tile: int = ROW_TILE,
+        feat_tile: int = 0, interpret: bool = False) -> Array:
+    """Quantized multi with the int8 lattice ALREADY prepared
+    (`quantized_lattice_rows`) — per-tree prep, per-wave calls."""
+    S = slots.shape[0]
     outs = []
     for c0 in range(0, S, MULTI_CHUNK_Q):
         c1 = min(S, c0 + MULTI_CHUNK_Q)

@@ -200,9 +200,11 @@ class TestWavePolicy:
         assert bst.current_iteration() == 2 * bst._BULK_CHUNK
 
     def test_wave_knobs_plumb_through(self):
-        """tpu_wave_width / tpu_wave_gain_ratio reach the grower spec;
-        ratio ~1 degenerates toward one split per wave (strict-like
-        order) and must still produce a working model."""
+        """tpu_wave_width / tpu_wave_gain_ratio reach the grower spec and
+        produce a working model.  The gain floor is capacity-aware
+        (ratio x opening gain x tree-fullness), so even ratio ~1 only
+        bites in the late, capacity-scarce waves — early waves still run
+        at full width."""
         from lightgbm_tpu.booster import Booster
         X, y = make_binary(1500)
         bst = Booster(params={"objective": "binary", "num_leaves": 7,
@@ -214,12 +216,9 @@ class TestWavePolicy:
         assert bst._grower_spec.wave_gain_ratio == 0.99
         bst.update_many(4)
         assert bst.num_trees() == 4
-        # near-1 ratio on a tiny tree: identical to strict order
-        strict = lgb.train({"objective": "binary", "num_leaves": 7,
-                            "verbosity": -1}, lgb.Dataset(X, label=y),
-                           num_boost_round=4)
-        np.testing.assert_allclose(bst.predict(X), strict.predict(X),
-                                   rtol=1e-6, atol=1e-7)
+        from lightgbm_tpu.metrics import _auc
+        assert float(_auc(bst.predict(X, raw_score=True), y,
+                          None, None)) > 0.75
 
     def test_downgrade_reasons(self):
         X, y = make_binary(1500)

@@ -12,6 +12,41 @@ from __future__ import annotations
 import re
 
 
+STASH_PREFIX = "TPU_STASHED_"
+_STASH_KEYS = ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+
+
+def stash_entries(base_env: dict) -> dict:
+    """Stash vars recording `base_env`'s ORIGINAL TPU-backend settings.
+    Merged into a cleaned-CPU environment before a re-exec (conftest), so
+    a forced-CPU process can still hand a REAL-chip environment to a
+    subprocess later (`restored_tpu_env` — the backend-parity test)."""
+    out = {}
+    for k in _STASH_KEYS:
+        out[STASH_PREFIX + "HAVE_" + k] = "1" if k in base_env else "0"
+        if k in base_env:
+            out[STASH_PREFIX + k] = base_env[k]
+    return out
+
+
+def restored_tpu_env(base_env: dict):
+    """Invert `stash_entries`: an environment whose TPU-backend vars are
+    back to their pre-re-exec values, for a subprocess that should see
+    the real chip.  None when no stash is present (the current env was
+    never cleaned — use it as-is)."""
+    if STASH_PREFIX + "HAVE_PALLAS_AXON_POOL_IPS" not in base_env:
+        return None
+    env = dict(base_env)
+    for k in _STASH_KEYS:
+        have = env.pop(STASH_PREFIX + "HAVE_" + k, "0") == "1"
+        val = env.pop(STASH_PREFIX + k, None)
+        if have and val is not None:
+            env[k] = val
+        else:
+            env.pop(k, None)
+    return env
+
+
 def cleaned_cpu_env(base_env: dict, n_devices: int = 8) -> dict:
     """A copy of `base_env` for a subprocess that must run on a pure CPU
     backend with exactly `n_devices` virtual devices."""

@@ -33,9 +33,15 @@ if os.environ.get("PALLAS_AXON_POOL_IPS"):
             capman.suspend_global_capture(in_=True)
         sys.stdout.flush()
         sys.stderr.flush()
+        # stash the original TPU-backend vars so the re-exec'd (pure-CPU)
+        # process can still hand a real-chip env to a SUBPROCESS — the
+        # backend-parity test restores them via restored_tpu_env()
+        from lightgbm_tpu.utils.env import stash_entries
+        env = _cleaned_env()
+        env.update(stash_entries(os.environ))
         os.execve(sys.executable,
                   [sys.executable, "-m", "pytest"] + sys.argv[1:],
-                  _cleaned_env())
+                  env)
 else:
     os.environ.update({k: _cleaned_env()[k]
                        for k in ("JAX_PLATFORMS", "XLA_FLAGS")})

@@ -32,12 +32,14 @@ rng = np.random.RandomState(5)
 X = rng.randn(1200, 8); X[rng.rand(1200, 8) < 0.05] = np.nan
 y = (np.nan_to_num(X[:, 0]) - 0.6 * np.nan_to_num(X[:, 1]) > 0)\
     .astype(float)
-bst = lgb.train({{"objective": "binary", "num_leaves": 15,
-                 "deterministic": True, "verbosity": -1}},
-                lgb.Dataset(X, label=y), num_boost_round=8)
-print("RESULT " + json.dumps({{
-    "platform": devs[0].platform,
-    "preds": bst.predict(X[:200]).tolist()}}))
+out = {{"platform": devs[0].platform}}
+for policy in ("leafwise", "wave"):
+    bst = lgb.train({{"objective": "binary", "num_leaves": 15,
+                     "deterministic": True, "verbosity": -1,
+                     "tree_grow_policy": policy}},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    out[policy] = bst.predict(X[:200]).tolist()
+print("RESULT " + json.dumps(out))
 """
 
 
@@ -54,8 +56,12 @@ def _run_job(env, timeout):
 
 
 def test_tpu_matches_cpu_when_chip_answers(tmp_path):
+    from lightgbm_tpu.utils.env import restored_tpu_env
+    # under the conftest re-exec this process runs forced-CPU; the stash
+    # carries the original TPU-backend vars for the chip subprocess
+    tpu_env = restored_tpu_env(os.environ) or dict(os.environ)
     try:
-        tpu = _run_job(dict(os.environ), timeout=420)
+        tpu = _run_job(tpu_env, timeout=420)
     except subprocess.TimeoutExpired:
         pytest.skip("TPU backend did not answer within 420s (wedged axon "
                     "tunnel — the round-2/3 failure mode); parity "
@@ -69,6 +75,10 @@ def test_tpu_matches_cpu_when_chip_answers(tmp_path):
     from lightgbm_tpu.utils.env import cleaned_cpu_env
     cpu = _run_job(cleaned_cpu_env(os.environ, 1), timeout=420)
     assert cpu["platform"] == "cpu"
-    np.testing.assert_allclose(np.asarray(tpu["preds"]),
-                               np.asarray(cpu["preds"]),
-                               rtol=2e-4, atol=2e-5)
+    # both policies: strict (single-leaf kernels) AND wave (multi-leaf
+    # kernels + identical wave widths across backends)
+    for policy in ("leafwise", "wave"):
+        np.testing.assert_allclose(np.asarray(tpu[policy]),
+                                   np.asarray(cpu[policy]),
+                                   rtol=2e-4, atol=2e-5,
+                                   err_msg=f"policy={policy}")

@@ -356,6 +356,7 @@ class Booster:
             monotone_intermediate=interm,
             wave_width=self._wave_width(),
             wave_gain_ratio=self._wave_gain_ratio(),
+            wave_overgrow=self._wave_overgrow(),
             has_cat=bool(np.asarray(self._dd.is_cat).any()),
         )
         self._grow_policy = self._resolve_grow_policy()
@@ -535,13 +536,16 @@ class Booster:
         slots = max(2, slots)
         return slots if slots < self.config.num_leaves else 0
 
-    # default wave knobs from the quality/perf sweep (PROFILE.md round
-    # 3c): moderate waves keep the strict policy's deep-where-it-matters
-    # capacity allocation while still batching histogram passes (W=6:
-    # 4x strict rounds/s at ~0.004 held-out AUC of strict on the Higgs
-    # shape; W=14 was 0.016 worse — capacity leaked to breadth)
+    # default wave knobs from the quality/perf sweeps (PROFILE.md round
+    # 3c): moderate waves (W=6) keep accuracy (W=14 leaked ~0.016 AUC of
+    # capacity into breadth).  Overgrow-prune defaults OFF: measured, it
+    # does not beat the capacity-aware gain floor on depth-hungry data —
+    # wave depth (~log2 of the grown size), not leaf capacity, is what
+    # binds (PROFILE.md "grow-then-prune" note) — but it remains an
+    # opt-in knob for breadth-friendly data.
     WAVE_WIDTH_DEFAULT = 6
     WAVE_GAIN_RATIO_DEFAULT = 0.0
+    WAVE_OVERGROW_DEFAULT = 0.0
 
     def _wave_width(self) -> int:
         """Leaves per batched histogram pass for the wave policy.
@@ -556,12 +560,37 @@ class Booster:
             else MULTI_CHUNK
         w = int(self.config.tpu_wave_width or 0)
         if w <= 0:
-            w = self.WAVE_WIDTH_DEFAULT
+            w = MULTI_CHUNK if self._wave_overgrow() > 1.0 \
+                else self.WAVE_WIDTH_DEFAULT
         return min(w, cap)
 
     def _wave_gain_ratio(self) -> float:
         r = float(self.config.tpu_wave_gain_ratio)
         return self.WAVE_GAIN_RATIO_DEFAULT if r < 0.0 else min(r, 1.0)
+
+    def _wave_overgrow(self) -> float:
+        """Grow-then-prune factor (0 = off).  Auto-resolves to the sweep
+        default for the wave policy; gated off under monotone
+        constraints / path smoothing, where a pruned parent's restored
+        output would ignore the clamp/smoothing chain."""
+        pol = str(self.config.tree_grow_policy or "leafwise").lower()
+        if pol not in ("wave", "batched"):
+            return 0.0
+        r = float(self.config.tpu_wave_overgrow)
+        val = self.WAVE_OVERGROW_DEFAULT if r < 0.0 else r
+        if val <= 1.0:
+            return 0.0
+        mono = list(self.config.monotone_constraints or [])
+        if (mono and any(mono)) or self.config.path_smooth > 0.0:
+            if not getattr(self, "_warned_overgrow", False):
+                self._warned_overgrow = True
+                log.warning(
+                    "tpu_wave_overgrow is not supported with monotone "
+                    "constraints or path smoothing (pruned parents "
+                    "restore un-clamped outputs) — growing without "
+                    "overgrow")
+            return 0.0
+        return val
 
     def _learner_topology(self):
         """ONE resolver for the learner kind + mesh shape — consumed by
@@ -625,8 +654,9 @@ class Booster:
             # shape (root pass padded to the wave width) — gate on a
             # probe of THAT shape (the single-leaf probe gating
             # hist_impl says nothing about the multi blocks)
+            from .ops.grow_wave import wave_sizes
             from .ops.pallas_hist import probe_cached
-            w = max(1, min(spec.wave_width or 14, spec.num_leaves - 1))
+            _, w = wave_sizes(spec)
             if not probe_cached(self._dd.max_bin, self._dd.num_feature,
                                 multi=True, width=w,
                                 quantized=spec.hist_impl == "pallas_q"):
@@ -2238,7 +2268,8 @@ class Booster:
         self._grower_spec = self._grower_spec._replace(
             packed_const_hess_level=self._packed_const_hess_level(),
             wave_width=self._wave_width(),
-            wave_gain_ratio=self._wave_gain_ratio())
+            wave_gain_ratio=self._wave_gain_ratio(),
+            wave_overgrow=self._wave_overgrow())
         self._grow_policy = self._resolve_grow_policy()
         self._grower = self._make_serial_grower()
         self._build_feat()

@@ -115,6 +115,12 @@ class GrowerSpec(NamedTuple):
     # never split if capacity runs out — how the wave policy keeps the
     # strict policy's deep-where-it-matters allocation).  0 = off
     wave_gain_ratio: float = 0.0
+    # wave grow-then-prune (classic CART wisdom applied to the batched
+    # order): grow to ceil(overgrow x num_leaves) leaves wave-style, then
+    # prune the lowest-gain leaf-parent splits back to num_leaves — the
+    # final tree recovers (and in measurements beats) the strict policy's
+    # capacity allocation at wave throughput.  <= 1 = off
+    wave_overgrow: float = 0.0
     # False = every feature is numerical (static): the split finder skips
     # the categorical cases — four [F, MB] argsorts per call
     has_cat: bool = True

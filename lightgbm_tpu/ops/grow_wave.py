@@ -38,6 +38,7 @@ histogram pool keep the strict grower.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Dict
 
 import jax
@@ -54,6 +55,17 @@ Array = jax.Array
 INF = jnp.inf
 
 
+def wave_sizes(spec: GrowerSpec):
+    """(LB, W): internal grow size (overgrow x num_leaves, pruned back
+    after growth) and wave width.  ONE definition shared with the
+    booster's probe gate so the probed kernel width always matches the
+    width the grower runs."""
+    L = spec.num_leaves
+    LB = L if spec.wave_overgrow <= 1.0 else \
+        max(L, int(math.ceil(spec.wave_overgrow * L)))
+    return LB, max(1, min(spec.wave_width or 14, LB - 1))
+
+
 @functools.lru_cache(maxsize=64)
 def make_wave_grower(spec: GrowerSpec, axis_name=None):
     """Build (and cache) the jitted wave grower for a static spec.
@@ -66,7 +78,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
     per-shard rescaling (unlike the voting learner's local vote)."""
     L = spec.num_leaves
     MB = spec.max_bin
-    W = max(1, min(spec.wave_width or 14, L - 1))
+    # grow-then-prune: grow to LB leaves, prune back to L (off: LB == L)
+    LB, W = wave_sizes(spec)
     find = functools.partial(
         find_best_split,
         l1=spec.lambda_l1, l2=spec.lambda_l2,
@@ -124,7 +137,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
 
         def hist_multi(leaf_id, slots):
             """[S, F|G, HB, 3] histograms of the listed leaf slots in one
-            batched sweep; pad slots (value L) yield zeros."""
+            batched sweep; pad slots (value LB) yield zeros."""
             with jax.named_scope("histogram_wave"):
                 if spec.hist_impl == "pallas":
                     h = pallas_histogram_multi_rows(bins_fm, pw_prep,
@@ -160,11 +173,11 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
 
         # ---- root ----
         # the root pass uses the SAME [W]-slot call shape as every wave
-        # (pad slots L match nothing), so exactly ONE multi-kernel block
+        # (pad slots LB match nothing), so exactly ONE multi-kernel block
         # shape is ever compiled/run per spec — the shape the booster's
         # probe gate checks
         leaf_id0 = jnp.zeros((N,), jnp.int32)
-        root_slots = jnp.full((W,), L, jnp.int32).at[0].set(0)
+        root_slots = jnp.full((W,), LB, jnp.int32).at[0].set(0)
         hist0 = hist_multi(leaf_id0, root_slots)[0]
         root_g = payload[:, 0].sum()
         root_h = payload[:, 1].sum()
@@ -177,24 +190,24 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
         s0 = split_of(hist0, root_g, root_h, root_c, allowed,
                       jnp.float32(-INF), jnp.float32(INF), root_out, 0)
 
-        hist = jnp.zeros((L,) + hist0.shape, dtype=jnp.float32)\
+        hist = jnp.zeros((LB,) + hist0.shape, dtype=jnp.float32)\
             .at[0].set(hist0)
-        leaf_best = [jnp.zeros((L,) + a.shape, dtype=a.dtype)
+        leaf_best = [jnp.zeros((LB,) + a.shape, dtype=a.dtype)
                      .at[0].set(a) for a in _split_to_arrays(s0)]
-        leaf_best[0] = jnp.full((L,), NEG_INF, dtype=jnp.float32).at[0]\
+        leaf_best[0] = jnp.full((LB,), NEG_INF, dtype=jnp.float32).at[0]\
             .set(s0.gain)
 
         nodes = dict(
-            split_leaf=jnp.zeros((L - 1,), jnp.int32),
-            split_feature=jnp.zeros((L - 1,), jnp.int32),
-            threshold_bin=jnp.zeros((L - 1,), jnp.int32),
-            default_left=jnp.zeros((L - 1,), bool),
-            split_is_cat=jnp.zeros((L - 1,), bool),
-            split_cat_mask=jnp.zeros((L - 1, MB), bool),
-            split_gain=jnp.zeros((L - 1,), jnp.float32),
-            internal_g=jnp.zeros((L - 1,), jnp.float32),
-            internal_h=jnp.zeros((L - 1,), jnp.float32),
-            internal_cnt=jnp.zeros((L - 1,), jnp.float32),
+            split_leaf=jnp.zeros((LB - 1,), jnp.int32),
+            split_feature=jnp.zeros((LB - 1,), jnp.int32),
+            threshold_bin=jnp.zeros((LB - 1,), jnp.int32),
+            default_left=jnp.zeros((LB - 1,), bool),
+            split_is_cat=jnp.zeros((LB - 1,), bool),
+            split_cat_mask=jnp.zeros((LB - 1, MB), bool),
+            split_gain=jnp.zeros((LB - 1,), jnp.float32),
+            internal_g=jnp.zeros((LB - 1,), jnp.float32),
+            internal_h=jnp.zeros((LB - 1,), jnp.float32),
+            internal_cnt=jnp.zeros((LB - 1,), jnp.float32),
         )
 
         state = dict(
@@ -206,13 +219,13 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             leaf_lc=leaf_best[6], leaf_rg=leaf_best[7],
             leaf_rh=leaf_best[8], leaf_rc=leaf_best[9],
             leaf_iscat=leaf_best[10], leaf_catmask=leaf_best[11],
-            leaf_g=jnp.zeros((L,), jnp.float32).at[0].set(root_g),
-            leaf_h=jnp.zeros((L,), jnp.float32).at[0].set(root_h),
-            leaf_c=jnp.zeros((L,), jnp.float32).at[0].set(root_c),
-            leaf_lb=jnp.full((L,), -INF, jnp.float32),
-            leaf_ub=jnp.full((L,), INF, jnp.float32),
-            leaf_out=jnp.zeros((L,), jnp.float32).at[0].set(root_out),
-            leaf_depth=jnp.zeros((L,), jnp.int32),
+            leaf_g=jnp.zeros((LB,), jnp.float32).at[0].set(root_g),
+            leaf_h=jnp.zeros((LB,), jnp.float32).at[0].set(root_h),
+            leaf_c=jnp.zeros((LB,), jnp.float32).at[0].set(root_c),
+            leaf_lb=jnp.full((LB,), -INF, jnp.float32),
+            leaf_ub=jnp.full((LB,), INF, jnp.float32),
+            leaf_out=jnp.zeros((LB,), jnp.float32).at[0].set(root_out),
+            leaf_depth=jnp.zeros((LB,), jnp.int32),
             nodes=nodes,
         )
 
@@ -221,7 +234,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                      "leaf_rc", "leaf_iscat", "leaf_catmask")
 
         def cond(st):
-            return (st["step"] < L - 1) & (jnp.max(st["leaf_gain"]) > 0.0)
+            return (st["step"] < LB - 1) & (jnp.max(st["leaf_gain"]) > 0.0)
 
         def body(st):
             # ---- split phase: best-first among READY leaves (leaves
@@ -231,12 +244,12 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                       ("step", "nl", "leaf_id", "nodes", "leaf_g",
                        "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
                        "leaf_out", "leaf_depth") + LEAF_KEYS}
-            istate["ready"] = jnp.arange(L) < st["nl"]
+            istate["ready"] = jnp.arange(LB) < st["nl"]
             istate["w"] = jnp.int32(0)
-            # per-wave pair records; pad slot L drops out of every scatter
-            istate["p_small"] = jnp.full((W,), L, jnp.int32)
-            istate["p_left"] = jnp.full((W,), L, jnp.int32)
-            istate["p_new"] = jnp.full((W,), L, jnp.int32)
+            # per-wave pair records; pad slot LB drops out of every scatter
+            istate["p_small"] = jnp.full((W,), LB, jnp.int32)
+            istate["p_left"] = jnp.full((W,), LB, jnp.int32)
+            istate["p_new"] = jnp.full((W,), LB, jnp.int32)
             istate["p_step"] = jnp.zeros((W,), jnp.int32)
             # depth bias (wave_gain_ratio): the wave stops early once the
             # best remaining ready gain falls below the floor — weaker
@@ -248,11 +261,11 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             # full width and only the late, capacity-scarce waves become
             # selective.
             istate["g_floor"] = jnp.float32(0.0)
-            fullness = st["nl"].astype(jnp.float32) / L
+            fullness = st["nl"].astype(jnp.float32) / LB
 
             def icond(s):
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
-                return (s["w"] < W) & (s["step"] < L - 1) & \
+                return (s["w"] < W) & (s["step"] < LB - 1) & \
                     (jnp.max(rg) > jnp.maximum(s["g_floor"], 0.0))
 
             def ibody(s):
@@ -339,7 +352,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                 # children; larger children by subtraction (the parent
                 # histogram still lives in the left child's slot) ----
                 small_h = hist_multi(s1["leaf_id"], s1["p_small"])
-                parents = st["hist"][jnp.clip(s1["p_left"], 0, L - 1)]
+                parents = st["hist"][jnp.clip(s1["p_left"], 0, LB - 1)]
                 large_h = parents - small_h
                 p_large = jnp.where(s1["p_small"] == s1["p_left"],
                                     s1["p_new"], s1["p_left"])
@@ -353,7 +366,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                                             2 * s1["p_step"] + 2])
 
                 def eval_child(slot, nid):
-                    sl = jnp.clip(slot, 0, L - 1)
+                    sl = jnp.clip(slot, 0, LB - 1)
                     g, h, c = s1["leaf_g"][sl], s1["leaf_h"][sl], \
                         s1["leaf_c"][sl]
                     deep_ok = (spec.max_depth <= 0) | \
@@ -374,7 +387,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                 # (one full-data pass saved on every capacity-bound tree)
                 return st["hist"], tuple(s1[k] for k in LEAF_KEYS)
 
-            hist, leaf_upd = jax.lax.cond(s1["step"] >= L - 1, tree_full,
+            hist, leaf_upd = jax.lax.cond(s1["step"] >= LB - 1, tree_full,
                                           hist_and_find, None)
 
             new_state = {k: s1[k] for k in
@@ -387,6 +400,21 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             return new_state
 
         st = jax.lax.while_loop(cond, body, state)
+
+        if LB > L:
+            nodes_f, leaves_f, leaf_id_f, n_splits = _prune_tail(st)
+            nl_f = n_splits + 1
+            slot = jnp.arange(L)
+            active = slot < nl_f
+            values = jnp.where(active & (nl_f > 1), leaves_f["out"], 0.0)
+            return DeviceTree(
+                n_splits=n_splits,
+                leaf_value=values,
+                leaf_g=leaves_f["g"], leaf_h=leaves_f["h"],
+                leaf_cnt=leaves_f["c"],
+                leaf_id=leaf_id_f,
+                **nodes_f,
+            )
 
         n_splits = st["step"]
         slot = jnp.arange(L)
@@ -410,5 +438,102 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             leaf_cnt=st["leaf_c"],
             leaf_id=st["leaf_id"],
         )
+
+    def _prune_tail(st):
+        """Prune the LB-leaf wave tree back to L leaves (classic
+        grow-then-prune): iteratively remove the lowest-gain split whose
+        both children are leaves, restore each pruned parent's leaf
+        stats/output from its recorded node sums, then compact the split
+        log to [L-1] — preserving the DeviceTree encoding invariant
+        (right child of split k = leaf slot k+1) by renumbering slots.
+
+        Only reachable with monotone constraints and path smoothing OFF
+        (the booster gates `wave_overgrow`): a restored parent's output
+        is the plain closed form of its (g, h) sums.
+        """
+        nd = st["nodes"]
+        n = st["step"]
+        idx = jnp.arange(LB - 1)
+        sl = nd["split_leaf"]
+        target = jnp.minimum(n, L - 1)
+
+        def pcond(ps):
+            return ps["n_alive"] > target
+
+        def pbody(ps):
+            alive = ps["alive"]
+            # split i's children are both leaves iff no LATER alive
+            # split targets its left slot (sl[i]) or right slot (i+1)
+            later = alive[None, :] & (idx[None, :] > idx[:, None])
+            hit = (sl[None, :] == sl[:, None]) \
+                | (sl[None, :] == idx[:, None] + 1)
+            removable = alive & ~jnp.any(later & hit, axis=1)
+            cand = jnp.where(removable, nd["split_gain"], jnp.inf)
+            r = jnp.argmin(cand).astype(jnp.int32)
+            b = sl[r]
+            # the parent becomes a leaf again — restore from node sums
+            return dict(
+                alive=alive.at[r].set(False),
+                n_alive=ps["n_alive"] - 1,
+                leaf_out=ps["leaf_out"].at[b].set(
+                    clamp_output(nd["internal_g"][r],
+                                 nd["internal_h"][r])),
+                leaf_g=ps["leaf_g"].at[b].set(nd["internal_g"][r]),
+                leaf_h=ps["leaf_h"].at[b].set(nd["internal_h"][r]),
+                leaf_c=ps["leaf_c"].at[b].set(nd["internal_cnt"][r]),
+            )
+
+        ps = jax.lax.while_loop(pcond, pbody, dict(
+            alive=idx < n, n_alive=n, leaf_out=st["leaf_out"],
+            leaf_g=st["leaf_g"], leaf_h=st["leaf_h"],
+            leaf_c=st["leaf_c"]))
+        alive = ps["alive"]
+
+        # ---- compact the log: new index k <- old index old_of_new[k] ----
+        new_idx = jnp.cumsum(alive.astype(jnp.int32)) - 1         # [LB-1]
+        old_of_new = jnp.zeros((L - 1,), jnp.int32)\
+            .at[jnp.where(alive, new_idx, L)].set(idx, mode="drop")
+        # big slot s survives iff s == 0 or its creator split is alive;
+        # otherwise its rows belong to the nearest surviving ancestor
+        slot_alive = jnp.concatenate([jnp.ones((1,), bool), alive])
+        parent_slot = jnp.concatenate([jnp.zeros((1,), jnp.int32), sl])
+
+        def resolve(_, t):
+            return jnp.where(slot_alive[t], t, parent_slot[t])
+
+        anc = jax.lax.fori_loop(0, LB, resolve,
+                                jnp.arange(LB, dtype=jnp.int32))   # [LB]
+        new_slot = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), new_idx + 1])[anc]        # [LB]
+
+        def g(a):
+            return a[old_of_new]
+
+        n_splits = target
+        valid = jnp.arange(L - 1) < n_splits
+        nodes_f = dict(
+            split_leaf=jnp.where(valid, new_slot[g(sl)], 0),
+            split_feature=jnp.where(valid, g(nd["split_feature"]), 0),
+            threshold_bin=jnp.where(valid, g(nd["threshold_bin"]), 0),
+            default_left=jnp.where(valid, g(nd["default_left"]), False),
+            split_is_cat=jnp.where(valid, g(nd["split_is_cat"]), False),
+            split_cat_mask=jnp.where(valid[:, None],
+                                     g(nd["split_cat_mask"]), False),
+            split_gain=jnp.where(valid, g(nd["split_gain"]), 0.0),
+            internal_g=jnp.where(valid, g(nd["internal_g"]), 0.0),
+            internal_h=jnp.where(valid, g(nd["internal_h"]), 0.0),
+            internal_cnt=jnp.where(valid, g(nd["internal_cnt"]), 0.0),
+        )
+        # final leaf slot k: big slot 0 for k = 0, else the right child
+        # of the kept split with new index k-1
+        big_of = jnp.zeros((L,), jnp.int32)\
+            .at[jnp.where(alive, new_idx + 1, L)].set(idx + 1,
+                                                      mode="drop")
+        leaves_f = dict(out=ps["leaf_out"][big_of],
+                        g=ps["leaf_g"][big_of],
+                        h=ps["leaf_h"][big_of],
+                        c=ps["leaf_c"][big_of])
+        leaf_id_f = new_slot[st["leaf_id"]]
+        return nodes_f, leaves_f, leaf_id_f, n_splits
 
     return jax.jit(grow)

@@ -204,6 +204,12 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # (< 0 = auto)
     "tpu_wave_width": (0, "int", ("wave_width",)),
     "tpu_wave_gain_ratio": (-1.0, "float", ("wave_gain_ratio",)),
+    # grow-then-prune: grow to overgrow x num_leaves leaves wave-style,
+    # then prune lowest-gain leaf-parent splits back to num_leaves.
+    # Opt-in (helps breadth-friendly data; on depth-hungry data the
+    # capacity-aware gain floor measured better — PROFILE.md).  < 0 =
+    # auto (currently off), <= 1 disables
+    "tpu_wave_overgrow": (-1.0, "float", ("wave_overgrow",)),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -81,21 +81,85 @@ class TestMultiHistogram:
 @pytest.mark.quick
 class TestWavePolicy:
     def test_small_tree_exact_match(self):
-        """For num_leaves <= 3 wave order IS strict order — trees must be
-        byte-identical (only the params dump in the model text differs)."""
+        """For num_leaves <= 3 (and overgrow off) wave order IS strict
+        order — trees must be byte-identical (only the params dump in
+        the model text differs)."""
         X, y = make_binary(2000)
         dumps = {}
         for pol in ("leafwise", "wave"):
             bst = lgb.train({"objective": "binary", "num_leaves": 3,
-                             "verbosity": -1, "tree_grow_policy": pol},
+                             "verbosity": -1, "tree_grow_policy": pol,
+                             "tpu_wave_overgrow": 0},
                             lgb.Dataset(X, label=y), num_boost_round=8)
             txt = bst.model_to_string()
             body = "\n".join(ln for ln in txt.splitlines()
-                             if not ln.startswith("[tree_grow_policy"))
+                             if not ln.startswith("[tree_grow_policy")
+                             and not ln.startswith("[tpu_wave_overgrow"))
             dumps[pol] = (body, bst.predict(X))
         assert dumps["leafwise"][0] == dumps["wave"][0]
         np.testing.assert_array_equal(dumps["leafwise"][1],
                                       dumps["wave"][1])
+
+    def test_overgrow_prune_invariants(self):
+        """Grow-then-prune (default for the wave policy): the emitted
+        tree must have <= num_leaves leaves, its split log must replay to
+        EXACTLY the returned row→leaf assignment (validates the
+        compaction/renumbering), and the model text must round-trip."""
+        import jax.numpy as jnp
+        from lightgbm_tpu.booster import Booster
+        from lightgbm_tpu.ops.predict import replay_leaf_ids
+        X, y = make_binary(2500)
+        bst = Booster(params={"objective": "binary", "num_leaves": 9,
+                              "verbosity": -1,
+                              "tree_grow_policy": "wave",
+                              "tpu_wave_overgrow": 2.0},
+                      train_set=lgb.Dataset(X, label=y))
+        assert bst._grower_spec.wave_overgrow > 1.0
+        g, h = bst._grad_fn(bst._train_score)
+        dev = bst._grower(bst._train_bins, g.astype(jnp.float32),
+                          h.astype(jnp.float32), bst._ones, bst._feat,
+                          jnp.asarray(bst._dd.base_allowed))
+        n_splits = int(dev.n_splits)
+        assert 0 < n_splits <= 8
+        replayed = replay_leaf_ids(dev, bst._train_bins,
+                                   bst._feat["nb"], bst._feat["missing"])
+        np.testing.assert_array_equal(np.asarray(replayed),
+                                      np.asarray(dev.leaf_id))
+        # through the public API: train, leaf counts, roundtrip
+        bst2 = lgb.train({"objective": "binary", "num_leaves": 9,
+                          "verbosity": -1, "tree_grow_policy": "wave",
+                          "tpu_wave_overgrow": 2.0},
+                         lgb.Dataset(X, label=y), num_boost_round=6)
+        d = bst2.dump_model()
+        for t in d["tree_info"]:
+            assert t["num_leaves"] <= 9
+        rt = lgb.Booster(model_str=bst2.model_to_string())
+        np.testing.assert_array_equal(bst2.predict(X), rt.predict(X))
+
+    def test_overgrow_quality(self):
+        """Overgrow-prune must not lose accuracy vs the plain wave."""
+        X, y = make_binary(4000)
+        Xe, ye = make_binary(2000, seed=23)
+        aucs = {}
+        for og in (0.0, 2.0):
+            bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                             "verbosity": -1, "tree_grow_policy": "wave",
+                             "tpu_wave_overgrow": og},
+                            lgb.Dataset(X, label=y), num_boost_round=25)
+            aucs[og] = auc_of(bst, Xe, ye)
+        assert aucs[2.0] > aucs[0.0] - 0.005, aucs
+
+    def test_overgrow_monotone_downgrade(self):
+        from lightgbm_tpu.booster import Booster
+        X, y = make_binary(1200)
+        bst = Booster(params={"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1, "tree_grow_policy": "wave",
+                              "tpu_wave_overgrow": 2.0,
+                              "monotone_constraints": [1, 0, 0, 0, 0, 0,
+                                                       0, 0]},
+                      train_set=lgb.Dataset(X, label=y))
+        assert bst._grower_spec.wave_overgrow == 0.0
+        assert bst._grow_policy == "wave"
 
     def test_accuracy_parity_with_strict(self):
         X, y = make_binary(4000)

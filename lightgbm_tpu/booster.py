@@ -528,9 +528,7 @@ class Booster:
         pool_mb = self.config.histogram_pool_size
         if pool_mb is None or pool_mb <= 0:
             return 0
-        efb = self._dd.efb
-        cols = efb.n_cols if efb is not None else self._dd.num_feature
-        bins = efb.max_bin if efb is not None else self._dd.max_bin
+        bins, cols = self._probe_shape()
         slot_bytes = max(cols * bins * 3 * 4, 1)
         slots = int(pool_mb * 2 ** 20 // slot_bytes)
         slots = max(2, slots)
@@ -560,7 +558,9 @@ class Booster:
             else MULTI_CHUNK
         w = int(self.config.tpu_wave_width or 0)
         if w <= 0:
-            w = MULTI_CHUNK if self._wave_overgrow() > 1.0 \
+            # overgrow mode wants the widest batch the family's kernel
+            # chunk supports; plain waves keep the accuracy-sweep width
+            w = cap if self._wave_overgrow() > 1.0 \
                 else self.WAVE_WIDTH_DEFAULT
         return min(w, cap)
 
@@ -657,8 +657,8 @@ class Booster:
             from .ops.grow_wave import wave_sizes
             from .ops.pallas_hist import probe_cached
             _, w = wave_sizes(spec)
-            if not probe_cached(self._dd.max_bin, self._dd.num_feature,
-                                multi=True, width=w,
+            pb, pc = self._probe_shape()
+            if not probe_cached(pb, pc, multi=True, width=w,
                                 quantized=spec.hist_impl == "pallas_q"):
                 reasons.append("a failing multi-leaf Pallas kernel probe "
                                "on this backend")
@@ -674,6 +674,16 @@ class Booster:
             from .ops.grow_wave import make_wave_grower
             return make_wave_grower(self._grower_spec)
         return make_grower(self._grower_spec)
+
+    def _probe_shape(self):
+        """(bin count, column count) the histogram kernels will ACTUALLY
+        run at: the BUNDLE matrix shape under EFB (bundle columns can be
+        wider than any single feature's bin count — probing the
+        per-feature shape would certify the wrong Mosaic block)."""
+        efb = self._dd.efb
+        if efb is not None:
+            return efb.max_bin, efb.n_cols
+        return self._dd.max_bin, self._dd.num_feature
 
     def _resolve_hist_impl(self) -> str:
         """Pick the histogram implementation: the Pallas kernel on real TPU
@@ -705,7 +715,7 @@ class Booster:
             # Mosaic regression degrades to the XLA path instead of
             # crashing training
             from .ops.pallas_hist import probe_cached
-            if probe_cached(self._dd.max_bin, self._dd.num_feature):
+            if probe_cached(*self._probe_shape()):
                 return "pallas_q" if quant_ok else "pallas"
             log.warning("Pallas histogram probe failed on this backend; "
                         "falling back to segment-sum")

@@ -101,7 +101,7 @@ class TestWavePolicy:
                                       dumps["wave"][1])
 
     def test_overgrow_prune_invariants(self):
-        """Grow-then-prune (default for the wave policy): the emitted
+        """Grow-then-prune (opt-in via tpu_wave_overgrow): the emitted
         tree must have <= num_leaves leaves, its split log must replay to
         EXACTLY the returned row→leaf assignment (validates the
         compaction/renumbering), and the model text must round-trip."""

@@ -214,6 +214,17 @@ class TestWavePolicy:
                         lgb.Dataset(X, label=y), num_boost_round=25)
         assert auc_of(bst, X, y) > 0.85
 
+    def test_goss_and_dart(self):
+        """GOSS rescale weights and DART drops ride the wave payload
+        unchanged (non-{0,1} weights force the f32 kernel family)."""
+        X, y = make_binary(3000)
+        for boosting in ("goss", "dart"):
+            bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                             "verbosity": -1, "tree_grow_policy": "wave",
+                             "boosting": boosting},
+                            lgb.Dataset(X, label=y), num_boost_round=25)
+            assert auc_of(bst, X, y) > 0.85, boosting
+
     def test_efb_bundled(self):
         rng = np.random.RandomState(9)
         n = 2500
@@ -283,6 +294,62 @@ class TestWavePolicy:
         from lightgbm_tpu.metrics import _auc
         assert float(_auc(bst.predict(X, raw_score=True), y,
                           None, None)) > 0.75
+
+    def test_multiclass_and_ranking(self):
+        """Wave grows per-class trees (multiclass) and consumes ranking
+        lambdas like any other gradient source."""
+        rng = np.random.RandomState(31)
+        n = 2400
+        X = rng.randn(n, 6).astype(np.float32)
+        ym = (X[:, 0] + 0.5 * rng.randn(n) > 0).astype(int) \
+            + (X[:, 1] > 0.5).astype(int)
+        bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                         "num_leaves": 7, "verbosity": -1,
+                         "tree_grow_policy": "wave"},
+                        lgb.Dataset(X, label=ym.astype(float)),
+                        num_boost_round=10)
+        acc = (bst.predict(X).argmax(axis=1) == ym).mean()
+        assert acc > 0.7
+        # lambdarank
+        q = 40
+        group = np.full(n // q, q)
+        rel = X[:, 0] + 0.3 * rng.randn(n)
+        yr = np.zeros(n)
+        for i in range(n // q):
+            s = slice(i * q, (i + 1) * q)
+            yr[s] = np.minimum(4, np.argsort(np.argsort(rel[s])) * 5 // q)
+        bstr = lgb.train({"objective": "lambdarank", "num_leaves": 7,
+                          "verbosity": -1, "tree_grow_policy": "wave"},
+                         lgb.Dataset(X, label=yr, group=group),
+                         num_boost_round=10)
+        # higher raw score should correlate with higher relevance
+        sc = bstr.predict(X, raw_score=True)
+        assert np.corrcoef(sc, yr)[0, 1] > 0.5
+
+    def test_overgrow_tiny_trees(self):
+        """Edge sizes: overgrow with num_leaves 2 and 4 prunes back
+        correctly (replay == leaf_id, leaf counts respected)."""
+        import jax.numpy as jnp
+        from lightgbm_tpu.booster import Booster
+        from lightgbm_tpu.ops.predict import replay_leaf_ids
+        X, y = make_binary(1500)
+        for L in (2, 4):
+            bst = Booster(params={"objective": "binary", "num_leaves": L,
+                                  "verbosity": -1,
+                                  "tree_grow_policy": "wave",
+                                  "tpu_wave_overgrow": 2.0},
+                          train_set=lgb.Dataset(X, label=y))
+            g, h = bst._grad_fn(bst._train_score)
+            dev = bst._grower(bst._train_bins, g.astype(jnp.float32),
+                              h.astype(jnp.float32), bst._ones,
+                              bst._feat,
+                              jnp.asarray(bst._dd.base_allowed))
+            assert int(dev.n_splits) <= L - 1
+            replayed = replay_leaf_ids(dev, bst._train_bins,
+                                       bst._feat["nb"],
+                                       bst._feat["missing"])
+            np.testing.assert_array_equal(np.asarray(replayed),
+                                          np.asarray(dev.leaf_id))
 
     def test_downgrade_reasons(self):
         X, y = make_binary(1500)

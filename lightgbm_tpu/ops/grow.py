@@ -442,20 +442,35 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         else:
             bfeat, bmono, hist_bins = feat, mono, bins_fm
 
+        # the kernel payload rows are loop-INVARIANT per tree: prepare
+        # them once here, not inside every while-loop iteration's call
+        # (XLA does not reliably hoist the split/lattice encoding out of
+        # the while body — same hoisting as the wave grower)
+        if spec.hist_impl == "pallas":
+            from .pallas_hist import (_split_payload9,
+                                      pallas_histogram_multi_rows)
+            pw_prep = _split_payload9(payload)
+        elif spec.hist_impl == "pallas_q":
+            from .pallas_hist import (
+                pallas_histogram_multi_quantized_rows,
+                quantized_lattice_rows)
+            pw_prep = quantized_lattice_rows(payload, feat["qscales"][0],
+                                             feat["qscales"][1])
+        one_slot = jnp.zeros((1,), jnp.int32)
+
         def hist_of(mask_rows):
             # named scopes feed XProf/Perfetto timelines (SURVEY §5: the
             # reference only has USE_TIMETAG chrono counters)
             with jax.named_scope("histogram"):
                 if spec.hist_impl == "pallas":
-                    from .pallas_hist import pallas_histogram
-                    h = pallas_histogram(hist_bins, payload, mask_rows, HB)
+                    lid = jnp.where(mask_rows, 0, -1).astype(jnp.int32)
+                    h = pallas_histogram_multi_rows(
+                        hist_bins, pw_prep, lid, one_slot, HB)[0]
                 elif spec.hist_impl == "pallas_q":
-                    # quantized lattice via ONE bf16 matmul — integer
-                    # exact; scales ride in feat["qscales"]
-                    from .pallas_hist import pallas_histogram_quantized
-                    h = pallas_histogram_quantized(
-                        hist_bins, payload, mask_rows, HB,
-                        feat["qscales"][0], feat["qscales"][1])
+                    lid = jnp.where(mask_rows, 0, -1).astype(jnp.int32)
+                    h = pallas_histogram_multi_quantized_rows(
+                        hist_bins, pw_prep, lid, one_slot, HB,
+                        feat["qscales"][0], feat["qscales"][1])[0]
                 elif spec.hist_impl == "packed":
                     # quantized-gradient packed-int scatter (2 sweeps);
                     # scales ride in feat["qscales"] (booster/fused set

@@ -12,10 +12,16 @@ materialises the final value with `np.asarray`; per-call time is the
 slope between k=1 and k=K chains, which cancels dispatch + transfer
 overhead.
 """
+import os
 import sys
 import time
 
 import numpy as np
+
+# runnable as `python benchmarks/bench_hist.py` from anywhere: the repo
+# root (one level up) carries the package; PYTHONPATH must stay untouched
+# or the session sitecustomize (TPU plugin registration) is lost
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main():
@@ -42,11 +48,26 @@ def main():
         payload[:, 0], jnp.abs(payload[:, 1]) + 0.1, 8, return_scales=True)
     payload_q = jnp.stack([gq, hq, jnp.ones_like(gq)], axis=1)
 
+    from lightgbm_tpu.ops.pallas_hist import (MULTI_CHUNK, MULTI_CHUNK_Q,
+                                              pallas_histogram_multi,
+                                              pallas_histogram_multi_quantized)
+    leaf_id = jnp.asarray(
+        np.random.RandomState(1).randint(0, 16, n).astype(np.int32))
+    slots = jnp.arange(MULTI_CHUNK, dtype=jnp.int32)
+    slots_q = jnp.arange(MULTI_CHUNK_Q, dtype=jnp.int32)
+
     impls = {
         "segment_sum": lambda p: leaf_histogram(bins, p, mask, mb),
         "pallas": lambda p: pallas_histogram(bins, p, mask, mb),
         "pallas_q": lambda p: pallas_histogram_quantized(
             bins, payload_q + p[:, :1] * 0, mask, mb, sg, sh),
+        # the wave grower's batched passes: one call = 14 / 42 histograms
+        f"pallas_multi_x{MULTI_CHUNK}": lambda p: pallas_histogram_multi(
+            bins, p, leaf_id, slots, mb)[0],
+        f"pallas_q_multi_x{MULTI_CHUNK_Q}":
+            lambda p: pallas_histogram_multi_quantized(
+                bins, payload_q + p[:, :1] * 0, leaf_id, slots_q, mb,
+                sg, sh)[0],
     }
 
     # bins + payload + mask read per call

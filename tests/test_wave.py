@@ -351,6 +351,32 @@ class TestWavePolicy:
             np.testing.assert_array_equal(np.asarray(replayed),
                                           np.asarray(dev.leaf_id))
 
+    def test_eval_driven_training_and_determinism(self):
+        """Wave under the fused eval-driven chunk path (valid sets +
+        early stopping sync once per chunk) and bit-identical reruns
+        for the same seed."""
+        X, y = make_binary(3000)
+        Xe, ye = make_binary(1200, seed=17)
+
+        def train_once():
+            ev = {}
+            bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                             "verbosity": -1, "tree_grow_policy": "wave",
+                             "metric": "auc", "seed": 7},
+                            lgb.Dataset(X, label=y), num_boost_round=40,
+                            valid_sets=[lgb.Dataset(Xe, label=ye)],
+                            callbacks=[lgb.early_stopping(5,
+                                                          verbose=False),
+                                       lgb.record_evaluation(ev)])
+            return bst, ev
+
+        b1, ev1 = train_once()
+        b2, ev2 = train_once()
+        assert b1.model_to_string() == b2.model_to_string()
+        aucs = ev1["valid_0"]["auc"]
+        assert aucs[-1] >= aucs[0]
+        assert max(aucs) > 0.85
+
     def test_downgrade_reasons(self):
         X, y = make_binary(1500)
         bst = lgb.train({"objective": "binary", "num_leaves": 7,

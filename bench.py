@@ -55,6 +55,14 @@ MAX_BIN = 255
 CUDA_ANCHOR_ROUNDS_PER_SEC = 20.2
 ANCHOR_ROWS = 10_500_000
 
+# training config the worker runs, emitted verbatim in the JSON line so a
+# consumer comparing against the stock-leafwise anchor can see the policy
+# difference (the knobs pick the AUC-parity point of the r3c sweep; the
+# emitted `auc` field keeps quality honest)
+BENCH_CONFIG = {"num_leaves": NUM_LEAVES, "max_bin": MAX_BIN,
+                "learning_rate": 0.1, "tree_grow_policy": "wave",
+                "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0.8}
+
 WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 540))
 PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_BUDGET", 90))
 
@@ -89,6 +97,11 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         "vs_baseline": float(f"{rounds_per_sec / baseline:.3g}"),
         "backend": backend,
         "partial": partial,
+        # the anchor is stock leaf-wise growth; this run's policy/knobs
+        # ride along so the throughput ratio is never read as a
+        # config-identical comparison (the `auc` field keeps quality
+        # honest — ADVICE r3)
+        "config": BENCH_CONFIG,
     }
     if auc is not None:
         line["auc"] = round(auc, 4)
@@ -267,18 +280,10 @@ def _run_worker() -> None:
     import lightgbm_tpu as lgb
     from lightgbm_tpu.booster import Booster
 
-    params = {"objective": "binary", "num_leaves": NUM_LEAVES,
-              "max_bin": MAX_BIN, "learning_rate": 0.1, "verbosity": -1,
-              # TPU-first growth: wave-batched multi-leaf histograms fill
-              # the MXU's 128-row LHS (PROFILE.md round 3c).  The knobs
-              # pick the AUC-PARITY point of the sweep — the
-              # capacity-aware gain floor (ratio x opening gain x
-              # tree-fullness) recovers strict leafwise's held-out AUC to
-              # within ~0.002 at ~3x its rounds/s; wider/floorless waves
-              # reach ~6x at a ~0.01 AUC cost — the reported `auc` field
-              # keeps this honest
-              "tree_grow_policy": "wave",
-              "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0.8}
+    # TPU-first growth: wave-batched multi-leaf histograms fill the MXU's
+    # 128-row LHS (PROFILE.md round 3c); BENCH_CONFIG picks the AUC-parity
+    # point of the sweep and rides along in the emitted JSON line
+    params = {"objective": "binary", "verbosity": -1, **BENCH_CONFIG}
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     bst = Booster(params=params, train_set=ds)
@@ -303,10 +308,13 @@ def _run_worker() -> None:
         print(f"@chunk {chunk} {dt:.4f}", flush=True)
     rounds_per_sec = done / total_s
 
-    # rough effective-bandwidth estimate (see PROFILE.md)
-    levels = np.log2(NUM_LEAVES) / 2 + 1
-    gbps = n * (F + 16) * levels * rounds_per_sec / 1e9
-    _log(f"est. effective HBM traffic ~{gbps:.0f} GB/s (analytic)")
+    # rough effective-bandwidth estimate (see PROFILE.md) — only
+    # meaningful on an accelerator; on the CPU fallback it rounded to a
+    # junk "~0 GB/s" line (VERDICT r3 weak #6)
+    if devs[0].platform == "tpu":
+        levels = np.log2(NUM_LEAVES) / 2 + 1
+        gbps = n * (F + 16) * levels * rounds_per_sec / 1e9
+        _log(f"est. effective HBM traffic ~{gbps:.1f} GB/s (analytic)")
 
     try:
         from lightgbm_tpu.metrics import _auc

@@ -540,8 +540,9 @@ class Booster:
     # does not beat the capacity-aware gain floor on depth-hungry data —
     # wave depth (~log2 of the grown size), not leaf capacity, is what
     # binds (PROFILE.md "grow-then-prune" note) — but it remains an
-    # opt-in knob for breadth-friendly data.
-    WAVE_WIDTH_DEFAULT = 6
+    # opt-in knob for breadth-friendly data.  The width default is
+    # DEFINED in ops/grow_wave.py so a directly-built GrowerSpec falls
+    # back to the same swept value.
     WAVE_GAIN_RATIO_DEFAULT = 0.0
     WAVE_OVERGROW_DEFAULT = 0.0
 
@@ -552,6 +553,7 @@ class Booster:
         rows-per-leaf chunks).  Deterministic across backends given the
         same params — the backend-parity contract (CPU packed ↔ TPU
         pallas_q resolve to the same family)."""
+        from .ops.grow_wave import WAVE_WIDTH_DEFAULT
         from .ops.pallas_hist import MULTI_CHUNK, MULTI_CHUNK_Q
         cap = MULTI_CHUNK_Q \
             if self._resolve_hist_impl() in ("pallas_q", "packed") \
@@ -561,7 +563,7 @@ class Booster:
             # overgrow mode wants the widest batch the family's kernel
             # chunk supports; plain waves keep the accuracy-sweep width
             w = cap if self._wave_overgrow() > 1.0 \
-                else self.WAVE_WIDTH_DEFAULT
+                else WAVE_WIDTH_DEFAULT
         return min(w, cap)
 
     def _wave_gain_ratio(self) -> float:

@@ -54,6 +54,12 @@ Array = jax.Array
 
 INF = jnp.inf
 
+# the accuracy-sweep default width (PROFILE.md round 3c: W=6 keeps AUC,
+# W=14 leaks ~0.016 of capacity into breadth).  ONE definition: the
+# Booster's knob resolution imports this, and `wave_sizes`' fallback for
+# directly-built GrowerSpecs resolves to the same swept value.
+WAVE_WIDTH_DEFAULT = 6
+
 
 def wave_sizes(spec: GrowerSpec):
     """(LB, W): internal grow size (overgrow x num_leaves, pruned back
@@ -63,7 +69,7 @@ def wave_sizes(spec: GrowerSpec):
     L = spec.num_leaves
     LB = L if spec.wave_overgrow <= 1.0 else \
         max(L, int(math.ceil(spec.wave_overgrow * L)))
-    return LB, max(1, min(spec.wave_width or 14, LB - 1))
+    return LB, max(1, min(spec.wave_width or WAVE_WIDTH_DEFAULT, LB - 1))
 
 
 @functools.lru_cache(maxsize=64)

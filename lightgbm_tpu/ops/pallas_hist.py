@@ -68,43 +68,22 @@ def _split3(x: Array):
     return x1, x2, x3
 
 
-def _hist_kernel(bins_ref, pw_ref, out_ref, *, mb: int):
-    """One (feature-block x row-tile) grid cell.
-
-    bins_ref: [F_t, N_t] uint8/int32; pw_ref: [R, N_t] f32 with
-    bf16-representable values (pre-masked split payload rows); out_ref:
-    [F_t, R, MB] f32 accumulator, revisited across row tiles.
-    """
-    r = pl.program_id(1)  # row-tile index (fast axis)
-
-    @pl.when(r == 0)
-    def _init():
-        out_ref[:] = jnp.zeros_like(out_ref)
-
-    f_t, n_t = bins_ref.shape
-    # f32 refs whose VALUES are bf16-representable: DEFAULT precision on
-    # TPU truncates f32 operands to bf16 for the MXU (one pass) — exact
-    # here by construction — and accumulates f32.  (Passing actual bf16
-    # refs makes Mosaic emit a bf16 RESULT despite preferred_element_type,
-    # which rounds the sums.)
-    pw = pw_ref[:]                                   # [R, N_t] f32
-    bin_ids = jax.lax.broadcasted_iota(jnp.int32, (n_t, mb), 1)
-    for f in range(f_t):                             # static unroll
-        b = bins_ref[f, :].astype(jnp.int32)         # [N_t]
-        onehot = (b[:, None] == bin_ids).astype(jnp.float32)
-        out_ref[f] += jax.lax.dot_general(
-            pw, onehot, (((1,), (0,)), ((), ())),
-            precision=jax.lax.Precision.DEFAULT,
-            preferred_element_type=jnp.float32)
-
-
 def _hist_kernel_multi(bins_ref, pw_ref, lid_ref, slots_ref, out_ref, *,
                        mb: int):
-    """Multi-leaf grid cell with IN-KERNEL leaf masking.
+    """Multi-leaf grid cell with IN-KERNEL leaf masking — THE production
+    kernel: every public f32 entry point (single-leaf included, via a
+    mask-derived leaf id) lowers to this one body, so `probe()` gates
+    exactly the code that training runs.
 
     bins_ref: [F_t, N_t]; pw_ref: [R0, N_t] base payload rows (9 f32-split
     or 3 quantized-lattice); lid_ref: [1, N_t] i32 row→leaf; slots_ref:
     [1, S] i32 leaf slots; out_ref: [F_t, S*R0, MB] accumulator.
+
+    The payload rides f32 refs whose VALUES are bf16-representable:
+    DEFAULT precision on TPU truncates f32 operands to bf16 for the MXU
+    (one pass) — exact here by construction — and accumulates f32.
+    (Passing actual bf16 refs makes Mosaic emit a bf16 RESULT despite
+    preferred_element_type, which rounds the sums.)
 
     Building the [S*R0, N_t] masked LHS in VMEM (instead of materialising
     it in HBM as the first multi formulation did) removes ~5.5 ms of
@@ -244,40 +223,6 @@ def _run_kernel_multi_i8(bins_fm: Array, pw0: Array, leaf_id: Array,
     return out[:f]
 
 
-def _run_kernel(bins_fm: Array, pw: Array, max_bin: int, row_tile: int,
-                feat_tile: int, interpret: bool) -> Array:
-    """Shared pallas_call driver: [F, N] bins x [R, N] payload rows (f32
-    carrier, bf16-representable values) -> [F, R, MB] f32."""
-    f, n = bins_fm.shape
-    rows = pw.shape[0]
-    n_pad = (-n) % row_tile
-    if n_pad:
-        pw = jnp.pad(pw, ((0, 0), (0, n_pad)))
-        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, n_pad)))
-    if feat_tile <= 0 or feat_tile > f:
-        feat_tile = f
-    f_pad = (-f) % feat_tile
-    if f_pad:
-        bins_fm = jnp.pad(bins_fm, ((0, f_pad), (0, 0)))
-    n_rt = (n + n_pad) // row_tile
-    n_ft = (f + f_pad) // feat_tile
-
-    out = pl.pallas_call(
-        functools.partial(_hist_kernel, mb=max_bin),
-        grid=(n_ft, n_rt),  # row tiles iterate fastest -> out revisited
-        in_specs=[
-            pl.BlockSpec((feat_tile, row_tile), lambda j, r: (j, r)),
-            pl.BlockSpec((rows, row_tile), lambda j, r: (0, r)),
-        ],
-        out_specs=pl.BlockSpec((feat_tile, rows, max_bin),
-                               lambda j, r: (j, 0, 0)),
-        out_shape=jax.ShapeDtypeStruct((f + f_pad, rows, max_bin),
-                                       jnp.float32),
-        interpret=interpret,
-    )(bins_fm, pw)
-    return out[:f]
-
-
 @functools.partial(jax.jit, static_argnames=("max_bin", "impl", "row_tile",
                                              "feat_tile", "interpret"))
 def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
@@ -286,29 +231,29 @@ def pallas_histogram(bins_fm: Array, payload: Array, row_mask: Array,
                      interpret: bool = False) -> Array:
     """Drop-in replacement for histogram.leaf_histogram (same contract).
 
+    Single-leaf = the f32 multi driver with a mask-derived leaf id
+    (slot 0 = in-leaf, -1 = masked out) — the SAME `_hist_kernel_multi`
+    block the strict grower runs (ops/grow.py `hist_of`, S=1), so the
+    `probe()` that exercises this function gates the production kernel,
+    not a legacy single-leaf body.
+
     Args:
       bins_fm: [F, N] uint8/uint16 bin matrix, feature-major.
       payload: [N, 3] f32 (grad*w, hess*w, w).
       row_mask: [N] bool leaf membership.
       max_bin: padded bin-axis size MB.
       impl: kept for call-site compatibility; every path now runs the
-        single-pass split-bf16 kernel.
+        single-pass split-bf16 multi kernel.
     Returns: [F, MB, 3] f32 — matches the segment-sum path to >= f32
       accuracy (the 3-term bf16 split carries ~27 mantissa bits per
       payload element; counts are exact below 2^24 rows).
     """
     del impl
-    p3 = jnp.where(row_mask, payload.T, 0.0).astype(jnp.float32)  # [3, N]
-    g1, g2, g3 = _split3(p3[0])
-    h1, h2, h3 = _split3(p3[1])
-    w1, w2, w3 = _split3(p3[2])                      # GOSS weights are f32
-    # [9, N] f32 carrier, every value bf16-representable by construction
-    pw = jnp.stack([g1, g2, g3, h1, h2, h3, w1, w2, w3])
-    out = _run_kernel(bins_fm, pw, max_bin, row_tile, feat_tile, interpret)
-    g = out[:, 0] + out[:, 1] + out[:, 2]
-    h = out[:, 3] + out[:, 4] + out[:, 5]
-    c = out[:, 6] + out[:, 7] + out[:, 8]
-    return jnp.stack([g, h, c], axis=-1)             # [F, MB, 3]
+    lid = jnp.where(row_mask, 0, -1).astype(jnp.int32)
+    return pallas_histogram_multi_rows(
+        bins_fm, _split_payload9(payload), lid,
+        jnp.zeros((1,), jnp.int32), max_bin, row_tile=row_tile,
+        feat_tile=feat_tile, interpret=interpret)[0]
 
 
 # MXU LHS capacity is 128 rows; leaves per kernel pass at 9 / 3 rows each
@@ -404,7 +349,10 @@ def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
 def quantized_lattice_rows(payload: Array, s_g: Array, s_h: Array) -> Array:
     """[N, 3] quantized payload -> [3, N] int8 lattice rows: |gq|, hq <=
     num_grad_quant_bins (booster-gated <= 15), w in {0, 1} — exact in
-    int8, 2x MXU rate vs bf16."""
+    int8, 2x MXU rate vs bf16.
+
+    PRECONDITION: payload[:, 2] ∈ {0, 1} (see pallas_histogram_quantized)
+    — fractional weights are binarized, corrupting the count channel."""
     gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int8)
     hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int8)
     w = (payload[:, 2] != 0).astype(jnp.int8)
@@ -445,20 +393,25 @@ def pallas_histogram_quantized(bins_fm: Array, payload: Array,
 
     Same contract as histogram.leaf_histogram_packed: payload carries
     (gq·s_g·w, hq·s_h·w, w) with integer gq/hq on the quantization lattice
-    and w ∈ {0, 1}.  The integers are recovered exactly by division, fed
+    and w ∈ {0, 1}.
+
+    PRECONDITION: the weight channel MUST be {0, 1} (bagging in/out).
+    The lattice binarizes it (`w != 0 -> 1`), so a fractional weight
+    (GOSS amplification, sample weights) would silently turn the count
+    channel into row counts instead of weight sums — the Booster's
+    `quant_ok` gate excludes those modes before routing here; direct
+    callers must do the same.
+
+    The integers are recovered exactly by division, fed
     to the MXU as bf16 (|gq| ≤ 2^8 — exactly representable), and the three
     (Σgq, Σhq, count) rows come out of a single [3, N_t]x[N_t, MB] pass
     (ref: the packed 32-bit atomics of cuda_histogram_constructor.cu — one
     operation covering grad+hess; here one matmul covers all three).
     """
     # single-leaf = the int8 multi driver with a mask-derived leaf id
-    # (slot 0 = in-leaf, -1 = masked out): |gq|, hq <= 15, w in {0, 1}
-    # are exact in int8 and the int8 x int8 -> int32 dot runs at 2x the
-    # bf16 MXU rate
-    gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int8)
-    hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int8)
-    w = (payload[:, 2] != 0).astype(jnp.int8)
-    pw = jnp.stack([gq, hq, w])                      # [3, N] int8
+    # (slot 0 = in-leaf, -1 = masked out): the lattice is exact in int8
+    # and the int8 x int8 -> int32 dot runs at 2x the bf16 MXU rate
+    pw = quantized_lattice_rows(payload, s_g, s_h)   # [3, N] int8
     lid = jnp.where(row_mask, 0, -1).astype(jnp.int32)
     out = _run_kernel_multi_i8(bins_fm, pw, lid,
                                jnp.zeros((1,), jnp.int32), max_bin,

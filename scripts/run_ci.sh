@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # CI entry (ref: .ci/test.sh in the reference).  Also the local gate:
-#   ./scripts/run_ci.sh quick    # pre-commit tier, <~3 min of test time
+#   ./scripts/run_ci.sh quick    # pre-commit tier, ~5-7 min of test time
 #   ./scripts/run_ci.sh full     # the whole suite (nightly; ~30 min on 1 core)
 # tests/conftest.py forces the virtual 8-device CPU mesh either way.
 set -euo pipefail

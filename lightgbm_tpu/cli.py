@@ -195,6 +195,28 @@ def load_data_file_full(path: str, config: Config):
     return X, y, extras
 
 
+def _snapshot_callback(freq: int, output_model: str):
+    """Periodic mid-training snapshots (ref: application.cpp
+    `Application::Train` — every `snapshot_freq` iterations the model so
+    far is saved to `<output_model>.snapshot_iter_<n>`).  `n` counts
+    TOTAL trees (`current_iteration`), so resumed runs continue the
+    numbering of the run they resume.  Not `chunk_safe`: the engine must
+    drive it per-iteration so each snapshot is the exact model at that
+    iteration."""
+    def _callback(env) -> None:
+        it = env.model.current_iteration()
+        if it % freq == 0:
+            path = f"{output_model}.snapshot_iter_{it}"
+            env.model.save_model(path)
+            log.info(f"Saved snapshot to {path}")
+
+    # BEFORE early_stopping (order 30): its EarlyStopException aborts the
+    # callback chain, which would silently drop a snapshot due on the
+    # stopping (or final) iteration
+    _callback.order = 25  # type: ignore
+    return _callback
+
+
 def run(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
@@ -219,10 +241,18 @@ def run(argv: List[str]) -> int:
             valid_sets.append(train_set.create_valid(vf))
             valid_names.append(f"valid_{i}")
         from .callback import log_evaluation
+        callbacks = [log_evaluation(max(config.metric_freq, 1))]
+        if config.snapshot_freq > 0:
+            callbacks.append(_snapshot_callback(config.snapshot_freq,
+                                                config.output_model))
         booster = engine_train(
             dict(params), train_set, num_boost_round=config.num_iterations,
             valid_sets=valid_sets or None, valid_names=valid_names or None,
-            callbacks=[log_evaluation(max(config.metric_freq, 1))])
+            # continued training: a killed job resumes from its last
+            # snapshot via input_model= (ref: application.cpp InitTrain —
+            # task=train + input_model loads then continues boosting)
+            init_model=config.input_model or None,
+            callbacks=callbacks)
         booster.save_model(config.output_model)
         log.info(f"Finished training; model saved to {config.output_model}")
         return 0

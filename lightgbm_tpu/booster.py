@@ -645,7 +645,7 @@ class Booster:
             reasons.append("histogram_pool_size (bounded histogram pool)")
         if spec.n_ic_groups:
             reasons.append("interaction constraints")
-        kind, shards, _, _, _ = self._learner_topology()
+        kind, shards, _, dcn, use_2level = self._learner_topology()
         if shards <= 1:
             kind = "serial"      # the one-device fallback (wave-eligible)
         if kind not in ("serial", "data"):
@@ -660,6 +660,14 @@ class Booster:
             from .ops.pallas_hist import probe_cached
             _, w = wave_sizes(spec)
             pb, pc = self._probe_shape()
+            if kind == "data" and self._dd.efb is None:
+                # distributed data_rs block-pads the feature axis — the
+                # kernel runs at the PADDED column count, so that is the
+                # shape the probe must certify (Mosaic regressions are
+                # shape-specific)
+                from .parallel.learner import padded_feature_count
+                s_last = shards // dcn if use_2level else shards
+                pc = padded_feature_count(pc, s_last)
             if not probe_cached(pb, pc, multi=True, width=w,
                                 quantized=spec.hist_impl == "pallas_q"):
                 reasons.append("a failing multi-leaf Pallas kernel probe "
@@ -824,10 +832,12 @@ class Booster:
             self._mesh = get_mesh_2level(dcn, shards // dcn)
         else:
             self._mesh = get_mesh(shards)
+        # the wave policy now runs data_rs too, so its feature axis is
+        # block-padded exactly like the strict data learner's
         self._train_bins = place_training_data(
             np.asarray(train_src), self._mesh, kind,
             pad_features=(kind in ("data", "feature")
-                          and self._dd.efb is None and not wave))
+                          and self._dd.efb is None))
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
             self._dd.num_feature, self._dd.num_data, wave=wave)

@@ -199,8 +199,40 @@ def _merge_split_across_shards(s: SplitResult, axis_name: str,
 # --------------------------------------------------------------------------
 # helpers shared by the strict (below) and wave (ops/grow_wave.py) growers —
 # one definition so the two policies can never drift on partition decode,
-# per-node sampling, EFB expansion, or monotone-basic child bounds
+# per-node sampling, EFB expansion, monotone-basic child bounds, or the
+# block-sharded (data_rs/feature) search machinery
 # --------------------------------------------------------------------------
+
+def make_feature_blocks(feat: Dict[str, Array], mono: Array, F: int,
+                        axis_last: str, n_shards: int, mode: str):
+    """This shard's feature block for distributed split finding:
+    `(Fb, offset, bslice, bfeat, bmono)` with the [F] per-feature
+    metadata sliced to this shard's `[offset, offset + Fb)` window.
+    Raises (not asserts — direct callers must hit it under `python -O`
+    too) on a non-divisible F, with the 'pad features first' message
+    instead of an opaque downstream psum_scatter shape error."""
+    if F % n_shards != 0:
+        raise ValueError(
+            f"{mode} learner requires features ({F}) divisible by "
+            f"shards ({n_shards}); pad features first")
+    Fb = F // n_shards
+    offset = jax.lax.axis_index(axis_last) * Fb
+
+    def bslice(x):
+        return jax.lax.dynamic_slice_in_dim(x, offset, Fb, axis=0)
+
+    bfeat = {k: bslice(feat[k])
+             for k in ("nb", "missing", "default", "is_cat")}
+    return Fb, offset, bslice, bfeat, bslice(mono)
+
+
+def rebase_and_merge_block_split(s: SplitResult, offset, axis_last: str,
+                                 n_shards: int) -> SplitResult:
+    """Rebase a block-local SplitResult's feature index to the global
+    feature space, then SplitInfo allreduce-max across shards."""
+    s = s._replace(feature=jnp.where(s.feature >= 0, s.feature + offset,
+                                     s.feature))
+    return _merge_split_across_shards(s, axis_last, n_shards)
 
 def make_bundled_expander(spec: GrowerSpec, feat: Dict[str, Array]):
     """(expand_bundled, decode_bins) for EFB bundle matrices.
@@ -423,19 +455,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         if block:
             # this shard owns feature block [offset, offset + Fb) for split
             # finding; partition still uses the full (global) feature space
-            if F % n_shards != 0:
-                raise ValueError(
-                    f"{mode} learner requires features ({F}) divisible by "
-                    f"shards ({n_shards}); pad features first")
-            Fb = F // n_shards
-            offset = jax.lax.axis_index(axis_last) * Fb
-
-            def bslice(x):
-                return jax.lax.dynamic_slice_in_dim(x, offset, Fb, axis=0)
-
-            bfeat = {k: bslice(feat[k])
-                     for k in ("nb", "missing", "default", "is_cat")}
-            bmono = bslice(mono)
+            Fb, offset, bslice, bfeat, bmono = make_feature_blocks(
+                feat, mono, F, axis_last, n_shards, mode)
             # feature mode histograms only this shard's columns (bins are
             # replicated); data_rs histograms all columns of its row shard
             hist_bins = bslice(bins_fm) if mode == "feature" else bins_fm
@@ -572,10 +593,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                      parent_output=p_out, cand_mask=cand_mask,
                      gain_penalty=penalty)
             if block:
-                s = s._replace(feature=jnp.where(s.feature >= 0,
-                                                 s.feature + offset,
-                                                 s.feature))
-                s = _merge_split_across_shards(s, axis_last, n_shards)
+                s = rebase_and_merge_block_split(s, offset, axis_last,
+                                                 n_shards)
             return s
 
         # per-node column sampling + extra_trees (shared derivations —

@@ -31,7 +31,10 @@ Feature scope (the booster downgrades to the strict grower otherwise):
 numerical + categorical splits, missing handling, monotone basic,
 path smoothing, per-tree/per-node column sampling, extra_trees,
 max_depth/min_* constraints, EFB bundling, all histogram impls, and
-distributed data-parallel training (full-histogram psum).  Forced splits,
+distributed data-parallel training — in the production reduce-scatter
+mode (`mode="data_rs"`: block-scattered wave histograms + per-wave
+SplitInfo allreduce-max; features block-padded), or full-histogram psum
+under EFB (see `make_wave_grower`).  Forced splits,
 CEGB, interaction constraints, monotone intermediate, and the bounded
 histogram pool keep the strict grower.
 """
@@ -46,7 +49,8 @@ import jax.numpy as jnp
 
 from .grow import (DeviceTree, GrowerSpec, _split_to_arrays,
                    child_bounds_basic, make_bundled_expander,
-                   make_node_samplers, split_go_left)
+                   make_feature_blocks, make_node_samplers,
+                   rebase_and_merge_block_split, split_go_left)
 from .histogram import leaf_histogram_multi, leaf_histogram_packed_multi
 from .split import NEG_INF, find_best_split, leaf_output, smooth_output
 
@@ -73,15 +77,29 @@ def wave_sizes(spec: GrowerSpec):
 
 
 @functools.lru_cache(maxsize=64)
-def make_wave_grower(spec: GrowerSpec, axis_name=None):
+def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
+                     n_shards: int = 1):
     """Build (and cache) the jitted wave grower for a static spec.
 
     Same contract as `ops.grow.make_grower`; with `axis_name` the grower
-    runs the data-parallel strategy only (rows sharded, batched
-    histograms `psum`med — ref: data_parallel_tree_learner.cpp; the
-    block/voting strategies keep the strict grower).  Histograms are
-    globally summed before split finding, so size constraints need no
-    per-shard rescaling (unlike the voting learner's local vote)."""
+    runs row-sharded data parallelism in one of two histogram-reduction
+    modes (the block/voting strategies keep the strict grower):
+
+    - mode="data": batched histograms fully `psum`med; every shard then
+      searches all features.  Required under EFB bundling (bundle
+      columns don't align with feature blocks).
+    - mode="data_rs": the production distributed mode (ref:
+      data_parallel_tree_learner.cpp `Network::ReduceScatter`): the
+      [S, F, MB, 3] wave histogram is `psum_scatter`ed over the feature
+      axis of the LAST mesh axis (ICI), each shard searches only its
+      F/n_shards block for ALL the wave's children, and the per-child
+      SplitInfo vector is allreduce-max merged across shards
+      (`_merge_split_across_shards`, vmapped over the wave).  DCN slices
+      allreduce the scattered block, so heavy traffic rides ICI.
+
+    Histograms are globally summed/scattered before split finding, so
+    size constraints need no per-shard rescaling (unlike the voting
+    learner's local vote)."""
     L = spec.num_leaves
     MB = spec.max_bin
     # grow-then-prune: grow to LB leaves, prune back to L (off: LB == L)
@@ -104,6 +122,13 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
 
     axes_all = axis_name if isinstance(axis_name, tuple) else \
         ((axis_name,) if axis_name is not None else None)
+    block = axes_all is not None and mode == "data_rs"
+    axis_last = axes_all[-1] if axes_all else None
+    axes_dcn = axes_all[:-1] if axes_all else ()
+    if block and spec.bundled:
+        raise ValueError("EFB bundling requires mode='data' for the "
+                         "distributed wave grower (bundle columns do not "
+                         "align with per-feature blocks)")
     HB = spec.bundle_max_bin if spec.bundled else spec.max_bin
 
     def grow(bins_fm: Array,       # [F, N] (or [G, N] bundled) feature-major
@@ -141,9 +166,19 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
             pw_prep = quantized_lattice_rows(payload, feat["qscales"][0],
                                              feat["qscales"][1])
 
+        # data_rs: each shard stores/searches only its feature block
+        # (the SAME shared machinery as the strict grower's block path)
+        if block:
+            Fb, offset, _, bfeat, bmono = make_feature_blocks(
+                feat, mono, F, axis_last, n_shards, mode)
+        else:
+            bfeat, bmono = feat, mono
+
         def hist_multi(leaf_id, slots):
-            """[S, F|G, HB, 3] histograms of the listed leaf slots in one
-            batched sweep; pad slots (value LB) yield zeros."""
+            """[S, F|G|Fb, HB, 3] histograms of the listed leaf slots in
+            one batched sweep; pad slots (value LB) yield zeros.  Under
+            data_rs the returned feature axis is this shard's summed
+            block (psum_scatter over ICI + psum over DCN)."""
             with jax.named_scope("histogram_wave"):
                 if spec.hist_impl == "pallas":
                     h = pallas_histogram_multi_rows(bins_fm, pw_prep,
@@ -160,7 +195,16 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
                 else:
                     h = leaf_histogram_multi(bins_fm, payload, leaf_id,
                                              slots, HB)
-                if axes_all is not None:
+                if block:
+                    # ref: Network::ReduceScatter of histogram buffers —
+                    # each shard receives the summed feature block it
+                    # will scan (over ICI); DCN slices allreduce it
+                    h = jax.lax.psum_scatter(h, axis_last,
+                                             scatter_dimension=1,
+                                             tiled=True)
+                    if axes_dcn:
+                        h = jax.lax.psum(h, axes_dcn)
+                elif axes_all is not None:
                     h = jax.lax.psum(h, axes_all)
             return h
 
@@ -170,12 +214,29 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None):
         bynode_mask, extra_mask = make_node_samplers(spec, feat, F)
 
         def split_of(hist, g, h, c, node_allowed, lb, ub, p_out, nid):
+            na = node_allowed & bynode_mask(nid)
+            cm = extra_mask(nid)
+            if block:
+                # block search on this shard's scattered histogram, then
+                # SplitInfo allreduce-max (vmapped over the wave's
+                # children by the caller) — ref: DataParallelTreeLearner
+                # FindBestSplitsFromHistograms + SplitInfo MaxReducer
+                na = jax.lax.dynamic_slice_in_dim(na, offset, Fb, axis=0)
+                if cm is not None:
+                    cm = jax.lax.dynamic_slice_in_dim(cm, offset, Fb,
+                                                      axis=0)
+                s = find(hist, g, h, c, bfeat["nb"], bfeat["missing"],
+                         bfeat["default"], na, bfeat["is_cat"],
+                         mono=bmono, out_lb=lb, out_ub=ub,
+                         parent_output=p_out, cand_mask=cm)
+                return rebase_and_merge_block_split(s, offset, axis_last,
+                                                    n_shards)
             if spec.bundled:
                 hist = expand_bundled(hist, g, h, c)
             return find(hist, g, h, c, feat["nb"], feat["missing"],
-                        feat["default"], node_allowed & bynode_mask(nid),
-                        feat["is_cat"], mono=mono, out_lb=lb, out_ub=ub,
-                        parent_output=p_out, cand_mask=extra_mask(nid))
+                        feat["default"], na, feat["is_cat"], mono=mono,
+                        out_lb=lb, out_ub=ub, parent_output=p_out,
+                        cand_mask=cm)
 
         # ---- root ----
         # the root pass uses the SAME [W]-slot call shape as every wave

@@ -83,8 +83,11 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
     feat, allowed) -> DeviceTree` with `leaf_id` of length N.
 
     `wave=True` plugs in the wave-batched grower (ops/grow_wave.py) —
-    data-parallel only (rows sharded, batched histograms psummed; the
-    booster downgrades other kinds before reaching here).
+    data-parallel only (rows sharded; the booster downgrades other kinds
+    before reaching here).  Like the strict grower, the wave runs the
+    production `data_rs` reduce-scatter mode (block-sharded histograms,
+    per-wave SplitInfo allreduce-max) except under EFB, where bundle
+    columns force the full-histogram psum.
     """
     axes = tuple(mesh.axis_names)     # ("data",) or ("dcn", "ici")
     S_last = int(mesh.shape[axes[-1]])
@@ -105,7 +108,6 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
     if wave:
         assert kind == "data", \
             "wave policy must be downgraded for non-data learners"
-        mode = "data"
     # feature blocks split over the LAST (ICI) axis only; rows shard over
     # the whole mesh
     f_extra = (padded_feature_count(num_feature, S_last) - num_feature) \
@@ -118,7 +120,8 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         from ..ops.grow_wave import make_wave_grower
         grow = make_wave_grower(spec,
                                 axis_name=axes if len(axes) > 1
-                                else axes[0])
+                                else axes[0],
+                                mode=mode, n_shards=S_last)
     else:
         grow = make_grower(spec,
                            axis_name=axes if len(axes) > 1 else axes[0],

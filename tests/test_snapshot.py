@@ -85,18 +85,32 @@ def test_snapshot_write_and_resume(tmp_path):
 
 def test_snapshot_with_early_stopping(tmp_path):
     # the snapshot callback runs BEFORE early_stopping in the callback
-    # chain: a snapshot due on the stopping/final iteration must be
-    # written even though EarlyStopException aborts the chain
-    data = os.path.join(tmp_path, "train.csv")
-    _write_csv(data)
-    valid = os.path.join(tmp_path, "valid.csv")
-    _write_csv(valid, n=150, seed=9)
+    # chain: the snapshot due on the stopping iteration must be written
+    # even though EarlyStopException aborts the chain.  Pure-noise valid
+    # labels make the valid metric plateau immediately, so the stop
+    # genuinely FIRES (well before num_iterations) — with snapshot_freq=1
+    # every iteration, including the stopping one, owes a snapshot.
+    from lightgbm_tpu.cli import _snapshot_callback
+    rng = np.random.RandomState(17)
+    X = rng.randn(400, 5)
+    y = X[:, 0] + 0.1 * rng.randn(400)
+    Xv = rng.randn(150, 5)
+    yv = rng.randn(150) * 10.0        # unrelated to features → plateau
     out = os.path.join(tmp_path, "m.txt")
-    _run_cli([f"data={data}", f"valid={valid}", f"output_model={out}",
-              "num_iterations=8", "snapshot_freq=4",
-              "early_stopping_round=50"] + COMMON)
-    assert os.path.exists(out + ".snapshot_iter_4")
-    assert os.path.exists(out + ".snapshot_iter_8")
+    ds = lgb.Dataset(X, label=y)
+    bst = lgb.train(
+        {"objective": "regression", "num_leaves": 8, "verbosity": -1,
+         "min_data_in_leaf": 5, "early_stopping_round": 2},
+        ds, num_boost_round=60,
+        valid_sets=[ds.create_valid(Xv, label=yv)],
+        callbacks=[_snapshot_callback(1, out)])
+    grown = bst.current_iteration()
+    assert grown < 60, "early stopping never fired — test is vacuous"
+    # every grown iteration has its snapshot, INCLUDING the stopping one
+    # (ordering the snapshot callback after early_stopping would lose
+    # exactly the last file)
+    for i in range(1, grown + 1):
+        assert os.path.exists(out + f".snapshot_iter_{i}"), i
 
 
 def test_snapshot_freq_off_writes_none(tmp_path):

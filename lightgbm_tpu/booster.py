@@ -600,17 +600,20 @@ class Booster:
         `_resolve_grow_policy` (which judges wave eligibility), so the
         two can never drift.  Quiet: emits no warnings.
 
-        Returns (kind, shards, n_dev, dcn, use_2level); `kind` includes
-        alias + EFB/2-level downgrades but NOT the one-device serial
-        fallback — callers apply `shards <= 1` themselves (the setup
-        path wants to warn, the policy path just wants the answer)."""
+        Returns (kind, shards, n_dev, dcn, use_2level, s_last); `s_last`
+        is the LAST (ICI) mesh-axis size — the shard count feature
+        blocks split over (must match `mesh.shape[axes[-1]]` of the mesh
+        `_setup_tree_learner` builds).  `kind` includes alias +
+        EFB/2-level downgrades but NOT the one-device serial fallback —
+        callers apply `shards <= 1` themselves (the setup path wants to
+        warn, the policy path just wants the answer)."""
         from .parallel.learner import resolve_tree_learner
         cfg = self.config
         bundled = self._dd.efb is not None
         name = cfg.tree_learner or "serial"
         kind = resolve_tree_learner(name, bundled=bundled, quiet=True)
         if kind == "serial":
-            return "serial", 1, 1, 1, False
+            return "serial", 1, 1, 1, False, 1
         try:
             n_dev = len(jax.devices())
         except RuntimeError:
@@ -621,7 +624,8 @@ class Booster:
         use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
         kind = resolve_tree_learner(name, bundled=bundled,
                                     two_level=use_2level, quiet=True)
-        return kind, shards, n_dev, dcn, use_2level
+        s_last = shards // dcn if use_2level else shards
+        return kind, shards, n_dev, dcn, use_2level, s_last
 
     def _resolve_grow_policy(self) -> str:
         """Resolve `tree_grow_policy` with eligibility downgrades (see
@@ -645,7 +649,7 @@ class Booster:
             reasons.append("histogram_pool_size (bounded histogram pool)")
         if spec.n_ic_groups:
             reasons.append("interaction constraints")
-        kind, shards, _, dcn, use_2level = self._learner_topology()
+        kind, shards, _, _, _, s_last = self._learner_topology()
         if shards <= 1:
             kind = "serial"      # the one-device fallback (wave-eligible)
         if kind not in ("serial", "data"):
@@ -664,9 +668,9 @@ class Booster:
                 # distributed data_rs block-pads the feature axis — the
                 # kernel runs at the PADDED column count, so that is the
                 # shape the probe must certify (Mosaic regressions are
-                # shape-specific)
+                # shape-specific); s_last comes from the ONE topology
+                # resolver so probe and mesh can't drift
                 from .parallel.learner import padded_feature_count
-                s_last = shards // dcn if use_2level else shards
                 pc = padded_feature_count(pc, s_last)
             if not probe_cached(pb, pc, multi=True, width=w,
                                 quantized=spec.hist_impl == "pallas_q"):
@@ -789,7 +793,7 @@ class Booster:
         bundled = self._dd.efb is not None
         # quiet resolution via the shared topology resolver — warnings
         # fire once, after the cache check
-        kind, shards, n_dev, dcn, use_2level = self._learner_topology()
+        kind, shards, n_dev, dcn, use_2level, _ = self._learner_topology()
         # EFB: training reads the bundled matrix (see _DeviceData)
         train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
         if kind == "serial":

@@ -242,8 +242,13 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         # the root pass uses the SAME [W]-slot call shape as every wave
         # (pad slots LB match nothing), so exactly ONE multi-kernel block
         # shape is ever compiled/run per spec — the shape the booster's
-        # probe gate checks
-        leaf_id0 = jnp.zeros((N,), jnp.int32)
+        # probe gate checks.  leaf_id0 is a compile-time CONSTANT here:
+        # without the barrier XLA constant-folds the segment-sum path's
+        # [W, N] slot compare + reduce at COMPILE time (observed: 10.3 s
+        # fold stall per chunk program at N=100k — BENCH_r03 tail); the
+        # barrier trades that for a trivial runtime zeros-fill
+        leaf_id0 = jax.lax.optimization_barrier(
+            jnp.zeros((N,), jnp.int32))
         root_slots = jnp.full((W,), LB, jnp.int32).at[0].set(0)
         hist0 = hist_multi(leaf_id0, root_slots)[0]
         root_g = payload[:, 0].sum()

@@ -84,10 +84,17 @@ def timeit_rounds(booster: Any, rounds: int) -> Dict:
     cannot complete before the device work has."""
     import jax
     chunk = booster._BULK_CHUNK
+    t0 = time.time()
     booster.update_many(chunk)  # warmup incl. compile
     jax.block_until_ready(booster._train_score)
+    warmup_s = time.time() - t0
     n = max(chunk, (rounds // chunk) * chunk)
     t0 = time.time()
     booster.update_many(n)
     jax.block_until_ready(booster._train_score)
-    return training_report(booster, n, time.time() - t0)
+    rep = training_report(booster, n, time.time() - t0)
+    # warmup (≈ compile) seconds ride along so compile-time regressions
+    # (e.g. XLA constant-fold stalls in the chunk program — BENCH_r03's
+    # 10.3 s reduce fold) are visible in every profiled run
+    rep["warmup_compile_sec"] = round(warmup_s, 1)
+    return rep

@@ -357,6 +357,7 @@ class Booster:
             wave_width=self._wave_width(),
             wave_gain_ratio=self._wave_gain_ratio(),
             wave_overgrow=self._wave_overgrow(),
+            wave_strict_tail=self._wave_strict_tail(),
             has_cat=bool(np.asarray(self._dd.is_cat).any()),
         )
         self._grow_policy = self._resolve_grow_policy()
@@ -569,6 +570,19 @@ class Booster:
     def _wave_gain_ratio(self) -> float:
         r = float(self.config.tpu_wave_gain_ratio)
         return self.WAVE_GAIN_RATIO_DEFAULT if r < 0.0 else min(r, 1.0)
+
+    def _wave_strict_tail(self) -> int:
+        """Hybrid wave/strict schedule knob: `tpu_wave_strict_tail=-1`
+        (auto) resolves to ~num_leaves/3 — enough strict endgame to
+        recover the strict policy's capacity allocation where it binds,
+        small enough that most splits stay wave-batched; 0 disables.
+        The grower caps it at its grow budget (LB - 1, which exceeds
+        num_leaves - 1 under overgrow — the tail is the endgame of the
+        grow phase)."""
+        t = int(self.config.tpu_wave_strict_tail)
+        if t < 0:
+            t = (self.config.num_leaves + 2) // 3
+        return max(t, 0)
 
     def _wave_overgrow(self) -> float:
         """Grow-then-prune factor (0 = off).  Auto-resolves to the sweep

@@ -121,6 +121,15 @@ class GrowerSpec(NamedTuple):
     # final tree recovers (and in measurements beats) the strict policy's
     # capacity allocation at wave throughput.  <= 1 = off
     wave_overgrow: float = 0.0
+    # hybrid wave/strict schedule: once remaining leaf capacity drops to
+    # this many splits, waves collapse to width 1 — which IS strict
+    # best-first order (one batched pass per split, children re-searched
+    # before the next pick).  Early growth gets MXU-batched waves while
+    # capacity is plentiful (splitting weak leaves costs nothing yet);
+    # the capacity-scarce endgame — where the wave policy's AUC tax
+    # lives (PROFILE.md r3c: wave DEPTH binds) — gets exact strict
+    # allocation.  0 = off
+    wave_strict_tail: int = 0
     # False = every feature is numerical (static): the split finder skips
     # the categorical cases — four [F, MB] argsorts per call
     has_cat: bool = True

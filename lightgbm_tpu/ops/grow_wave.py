@@ -318,6 +318,25 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                        "leaf_out", "leaf_depth") + LEAF_KEYS}
             istate["ready"] = jnp.arange(LB) < st["nl"]
             istate["w"] = jnp.int32(0)
+            # hybrid wave/strict schedule (spec.wave_strict_tail): with
+            # at most `tail` splits of capacity left, cap the wave at
+            # width 1 — strict best-first order (children re-searched
+            # before the next pick), still on the one [W]-slot kernel
+            # shape (pad slots) at ~1.1x a single-leaf pass.  The wave
+            # that CROSSES the boundary is clipped to `remaining - tail`
+            # so the promised strict endgame is never consumed by a wide
+            # boundary wave; the cap against LB-1 (not num_leaves) keeps
+            # the semantics under overgrow: the tail is the endgame of
+            # the GROW phase (pruning then trims by gain).
+            if spec.wave_strict_tail > 0:
+                tail = min(spec.wave_strict_tail, LB - 1)
+                remaining = LB - st["nl"]
+                istate["wcap"] = jnp.where(
+                    remaining <= tail, jnp.int32(1),
+                    jnp.minimum(jnp.int32(W),
+                                (remaining - tail).astype(jnp.int32)))
+            else:
+                istate["wcap"] = jnp.int32(W)
             # per-wave pair records; pad slot LB drops out of every scatter
             istate["p_small"] = jnp.full((W,), LB, jnp.int32)
             istate["p_left"] = jnp.full((W,), LB, jnp.int32)
@@ -337,7 +356,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
 
             def icond(s):
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
-                return (s["w"] < W) & (s["step"] < LB - 1) & \
+                return (s["w"] < s["wcap"]) & (s["step"] < LB - 1) & \
                     (jnp.max(rg) > jnp.maximum(s["g_floor"], 0.0))
 
             def ibody(s):

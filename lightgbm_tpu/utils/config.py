@@ -210,6 +210,7 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # capacity-aware gain floor measured better — PROFILE.md).  < 0 =
     # auto (currently off), <= 1 disables
     "tpu_wave_overgrow": (-1.0, "float", ("wave_overgrow",)),
+    "tpu_wave_strict_tail": (-1, "int", ("wave_strict_tail",)),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -100,6 +100,50 @@ class TestWavePolicy:
         np.testing.assert_array_equal(dumps["leafwise"][1],
                                       dumps["wave"][1])
 
+    def test_full_strict_tail_matches_strict(self):
+        """tpu_wave_strict_tail >= num_leaves - 1 collapses EVERY wave
+        to width 1 — strict best-first order: trees must be
+        byte-identical to the leafwise grower at any num_leaves (the
+        hybrid schedule's endgame is exactly this path)."""
+        X, y = make_binary(2500)
+        dumps = {}
+        strip = ("[tree_grow_policy", "[tpu_wave")
+        for pol, extra in (("leafwise", {}),
+                           ("wave", {"tpu_wave_strict_tail": 1000,
+                                     "tpu_wave_gain_ratio": 0})):
+            bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                             "verbosity": -1, "tree_grow_policy": pol,
+                             "tpu_wave_overgrow": 0, **extra},
+                            lgb.Dataset(X, label=y), num_boost_round=8)
+            txt = bst.model_to_string()
+            body = "\n".join(ln for ln in txt.splitlines()
+                             if not ln.startswith(strip))
+            dumps[pol] = (body, bst.predict(X))
+        assert dumps["leafwise"][0] == dumps["wave"][0]
+        np.testing.assert_array_equal(dumps["leafwise"][1],
+                                      dumps["wave"][1])
+
+    def test_strict_tail_partial_quality(self):
+        """A partial strict tail (the auto default) must keep the wave
+        policy's held-out quality at least at the floorless wave's level
+        and grow num_leaves-bounded trees."""
+        X, y = make_binary(4000)
+        Xv, yv = make_binary(1500, seed=123)
+        aucs = {}
+        for tail in (0, -1):
+            bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                             "verbosity": -1, "tree_grow_policy": "wave",
+                             "tpu_wave_strict_tail": tail,
+                             "tpu_wave_gain_ratio": 0},
+                            lgb.Dataset(X, label=y), num_boost_round=16)
+            from lightgbm_tpu.metrics import _auc
+            aucs[tail] = float(_auc(bst.predict(Xv, raw_score=True),
+                                    yv, None, None))
+            for t in bst.trees:
+                assert t.num_internal() + 1 <= 31
+        # auto tail (~L/3 strict endgame) should not hurt; allow noise
+        assert aucs[-1] >= aucs[0] - 0.004, aucs
+
     def test_overgrow_prune_invariants(self):
         """Grow-then-prune (opt-in via tpu_wave_overgrow): the emitted
         tree must have <= num_leaves leaves, its split log must replay to

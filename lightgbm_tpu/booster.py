@@ -578,10 +578,14 @@ class Booster:
         small enough that most splits stay wave-batched; 0 disables.
         The grower caps it at its grow budget (LB - 1, which exceeds
         num_leaves - 1 under overgrow — the tail is the endgame of the
-        grow phase)."""
+        grow phase).  Auto resolves to 0 under overgrow: the prune
+        already reallocates capacity by gain, and a strict tail on the
+        pre-prune growth measurably hurts it (tests/test_wave.py
+        overgrow-quality); an explicit value is honored either way."""
         t = int(self.config.tpu_wave_strict_tail)
         if t < 0:
-            t = (self.config.num_leaves + 2) // 3
+            t = 0 if self._wave_overgrow() > 1.0 \
+                else (self.config.num_leaves + 2) // 3
         return max(t, 0)
 
     def _wave_overgrow(self) -> float:

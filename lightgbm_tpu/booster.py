@@ -1779,7 +1779,6 @@ class Booster:
             return out
         if pred_contrib:
             return self._predict_contrib(X, trees)
-        raw = np.zeros((n, K), dtype=np.float64)
         # per-row prediction early stop (ref: prediction_early_stop.cpp —
         # binary: 2|score| >= margin; multiclass: top1-top2 >= margin,
         # checked every pred_early_stop_freq tree groups)
@@ -1791,6 +1790,34 @@ class Booster:
                            self.params.get("pred_early_stop", False)))
         obj_name = getattr(getattr(self, "config", None), "objective", "")
         es = es and (obj_name == "binary" or K > 1)
+        # TPU batch path (opt-in `device_predict=True`): one jitted
+        # scan-of-vmapped-traversals over stacked padded trees
+        # (ops/predict.py predict_raw_ensemble) instead of the host
+        # per-tree walk — the batched analog of predictor.hpp's OpenMP
+        # row loop.  Falls back silently to the host path for shapes it
+        # does not cover (multiclass, categorical splits, linear trees,
+        # early stop).
+        if (_b(kwargs.get("device_predict",
+                          self.params.get("device_predict", False)))
+                and K == 1 and not es):
+            # the stacked ensemble is model-constant: cache the padded
+            # arrays (and their device copies) across calls, invalidated
+            # when the tree slice changes (further training/rollback)
+            ck = (start_iteration, num_iteration, len(self.trees),
+                  self.cur_iter)
+            cached = getattr(self, "_pred_dev_cache", None)
+            stacked = cached[1] if cached and cached[0] == ck \
+                else self._stack_for_device(trees)
+            if stacked is not None:
+                self._pred_dev_cache = (ck, stacked)
+                raw = self._predict_raw_device(stacked, X)
+                if getattr(self, "_average_output", False) and len(trees):
+                    raw = raw / max(len(trees), 1)
+                if raw_score or self.objective_ is None:
+                    return raw
+                return np.asarray(jax.device_get(
+                    self.objective_.convert_output(jnp.asarray(raw))))
+        raw = np.zeros((n, K), dtype=np.float64)
         if es and len(trees):
             freq = int(kwargs.get(
                 "pred_early_stop_freq",
@@ -1826,6 +1853,53 @@ class Booster:
             return raw
         return np.asarray(jax.device_get(
             self.objective_.convert_output(jnp.asarray(raw))))
+
+    def _stack_for_device(self, trees: List[Tree]):
+        """Pad host trees into the stacked [T, NI]/[T, NL] arrays that
+        `ops.predict.predict_raw_ensemble` scans.  Returns None when any
+        tree needs a path the device traversal does not implement
+        (categorical splits, linear leaves) — callers fall back to the
+        host walk."""
+        if not trees or any(t.num_cat > 0 or t.is_linear for t in trees):
+            return None
+        ni = max(max(t.num_leaves - 1, 1) for t in trees)
+        T = len(trees)
+        feat = np.zeros((T, ni), np.int32)
+        thr = np.zeros((T, ni), np.float32)
+        dtype_ = np.zeros((T, ni), np.int32)
+        # pad nodes route to leaf 0 (~0 = -1): a single-leaf tree's root
+        # terminates immediately with its constant value
+        left = np.full((T, ni), -1, np.int32)
+        right = np.full((T, ni), -1, np.int32)
+        value = np.zeros((T, ni + 1), np.float32)
+        for i, t in enumerate(trees):
+            k = t.num_leaves - 1
+            feat[i, :k] = t.split_feature[:k]
+            thr[i, :k] = t.threshold[:k]
+            dtype_[i, :k] = t.decision_type[:k]
+            left[i, :k] = t.left_child[:k]
+            right[i, :k] = t.right_child[:k]
+            value[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        return dict(feat=jnp.asarray(feat), thr=jnp.asarray(thr),
+                    dtype=jnp.asarray(dtype_), left=jnp.asarray(left),
+                    right=jnp.asarray(right), value=jnp.asarray(value))
+
+    def _predict_raw_device(self, stacked, X: np.ndarray) -> np.ndarray:
+        """Jitted stacked-ensemble batch predict in f32.
+
+        Parity caveat: features AND thresholds are cast to f32, so a
+        feature value lying strictly between a threshold and its f32
+        rounding can route to the other subtree — such rows' errors are
+        leaf-value-sized, not rounding-sized.  This affects only rows
+        within f32 epsilon of a split threshold (thresholds are bin-edge
+        midpoints, so real data virtually never sits there); the host
+        walk remains the exact-f64 reference path."""
+        from .ops.predict import predict_raw_ensemble
+        if getattr(self, "_pred_dev_jit", None) is None:
+            self._pred_dev_jit = jax.jit(predict_raw_ensemble)
+        out = self._pred_dev_jit(stacked,
+                                 jnp.asarray(X, dtype=jnp.float32))
+        return np.asarray(jax.device_get(out), dtype=np.float64)
 
     def _predict_contrib(self, X: np.ndarray, trees: List[Tree]) -> np.ndarray:
         """TreeSHAP feature contributions (ref: PredictContrib → tree.cpp

@@ -1,0 +1,37 @@
+"""Round-4 candidate bench configs — ONE definition shared by the
+quality sweep (sweep_quality_r4.py, CPU-runnable, orders configs by
+held-out AUC) and the speed sweep (sweep_speed_r4.py, TPU), so the two
+sweeps can never silently measure different configs under one name."""
+
+BASE = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
+        "learning_rate": 0.1, "verbosity": -1}
+
+QUANT = {"use_quantized_grad": True, "num_grad_quant_bins": 15}
+
+CONFIGS = {
+    # ordered most-important-first (the speed sweep runs them in order
+    # so a wedging tunnel costs the least-important tail)
+    "wave_w8_tail_auto+quant": {"tree_grow_policy": "wave",
+                                "tpu_wave_width": 8,
+                                "tpu_wave_gain_ratio": 0, **QUANT},
+    "wave_w8_tail_auto": {"tree_grow_policy": "wave", "tpu_wave_width": 8,
+                          "tpu_wave_gain_ratio": 0},
+    "wave_r3bench": {"tree_grow_policy": "wave", "tpu_wave_width": 8,
+                     "tpu_wave_gain_ratio": 0.8, "tpu_wave_strict_tail": 0},
+    "strict": {},
+    "wave_w8_tail6+quant": {"tree_grow_policy": "wave",
+                            "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0,
+                            "tpu_wave_strict_tail": 6, **QUANT},
+    "wave_r3bench+quant": {"tree_grow_policy": "wave", "tpu_wave_width": 8,
+                           "tpu_wave_gain_ratio": 0.8,
+                           "tpu_wave_strict_tail": 0, **QUANT},
+    "strict+quant": dict(QUANT),
+    # quality-sweep extras (cheap on CPU, skipped by the speed sweep's
+    # default ordering unless explicitly named)
+    "wave_r3bench+tail": {"tree_grow_policy": "wave", "tpu_wave_width": 8,
+                          "tpu_wave_gain_ratio": 0.8},
+    "wave_w6_tail_auto": {"tree_grow_policy": "wave", "tpu_wave_width": 6,
+                          "tpu_wave_gain_ratio": 0},
+    "wave_w8_tail16": {"tree_grow_policy": "wave", "tpu_wave_width": 8,
+                       "tpu_wave_gain_ratio": 0, "tpu_wave_strict_tail": 16},
+}

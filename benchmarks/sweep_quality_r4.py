@@ -1,0 +1,48 @@
+"""Round-4 growth-policy quality sweep (backend-independent).
+
+Held-out AUC of candidate bench configs on the bench's Higgs-like data.
+Speed is NOT measured here (run on CPU; kernel economics differ) — this
+sweep only orders configs by quality so the TPU speed sweep
+(sweep_speed_r4.py) can be short.  Results feed PROFILE.md r4.
+
+Usage: python benchmarks/sweep_quality_r4.py [N] [ROUNDS]
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from configs_r4 import BASE, CONFIGS  # noqa: E402 (one shared definition)
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
+ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 48
+
+
+def main():
+    import bench
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.metrics import _auc
+
+    n_eval = max(100_000, N // 10)
+    X, y = bench._make_higgs_like(N + n_eval, bench.F)
+    X_eval, y_eval = X[N:], y[N:]
+    X, y = X[:N], y[:N]
+    out = {}
+    for name, extra in CONFIGS.items():
+        params = {**BASE, **extra}
+        t0 = time.time()
+        bst = lgb.train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=ROUNDS)
+        auc = float(_auc(bst.predict(X_eval, raw_score=True),
+                         y_eval, None, None))
+        out[name] = {"auc": round(auc, 5),
+                     "train_s": round(time.time() - t0, 1)}
+        print(json.dumps({name: out[name]}), flush=True)
+    print("RESULT " + json.dumps({"n": N, "rounds": ROUNDS,
+                                  "configs": out}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -57,11 +57,16 @@ ANCHOR_ROWS = 10_500_000
 
 # training config the worker runs, emitted verbatim in the JSON line so a
 # consumer comparing against the stock-leafwise anchor can see the policy
-# difference (the knobs pick the AUC-parity point of the r3c sweep; the
-# emitted `auc` field keeps quality honest)
+# difference (the emitted `auc` field keeps quality honest).  r4: the r3c
+# AUC-parity knobs (W=8, capacity-aware gain floor 0.8) PLUS the hybrid
+# strict tail (auto ~num_leaves/3), which collapses the capacity-scarce
+# endgame to exact strict order — the mechanism behind the r3 2M AUC gap
+# (PROFILE.md r4: the 500k quality sweep orders floor+tail >= floor >
+# neither; tail-only-small is the worst config).
 BENCH_CONFIG = {"num_leaves": NUM_LEAVES, "max_bin": MAX_BIN,
                 "learning_rate": 0.1, "tree_grow_policy": "wave",
-                "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0.8}
+                "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0.8,
+                "tpu_wave_strict_tail": -1}
 
 WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 540))
 PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_BUDGET", 90))

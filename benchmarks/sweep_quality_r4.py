@@ -5,7 +5,7 @@ Speed is NOT measured here (run on CPU; kernel economics differ) — this
 sweep only orders configs by quality so the TPU speed sweep
 (sweep_speed_r4.py) can be short.  Results feed PROFILE.md r4.
 
-Usage: python benchmarks/sweep_quality_r4.py [N] [ROUNDS]
+Usage: python benchmarks/sweep_quality_r4.py [N] [ROUNDS] [SEED] [names...]
 """
 import json
 import os
@@ -18,6 +18,8 @@ from configs_r4 import BASE, CONFIGS  # noqa: E402 (one shared definition)
 
 N = int(sys.argv[1]) if len(sys.argv) > 1 else 500_000
 ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 48
+SEED = int(sys.argv[3]) if len(sys.argv) > 3 else 77
+NAMES = sys.argv[4:] or list(CONFIGS)
 
 
 def main():
@@ -25,12 +27,16 @@ def main():
     import lightgbm_tpu as lgb
     from lightgbm_tpu.metrics import _auc
 
+    unknown = set(NAMES) - CONFIGS.keys()
+    if unknown:
+        sys.exit(f"unknown config name(s): {sorted(unknown)}")
     n_eval = max(100_000, N // 10)
-    X, y = bench._make_higgs_like(N + n_eval, bench.F)
+    X, y = bench._make_higgs_like(N + n_eval, bench.F, seed=SEED)
     X_eval, y_eval = X[N:], y[N:]
     X, y = X[:N], y[:N]
     out = {}
-    for name, extra in CONFIGS.items():
+    for name in NAMES:
+        extra = CONFIGS[name]
         params = {**BASE, **extra}
         t0 = time.time()
         bst = lgb.train(params, lgb.Dataset(X, label=y),
@@ -40,7 +46,7 @@ def main():
         out[name] = {"auc": round(auc, 5),
                      "train_s": round(time.time() - t0, 1)}
         print(json.dumps({name: out[name]}), flush=True)
-    print("RESULT " + json.dumps({"n": N, "rounds": ROUNDS,
+    print("RESULT " + json.dumps({"n": N, "rounds": ROUNDS, "seed": SEED,
                                   "configs": out}), flush=True)
 
 

@@ -1598,6 +1598,7 @@ class Booster:
             else:
                 new_scale = 1.0 / (kdrop + 1.0)
                 old_scale = kdrop / (kdrop + 1.0)
+            self._invalidate_pred_caches()  # in-place value rescaling
             for k in range(K):
                 tree = self.trees[-K + k]
                 tree.leaf_value = tree.leaf_value * new_scale
@@ -1843,8 +1844,24 @@ class Booster:
                     active &= ~decided
                     all_active = bool(active.all())
         else:
-            for i, t in enumerate(trees):
-                raw[:, i % K] += t.predict(X)
+            filled = False
+            if K == 1:
+                # native tight-loop ensemble walk (ref: predictor.hpp +
+                # c_api.cpp PredictSingleRowFast: model arrays resolved
+                # once, each call is pure traversal).  Exact f64 drop-in
+                # for the numpy path — same decision semantics, same
+                # tree-order summation — so no behavior flag is needed.
+                flat = self._flatten_for_native(
+                    trees, (start_iteration, num_iteration))
+                if flat is not None:
+                    from . import native
+                    nr = native.predict_rows(flat, X)
+                    if nr is not None:
+                        raw[:, 0] = nr
+                        filled = True
+            if not filled:
+                for i, t in enumerate(trees):
+                    raw[:, i % K] += t.predict(X)
         if getattr(self, "_average_output", False) and len(trees) >= K:
             raw /= max(len(trees) // K, 1)
         if K == 1:
@@ -1883,6 +1900,48 @@ class Booster:
         return dict(feat=jnp.asarray(feat), thr=jnp.asarray(thr),
                     dtype=jnp.asarray(dtype_), left=jnp.asarray(left),
                     right=jnp.asarray(right), value=jnp.asarray(value))
+
+    def _flatten_for_native(self, trees: List[Tree], slice_key):
+        """Per-tree-concatenated contiguous model arrays for the native
+        ensemble walk (`native.predict_rows`), cached across calls
+        (single-row latency is dominated by setup otherwise).  None for
+        shapes the walk does not cover (linear trees)."""
+        if not trees or any(t.is_linear for t in trees):
+            return None
+        ck = (slice_key, len(self.trees), self.cur_iter)
+        cached = getattr(self, "_pred_native_cache", None)
+        if cached and cached[0] == ck:
+            return cached[1]
+        offs = {k: [0] for k in ("node", "leaf", "cb", "bits")}
+        cols = {k: [] for k in ("feat", "thr", "dtype", "left", "right",
+                                "thr_bin", "leaf_value", "cat_bounds",
+                                "cat_bits")}
+        for t in trees:
+            ni = max(t.num_leaves - 1, 0)
+            cols["feat"].append(t.split_feature[:ni])
+            cols["thr"].append(t.threshold[:ni])
+            cols["dtype"].append(t.decision_type[:ni])
+            cols["left"].append(t.left_child[:ni])
+            cols["right"].append(t.right_child[:ni])
+            cols["thr_bin"].append(t.threshold_bin[:ni])
+            cols["leaf_value"].append(t.leaf_value[:t.num_leaves])
+            cols["cat_bounds"].append(t.cat_boundaries)
+            cols["cat_bits"].append(t.cat_threshold)
+            offs["node"].append(offs["node"][-1] + ni)
+            offs["leaf"].append(offs["leaf"][-1] + t.num_leaves)
+            offs["cb"].append(offs["cb"][-1] + len(t.cat_boundaries))
+            offs["bits"].append(offs["bits"][-1] + len(t.cat_threshold))
+        dt = dict(feat=np.int32, thr=np.float64, dtype=np.int32,
+                  left=np.int32, right=np.int32, thr_bin=np.int32,
+                  leaf_value=np.float64, cat_bounds=np.int64,
+                  cat_bits=np.uint32)
+        flat = {k: np.ascontiguousarray(np.concatenate(v), dt[k])
+                for k, v in cols.items()}
+        for k in offs:
+            flat[f"{k}_off"] = np.asarray(offs[k], np.int64)
+        flat["n_trees"] = len(trees)
+        self._pred_native_cache = (ck, flat)
+        return flat
 
     def _predict_raw_device(self, stacked, X: np.ndarray) -> np.ndarray:
         """Jitted stacked-ensemble batch predict in f32.
@@ -2225,7 +2284,16 @@ class Booster:
         self._scores_stale = True
         # the rollback cache holds the OLD leaf's contributions
         self._last_contribs = []
+        self._invalidate_pred_caches()
         return self
+
+    def _invalidate_pred_caches(self) -> None:
+        """Drop the flattened/stacked prediction caches after any
+        IN-PLACE model mutation that their keys (tree slice, tree count,
+        cur_iter) cannot see — set_leaf_output, shuffle_models, DART
+        value rescaling."""
+        self._pred_native_cache = None
+        self._pred_dev_cache = None
 
     def shuffle_models(self, start_iteration: int = 0,
                        end_iteration: int = -1) -> "Booster":
@@ -2246,6 +2314,8 @@ class Booster:
             self.trees = [t for b in reordered for t in b]
             # the rollback cache refers to the pre-shuffle last iteration
             self._last_contribs = []
+            # slice-based predictions (start/num_iteration) DO change
+            self._invalidate_pred_caches()
         return self
 
     def get_split_value_histogram(self, feature, bins=None,

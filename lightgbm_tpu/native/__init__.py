@@ -71,8 +71,36 @@ def get_lib():
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
         lib.lgbtpu_stream_close.restype = None
         lib.lgbtpu_stream_close.argtypes = [ctypes.c_void_p]
+        lib.lgbtpu_predict_rows.restype = None
+        lib.lgbtpu_predict_rows.argtypes = [ctypes.c_void_p] * 13 + [
+            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_void_p]
         _lib = lib
         return _lib
+
+
+def predict_rows(flat, X: np.ndarray) -> Optional[np.ndarray]:
+    """Raw-score ensemble prediction over `X` [n, F] f64 via the native
+    tree walk.  `flat` is the dict built by
+    `Booster._flatten_for_native` (contiguous per-tree-concatenated node
+    arrays + offsets).  None if the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    X = np.ascontiguousarray(X, dtype=np.float64)
+    out = np.empty(X.shape[0], dtype=np.float64)
+
+    def p(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    lib.lgbtpu_predict_rows(
+        p(flat["feat"]), p(flat["thr"]), p(flat["dtype"]), p(flat["left"]),
+        p(flat["right"]), p(flat["thr_bin"]), p(flat["leaf_value"]),
+        p(flat["node_off"]), p(flat["leaf_off"]), p(flat["cb_off"]),
+        p(flat["cat_bounds"]), p(flat["bits_off"]), p(flat["cat_bits"]),
+        ctypes.c_int64(flat["n_trees"]), p(X),
+        ctypes.c_int64(X.shape[0]), ctypes.c_int64(X.shape[1]), p(out))
+    return out
 
 
 def parse_dense(path: str) -> Optional[Tuple[np.ndarray, bool]]:

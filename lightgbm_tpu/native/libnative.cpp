@@ -269,4 +269,70 @@ void lgbtpu_values_to_bins(const double *vals, int64_t n,
   }
 }
 
+// ---------------------------------------------------------------------------
+// Ensemble traversal over raw feature rows (ref: src/application/predictor.hpp
+// `Predictor` + src/c_api.cpp `LGBM_BoosterPredictForMatSingleRowFast`: the
+// pre-resolved fast path — model arrays flattened ONCE on the Python side,
+// each call is a tight tree walk with no per-call setup).  Decision semantics
+// mirror tree.h `Tree::NumericalDecision` / `CategoricalDecision` exactly
+// (bit layout: 1 = categorical, 2 = default_left, bits 2-3 = missing type).
+// ---------------------------------------------------------------------------
+
+static const double kZeroThreshold = 1e-35;
+
+void lgbtpu_predict_rows(
+    const int32_t *feat,        // [total_nodes] split feature per node
+    const double *thr,          // [total_nodes] numerical threshold
+    const int32_t *dtype,       // [total_nodes] decision_type bits
+    const int32_t *left,        // [total_nodes] child (< 0: leaf ~child)
+    const int32_t *right,       // [total_nodes]
+    const int32_t *thr_bin,     // [total_nodes] cat_boundaries index (cat)
+    const double *leaf_value,   // [total_leaves]
+    const int64_t *node_off,    // [n_trees + 1] node ranges
+    const int64_t *leaf_off,    // [n_trees + 1] leaf ranges
+    const int64_t *cb_off,      // [n_trees + 1] cat_boundaries ranges
+    const int64_t *cat_bounds,  // concatenated per-tree cat_boundaries
+    const int64_t *bits_off,    // [n_trees + 1] cat bitset word ranges
+    const uint32_t *cat_bits,   // concatenated cat_threshold words
+    int64_t n_trees, const double *X, int64_t n_rows, int64_t n_feat,
+    double *out) {
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const double *x = X + r * n_feat;
+    double acc = 0.0;
+    for (int64_t t = 0; t < n_trees; ++t) {
+      const int64_t nb = node_off[t];
+      if (node_off[t + 1] == nb) {  // single-leaf tree: constant output
+        acc += leaf_value[leaf_off[t]];
+        continue;
+      }
+      int32_t nd = 0;
+      while (nd >= 0) {
+        const int64_t g = nb + nd;
+        const double fv = x[feat[g]];
+        const int32_t dt = dtype[g];
+        bool go_left;
+        if (dt & 1) {  // categorical: category in bitset -> left
+          const int64_t lo = cat_bounds[cb_off[t] + thr_bin[g]];
+          const int64_t hi = cat_bounds[cb_off[t] + thr_bin[g] + 1];
+          const int64_t v = std::isnan(fv) ? -1 : (int64_t)fv;
+          go_left = v >= 0 && v < (hi - lo) * 32 &&
+                    ((cat_bits[bits_off[t] + lo + v / 32] >> (v % 32)) & 1u);
+        } else {
+          const int32_t missing_type = (dt >> 2) & 3;
+          const bool default_left = (dt & 2) != 0;
+          const bool isnan_v = std::isnan(fv);
+          const double v = (isnan_v && missing_type != 2) ? 0.0 : fv;
+          const bool is_missing =
+              (missing_type == 1 && std::fabs(v) <= kZeroThreshold) ||
+              (missing_type == 2 && isnan_v);
+          go_left = is_missing ? default_left : (v <= thr[g]);
+        }
+        nd = go_left ? left[g] : right[g];
+      }
+      acc += leaf_value[leaf_off[t] + (~nd)];
+    }
+    out[r] = acc;
+  }
+}
+
 }  // extern "C"

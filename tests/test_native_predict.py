@@ -1,0 +1,90 @@
+"""Native ensemble tree walk (native/libnative.cpp lgbtpu_predict_rows,
+ref: predictor.hpp Predictor + c_api.cpp LGBM_BoosterPredictForMat
+SingleRowFast) — must be an EXACT f64 drop-in for the numpy per-tree
+host path (same decision semantics, same tree-order summation).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import native
+
+pytestmark = pytest.mark.quick
+
+needs_native = pytest.mark.skipif(native.get_lib() is None,
+                                  reason="no native toolchain")
+
+
+def _numpy_raw(bst, X):
+    out = np.zeros(len(X), dtype=np.float64)
+    for t in bst.trees:
+        out += t.predict(X)
+    return out
+
+
+def _train(params, X, y, rounds=15, **dskw):
+    p = {"num_leaves": 12, "verbosity": -1, "min_data_in_leaf": 5, **params}
+    return lgb.train(p, lgb.Dataset(X, label=y, **dskw),
+                     num_boost_round=rounds)
+
+
+@needs_native
+def test_exact_parity_numerical_with_nans():
+    rng = np.random.RandomState(3)
+    X = rng.randn(2500, 7)
+    X[rng.rand(*X.shape) < 0.07] = np.nan
+    y = np.nan_to_num(X[:, 0] - 0.5 * X[:, 1]) + 0.1 * rng.randn(2500)
+    bst = _train({"objective": "regression"}, X, y)
+    got = bst.predict(X, raw_score=True)
+    np.testing.assert_array_equal(got, _numpy_raw(bst, X))
+
+
+@needs_native
+def test_exact_parity_categorical():
+    rng = np.random.RandomState(4)
+    X = rng.randn(2500, 6)
+    X[:, 1] = rng.randint(0, 14, 2500)
+    y = (np.isin(X[:, 1], [2, 5, 11]) + 0.3 * rng.randn(2500) > 0.5)\
+        .astype(float)
+    bst = _train({"objective": "binary"}, X, y, categorical_feature=[1])
+    assert any(t.num_cat > 0 for t in bst.trees)
+    got = bst.predict(X, raw_score=True)
+    np.testing.assert_array_equal(got, _numpy_raw(bst, X))
+    # categories unseen in training + NaN categories route like numpy
+    Xo = X.copy()
+    Xo[:50, 1] = 99
+    Xo[50:100, 1] = np.nan
+    np.testing.assert_array_equal(bst.predict(Xo, raw_score=True),
+                                  _numpy_raw(bst, Xo))
+
+
+@needs_native
+def test_single_row_latency_path_and_slices():
+    rng = np.random.RandomState(5)
+    X = rng.randn(2000, 5)
+    y = X[:, 0] + 0.1 * rng.randn(2000)
+    bst = _train({"objective": "regression"}, X, y, rounds=20)
+    row = X[:1]
+    np.testing.assert_array_equal(bst.predict(row, raw_score=True),
+                                  _numpy_raw(bst, row))
+    # iteration slices flatten their own cache entry
+    a = bst.predict(X[:100], raw_score=True, num_iteration=7)
+    b = np.zeros(100)
+    for t in bst.trees[:7]:
+        b += t.predict(X[:100])
+    np.testing.assert_array_equal(a, b)
+
+
+@needs_native
+def test_linear_trees_fall_back():
+    rng = np.random.RandomState(6)
+    X = rng.randn(1500, 4)
+    y = X[:, 0] * 2 + X[:, 1] + 0.05 * rng.randn(1500)
+    bst = _train({"objective": "regression", "linear_tree": True}, X, y,
+                 rounds=8)
+    assert any(t.is_linear for t in bst.trees)
+    # fallback result == per-tree numpy path (linear leaves included)
+    want = np.zeros(len(X))
+    for t in bst.trees:
+        want += t.predict(X)
+    np.testing.assert_array_equal(bst.predict(X, raw_score=True), want)

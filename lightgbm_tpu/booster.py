@@ -1802,14 +1802,14 @@ class Booster:
                           self.params.get("device_predict", False)))
                 and K == 1 and not es):
             # the stacked ensemble is model-constant: cache the padded
-            # arrays (and their device copies) across calls, invalidated
-            # when the tree slice changes (further training/rollback)
-            ck = (start_iteration, num_iteration, len(self.trees),
-                  self.cur_iter)
+            # arrays (and their device copies) across calls, keyed by
+            # the resolved slice's object identity (stale on any model
+            # replacement; in-place mutations invalidate explicitly)
+            ck = self._tree_slice_key(trees) if trees else None
             cached = getattr(self, "_pred_dev_cache", None)
-            stacked = cached[1] if cached and cached[0] == ck \
+            stacked = cached[1] if ck and cached and cached[0] == ck \
                 else self._stack_for_device(trees)
-            if stacked is not None:
+            if stacked is not None and X.shape[1] >= stacked["min_features"]:
                 self._pred_dev_cache = (ck, stacked)
                 raw = self._predict_raw_device(stacked, X)
                 if getattr(self, "_average_output", False) and len(trees):
@@ -1851,10 +1851,14 @@ class Booster:
                 # once, each call is pure traversal).  Exact f64 drop-in
                 # for the numpy path — same decision semantics, same
                 # tree-order summation — so no behavior flag is needed.
-                flat = self._flatten_for_native(
-                    trees, (start_iteration, num_iteration))
-                if flat is not None:
-                    from . import native
+                # The library check comes FIRST (no point flattening a
+                # model copy on toolchain-less hosts), and a too-narrow
+                # X skips to the numpy path so it raises the same
+                # IndexError it always did.
+                from . import native
+                flat = self._flatten_for_native(trees) \
+                    if native.get_lib() is not None else None
+                if flat is not None and X.shape[1] >= flat["min_features"]:
                     nr = native.predict_rows(flat, X)
                     if nr is not None:
                         raw[:, 0] = nr
@@ -1899,16 +1903,27 @@ class Booster:
             value[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
         return dict(feat=jnp.asarray(feat), thr=jnp.asarray(thr),
                     dtype=jnp.asarray(dtype_), left=jnp.asarray(left),
-                    right=jnp.asarray(right), value=jnp.asarray(value))
+                    right=jnp.asarray(right), value=jnp.asarray(value),
+                    min_features=int(feat.max()) + 1 if feat.size else 0)
 
-    def _flatten_for_native(self, trees: List[Tree], slice_key):
+    @staticmethod
+    def _tree_slice_key(trees: List[Tree]):
+        """Cache key pinning the RESOLVED tree slice by object identity
+        (first id + length determines a contiguous slice; a replaced
+        model — model_from_string, refit — allocates new Tree objects,
+        so stale hits are impossible even when counts coincide).
+        In-place mutations that keep identities must still call
+        `_invalidate_pred_caches`."""
+        return (len(trees), id(trees[0]), id(trees[-1]))
+
+    def _flatten_for_native(self, trees: List[Tree]):
         """Per-tree-concatenated contiguous model arrays for the native
         ensemble walk (`native.predict_rows`), cached across calls
         (single-row latency is dominated by setup otherwise).  None for
         shapes the walk does not cover (linear trees)."""
         if not trees or any(t.is_linear for t in trees):
             return None
-        ck = (slice_key, len(self.trees), self.cur_iter)
+        ck = self._tree_slice_key(trees)
         cached = getattr(self, "_pred_native_cache", None)
         if cached and cached[0] == ck:
             return cached[1]
@@ -1940,6 +1955,10 @@ class Booster:
         for k in offs:
             flat[f"{k}_off"] = np.asarray(offs[k], np.int64)
         flat["n_trees"] = len(trees)
+        # narrower X must fall back to the numpy path's IndexError, not
+        # read out of bounds in C
+        flat["min_features"] = int(flat["feat"].max()) + 1 \
+            if len(flat["feat"]) else 0
         self._pred_native_cache = (ck, flat)
         return flat
 
@@ -1956,7 +1975,8 @@ class Booster:
         from .ops.predict import predict_raw_ensemble
         if getattr(self, "_pred_dev_jit", None) is None:
             self._pred_dev_jit = jax.jit(predict_raw_ensemble)
-        out = self._pred_dev_jit(stacked,
+        arrays = {k: v for k, v in stacked.items() if k != "min_features"}
+        out = self._pred_dev_jit(arrays,
                                  jnp.asarray(X, dtype=jnp.float32))
         return np.asarray(jax.device_get(out), dtype=np.float64)
 
@@ -2091,7 +2111,10 @@ class Booster:
         self.metrics_ = create_metrics(
             self.config, self.config.metric or self.config.default_metric())
         self._fobj = None
-        # parse trees
+        # parse trees; the identity-keyed prediction caches are invalid
+        # the moment the model is replaced wholesale (belt-and-braces vs
+        # id() reuse after GC)
+        self._invalidate_pred_caches()
         text = "\n".join(lines[i:])
         self.trees = []
         for section in text.split("Tree=")[1:]:

@@ -46,37 +46,56 @@ def get_lib():
             return None
         try:
             lib = ctypes.CDLL(so)
+            _register(lib)
         except OSError:
             return None
-        lib.lgbtpu_parse_dense.restype = ctypes.c_int64
-        lib.lgbtpu_parse_dense.argtypes = [
-            ctypes.c_char_p, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.lgbtpu_parse_libsvm.restype = ctypes.c_int64
-        lib.lgbtpu_parse_libsvm.argtypes = [
-            ctypes.c_char_p, ctypes.c_void_p,
-            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.lgbtpu_values_to_bins.restype = None
-        lib.lgbtpu_values_to_bins.argtypes = [
-            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
-            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p]
-        lib.lgbtpu_stream_open.restype = ctypes.c_void_p
-        lib.lgbtpu_stream_open.argtypes = [
-            ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int32)]
-        lib.lgbtpu_stream_next.restype = ctypes.c_int64
-        lib.lgbtpu_stream_next.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
-        lib.lgbtpu_stream_close.restype = None
-        lib.lgbtpu_stream_close.argtypes = [ctypes.c_void_p]
-        lib.lgbtpu_predict_rows.restype = None
-        lib.lgbtpu_predict_rows.argtypes = [ctypes.c_void_p] * 13 + [
-            ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
-            ctypes.c_int64, ctypes.c_void_p]
+        except AttributeError:
+            # a cached .so predating a newly added symbol slipped past
+            # the mtime staleness check (archive extraction / docker
+            # COPY normalize mtimes) — rebuild once, else degrade to
+            # the numpy fallback as documented
+            so = _build()
+            if so is None:
+                return None
+            try:
+                lib = ctypes.CDLL(so)
+                _register(lib)
+            except (OSError, AttributeError):
+                return None
         _lib = lib
         return _lib
+
+
+def _register(lib) -> None:
+    """Bind every exported symbol's signature (raises AttributeError if
+    the loaded .so predates one — caller handles rebuild/fallback)."""
+    lib.lgbtpu_parse_dense.restype = ctypes.c_int64
+    lib.lgbtpu_parse_dense.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.lgbtpu_parse_libsvm.restype = ctypes.c_int64
+    lib.lgbtpu_parse_libsvm.argtypes = [
+        ctypes.c_char_p, ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.lgbtpu_values_to_bins.restype = None
+    lib.lgbtpu_values_to_bins.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_void_p]
+    lib.lgbtpu_stream_open.restype = ctypes.c_void_p
+    lib.lgbtpu_stream_open.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.lgbtpu_stream_next.restype = ctypes.c_int64
+    lib.lgbtpu_stream_next.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.lgbtpu_stream_close.restype = None
+    lib.lgbtpu_stream_close.argtypes = [ctypes.c_void_p]
+    lib.lgbtpu_predict_rows.restype = None
+    lib.lgbtpu_predict_rows.argtypes = [ctypes.c_void_p] * 13 + [
+        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_void_p]
 
 
 def predict_rows(flat, X: np.ndarray) -> Optional[np.ndarray]:

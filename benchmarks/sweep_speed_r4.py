@@ -25,10 +25,12 @@ N = int(sys.argv[1]) if len(sys.argv) > 1 else 2_000_000
 ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 48
 PER_CONFIG_TIMEOUT = float(os.environ.get("SWEEP_TIMEOUT", 420))
 
-# speed-sweep default: the TPU-relevant head of the shared table
-SPEED_DEFAULT = ["wave_w8_tail_auto+quant", "wave_w8_tail_auto",
-                 "wave_r3bench", "strict", "wave_w8_tail6+quant",
-                 "wave_r3bench+quant", "strict+quant"]
+# speed-sweep default: the TPU-relevant head of the shared table.
+# wave_w8_tail16 is the cross-seed-stable quality challenger (PROFILE r4
+# addendum); r3bench+tail is the shipped bench config.
+SPEED_DEFAULT = ["wave_r3bench+tail", "wave_w8_tail16", "wave_r3bench",
+                 "strict", "wave_w8_tail_auto+quant", "wave_w8_tail_auto",
+                 "strict+quant"]
 
 
 def child(name: str) -> None:

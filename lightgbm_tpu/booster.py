@@ -1818,8 +1818,9 @@ class Booster:
                     return raw
                 return np.asarray(jax.device_get(
                     self.objective_.convert_output(jnp.asarray(raw))))
-        raw = np.zeros((n, K), dtype=np.float64)
+        raw = None  # allocated by whichever path fills it
         if es and len(trees):
+            raw = np.zeros((n, K), dtype=np.float64)
             freq = int(kwargs.get(
                 "pred_early_stop_freq",
                 self.params.get("pred_early_stop_freq", 10)))
@@ -1844,26 +1845,26 @@ class Booster:
                     active &= ~decided
                     all_active = bool(active.all())
         else:
-            filled = False
-            if K == 1:
-                # native tight-loop ensemble walk (ref: predictor.hpp +
-                # c_api.cpp PredictSingleRowFast: model arrays resolved
-                # once, each call is pure traversal).  Exact f64 drop-in
-                # for the numpy path — same decision semantics, same
-                # tree-order summation — so no behavior flag is needed.
-                # The library check comes FIRST (no point flattening a
-                # model copy on toolchain-less hosts), and a too-narrow
-                # X skips to the numpy path so it raises the same
-                # IndexError it always did.
-                from . import native
-                flat = self._flatten_for_native(trees) \
-                    if native.get_lib() is not None else None
-                if flat is not None and X.shape[1] >= flat["min_features"]:
-                    nr = native.predict_rows(flat, X)
-                    if nr is not None:
-                        raw[:, 0] = nr
-                        filled = True
-            if not filled:
+            # native tight-loop ensemble walk (ref: predictor.hpp +
+            # c_api.cpp PredictSingleRowFast: model arrays resolved
+            # once, each call is pure traversal; tree i accumulates
+            # into class i % K like the reference's interleaving).
+            # Exact f64 drop-in for the numpy path — same decision
+            # semantics, same tree-order summation — so no behavior
+            # flag is needed.  The library check comes FIRST (no point
+            # flattening a model copy on toolchain-less hosts), and a
+            # too-narrow X skips to the numpy path so it raises the
+            # same IndexError it always did.
+            from . import native
+            nr = None
+            flat = self._flatten_for_native(trees) \
+                if native.get_lib() is not None else None
+            if flat is not None and X.shape[1] >= flat["min_features"]:
+                nr = native.predict_rows(flat, X, K)
+            if nr is not None:
+                raw = nr            # the C walk zero-inits and fills
+            else:
+                raw = np.zeros((n, K), dtype=np.float64)
                 for i, t in enumerate(trees):
                     raw[:, i % K] += t.predict(X)
         if getattr(self, "_average_output", False) and len(trees) >= K:

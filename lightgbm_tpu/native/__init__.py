@@ -66,9 +66,20 @@ def get_lib():
         return _lib
 
 
+# bump together with libnative.cpp lgbtpu_abi_version on ANY exported
+# signature change
+_ABI_VERSION = 2
+
+
 def _register(lib) -> None:
-    """Bind every exported symbol's signature (raises AttributeError if
-    the loaded .so predates one — caller handles rebuild/fallback)."""
+    """Bind every exported symbol's signature.  Raises AttributeError
+    for a stale cached .so — either a missing symbol or an ABI version
+    mismatch (same symbol, changed signature) — and the caller rebuilds
+    or degrades to the numpy fallback."""
+    lib.lgbtpu_abi_version.restype = ctypes.c_int32
+    lib.lgbtpu_abi_version.argtypes = []
+    if lib.lgbtpu_abi_version() != _ABI_VERSION:
+        raise AttributeError("libnative ABI version mismatch")
     lib.lgbtpu_parse_dense.restype = ctypes.c_int64
     lib.lgbtpu_parse_dense.argtypes = [
         ctypes.c_char_p, ctypes.c_void_p,
@@ -94,20 +105,22 @@ def _register(lib) -> None:
     lib.lgbtpu_stream_close.argtypes = [ctypes.c_void_p]
     lib.lgbtpu_predict_rows.restype = None
     lib.lgbtpu_predict_rows.argtypes = [ctypes.c_void_p] * 13 + [
-        ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
         ctypes.c_int64, ctypes.c_void_p]
 
 
-def predict_rows(flat, X: np.ndarray) -> Optional[np.ndarray]:
+def predict_rows(flat, X: np.ndarray, k_classes: int = 1
+                 ) -> Optional[np.ndarray]:
     """Raw-score ensemble prediction over `X` [n, F] f64 via the native
-    tree walk.  `flat` is the dict built by
+    tree walk: [n, K] with tree i accumulating into class i % K (the
+    reference's multiclass interleaving).  `flat` is the dict built by
     `Booster._flatten_for_native` (contiguous per-tree-concatenated node
     arrays + offsets).  None if the native library is unavailable."""
     lib = get_lib()
     if lib is None:
         return None
     X = np.ascontiguousarray(X, dtype=np.float64)
-    out = np.empty(X.shape[0], dtype=np.float64)
+    out = np.empty((X.shape[0], k_classes), dtype=np.float64)
 
     def p(a):
         return a.ctypes.data_as(ctypes.c_void_p)
@@ -117,7 +130,7 @@ def predict_rows(flat, X: np.ndarray) -> Optional[np.ndarray]:
         p(flat["right"]), p(flat["thr_bin"]), p(flat["leaf_value"]),
         p(flat["node_off"]), p(flat["leaf_off"]), p(flat["cb_off"]),
         p(flat["cat_bounds"]), p(flat["bits_off"]), p(flat["cat_bits"]),
-        ctypes.c_int64(flat["n_trees"]), p(X),
+        ctypes.c_int64(flat["n_trees"]), ctypes.c_int64(k_classes), p(X),
         ctypes.c_int64(X.shape[0]), ctypes.c_int64(X.shape[1]), p(out))
     return out
 

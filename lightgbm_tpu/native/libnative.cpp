@@ -278,6 +278,13 @@ void lgbtpu_values_to_bins(const double *vals, int64_t n,
 // (bit layout: 1 = categorical, 2 = default_left, bits 2-3 = missing type).
 // ---------------------------------------------------------------------------
 
+// bumped on ANY exported-signature change: the loader refuses a stale
+// cached .so whose symbols still resolve but marshal differently (the
+// mtime staleness check is defeated by archive/docker mtime
+// normalization, and a same-name signature change would otherwise read
+// scalars as pointers)
+int32_t lgbtpu_abi_version() { return 2; }
+
 static const double kZeroThreshold = 1e-35;
 
 void lgbtpu_predict_rows(
@@ -294,15 +301,16 @@ void lgbtpu_predict_rows(
     const int64_t *cat_bounds,  // concatenated per-tree cat_boundaries
     const int64_t *bits_off,    // [n_trees + 1] cat bitset word ranges
     const uint32_t *cat_bits,   // concatenated cat_threshold words
-    int64_t n_trees, const double *X, int64_t n_rows, int64_t n_feat,
-    double *out) {
+    int64_t n_trees, int64_t k_classes, const double *X, int64_t n_rows,
+    int64_t n_feat, double *out) {  // out: [n_rows, k_classes]
   for (int64_t r = 0; r < n_rows; ++r) {
     const double *x = X + r * n_feat;
-    double acc = 0.0;
+    double *acc = out + r * k_classes;
+    for (int64_t k = 0; k < k_classes; ++k) acc[k] = 0.0;
     for (int64_t t = 0; t < n_trees; ++t) {
       const int64_t nb = node_off[t];
       if (node_off[t + 1] == nb) {  // single-leaf tree: constant output
-        acc += leaf_value[leaf_off[t]];
+        acc[t % k_classes] += leaf_value[leaf_off[t]];
         continue;
       }
       int32_t nd = 0;
@@ -329,9 +337,8 @@ void lgbtpu_predict_rows(
         }
         nd = go_left ? left[g] : right[g];
       }
-      acc += leaf_value[leaf_off[t] + (~nd)];
+      acc[t % k_classes] += leaf_value[leaf_off[t] + (~nd)];
     }
-    out[r] = acc;
   }
 }
 

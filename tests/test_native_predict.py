@@ -76,6 +76,20 @@ def test_single_row_latency_path_and_slices():
 
 
 @needs_native
+def test_exact_parity_multiclass():
+    rng = np.random.RandomState(8)
+    X = rng.randn(2000, 6)
+    y = rng.randint(0, 3, 2000).astype(float)
+    bst = _train({"objective": "multiclass", "num_class": 3}, X, y,
+                 rounds=8)
+    got = bst.predict(X, raw_score=True)          # [n, 3]
+    want = np.zeros((2000, 3))
+    for i, t in enumerate(bst.trees):
+        want[:, i % 3] += t.predict(X)
+    np.testing.assert_array_equal(got, want)
+
+
+@needs_native
 def test_linear_trees_fall_back():
     rng = np.random.RandomState(6)
     X = rng.randn(1500, 4)

@@ -17,19 +17,32 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_DIR, "libnative.cpp")
-_SO = os.path.join(_DIR, f"libnative-{sys.platform}.so")
+# the ABI version rides in the FILENAME: dlopen caches handles by
+# pathname, so rebuilding a stale same-named .so would keep returning
+# the old image (reproduced in review) — a new name sidesteps the cache
+# entirely; the in-library lgbtpu_abi_version check remains as a
+# backstop against wrong-content files under the right name.  Bump both
+# together with any exported-signature change.
+_ABI_VERSION = 2
+_SO = os.path.join(_DIR, f"libnative-{sys.platform}-v{_ABI_VERSION}.so")
 _lock = threading.Lock()
 _lib = None
 _tried = False
 
 
 def _build() -> Optional[str]:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
-    try:
-        r = subprocess.run(cmd, capture_output=True, timeout=120)
-    except (OSError, subprocess.TimeoutExpired):
-        return None
-    return _SO if r.returncode == 0 and os.path.exists(_SO) else None
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
+            "-o", _SO]
+    # OpenMP first (the prediction walk parallelizes over rows like the
+    # reference's Predictor); retry serial on toolchains without it
+    for cmd in (base[:1] + ["-fopenmp"] + base[1:], base):
+        try:
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if r.returncode == 0 and os.path.exists(_SO):
+            return _SO
+    return None
 
 
 def get_lib():
@@ -42,33 +55,24 @@ def get_lib():
         so = _SO if (os.path.exists(_SO)
                      and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)) \
             else _build()
-        if so is None:
-            return None
-        try:
-            lib = ctypes.CDLL(so)
-            _register(lib)
-        except OSError:
-            return None
-        except AttributeError:
-            # a cached .so predating a newly added symbol slipped past
-            # the mtime staleness check (archive extraction / docker
-            # COPY normalize mtimes) — rebuild once, else degrade to
-            # the numpy fallback as documented
-            so = _build()
+        # one rebuild attempt covers every stale-artifact failure: a .so
+        # missing a symbol / failing the ABI check (AttributeError), or
+        # one whose runtime deps are absent on this host, e.g. a
+        # -fopenmp build shipped without libgomp (OSError — the serial
+        # retry inside _build handles that).  A second failure degrades
+        # to the numpy fallback as documented.
+        for attempt in range(2):
             if so is None:
                 return None
             try:
                 lib = ctypes.CDLL(so)
                 _register(lib)
             except (OSError, AttributeError):
-                return None
-        _lib = lib
-        return _lib
-
-
-# bump together with libnative.cpp lgbtpu_abi_version on ANY exported
-# signature change
-_ABI_VERSION = 2
+                so = _build() if attempt == 0 else None
+                continue
+            _lib = lib
+            return _lib
+        return None
 
 
 def _register(lib) -> None:

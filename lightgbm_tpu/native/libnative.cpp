@@ -303,6 +303,17 @@ void lgbtpu_predict_rows(
     const uint32_t *cat_bits,   // concatenated cat_threshold words
     int64_t n_trees, int64_t k_classes, const double *X, int64_t n_rows,
     int64_t n_feat, double *out) {  // out: [n_rows, k_classes]
+  // rows are independent — the same axis the reference's Predictor
+  // parallelizes with OpenMP (predictor.hpp); a no-OpenMP toolchain
+  // just compiles this serial (the Python builder retries without
+  // -fopenmp).  The if-clause keeps the single-/few-row latency path
+  // out of the parallel region (no barrier/dispatch overhead, and
+  // fork()ed children doing small predicts never touch libgomp, which
+  // is not fork-safe; large batch predicts in forked workers should
+  // use spawn).
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) if (n_rows > 64)
+#endif
   for (int64_t r = 0; r < n_rows; ++r) {
     const double *x = X + r * n_feat;
     double *acc = out + r * k_classes;

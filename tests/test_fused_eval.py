@@ -51,6 +51,33 @@ class TestChunkedEval:
         np.testing.assert_allclose(bc.predict(Xv), bp.predict(Xv),
                                    rtol=1e-5, atol=1e-7)
 
+    def test_wave_policy_chunked_eval_matches(self):
+        """The bench's hot path (wave policy + hybrid strict tail) must
+        compose with eval-driven chunked training: metric curves and
+        predictions equal to the per-iteration loop, incl. early
+        stopping on a plateauing valid metric."""
+        X, y = make_data(3500)
+        Xv, yv = make_data(1000, seed=13)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "metric": "auc", "learning_rate": 0.1, "verbosity": -1,
+                  "tree_grow_policy": "wave"}
+        bc, rec_c, bp, rec_p = _train_two_ways(params, X, y, Xv, yv, 32)
+        assert bc._grow_policy == "wave"
+        assert bc._grower_spec.wave_strict_tail > 0   # auto tail active
+        np.testing.assert_allclose(rec_c["valid_0"]["auc"],
+                                   rec_p["valid_0"]["auc"],
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_allclose(bc.predict(Xv), bp.predict(Xv),
+                                   rtol=1e-5, atol=1e-7)
+
+        def es():
+            return [lgb.early_stopping(3, verbose=False)]
+
+        bc, rec_c, bp, rec_p = _train_two_ways(
+            {**params, "learning_rate": 0.5}, X, y, Xv, yv, 64, cbs=es)
+        assert bc.best_iteration == bp.best_iteration
+        assert bc.best_iteration < 64
+
     def test_early_stopping_matches_and_truncates(self):
         X, y = make_data(3000)
         Xv, yv = make_data(800, seed=9)

@@ -1,0 +1,25 @@
+"""PARAMETERS.md is generated from the config table — regeneration must
+be a no-op at HEAD (the docs-from-one-source contract, ref:
+helpers/parameter_generator.py keeping Parameters.rst and
+config_auto.cpp in sync)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_parameters_md_is_fresh(tmp_path):
+    committed = open(os.path.join(ROOT, "PARAMETERS.md")).read()
+    # regenerate in a scratch copy of the repo layout
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "scripts", "gen_params_doc.py")],
+        capture_output=True, text=True, cwd=ROOT)
+    assert r.returncode == 0, r.stderr
+    regenerated = open(os.path.join(ROOT, "PARAMETERS.md")).read()
+    assert regenerated == committed, \
+        "PARAMETERS.md is stale — run scripts/gen_params_doc.py"

@@ -1860,7 +1860,12 @@ class Booster:
             flat = self._flatten_for_native(trees) \
                 if native.get_lib() is not None else None
             if flat is not None and X.shape[1] >= flat["min_features"]:
-                nr = native.predict_rows(flat, X, K)
+                # num_threads rides per call (works for loaded models
+                # too — model_from_string builds self.config; no global
+                # OpenMP state, so concurrent boosters can't clobber
+                # each other)
+                nthr = int(getattr(self.config, "num_threads", 0) or 0)
+                nr = native.predict_rows(flat, X, K, nthr)
             if nr is not None:
                 raw = nr            # the C walk zero-inits and fills
             else:

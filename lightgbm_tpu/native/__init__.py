@@ -23,7 +23,7 @@ _SRC = os.path.join(_DIR, "libnative.cpp")
 # entirely; the in-library lgbtpu_abi_version check remains as a
 # backstop against wrong-content files under the right name.  Bump both
 # together with any exported-signature change.
-_ABI_VERSION = 2
+_ABI_VERSION = 3
 _SO = os.path.join(_DIR, f"libnative-{sys.platform}-v{_ABI_VERSION}.so")
 _lock = threading.Lock()
 _lib = None
@@ -109,17 +109,18 @@ def _register(lib) -> None:
     lib.lgbtpu_stream_close.argtypes = [ctypes.c_void_p]
     lib.lgbtpu_predict_rows.restype = None
     lib.lgbtpu_predict_rows.argtypes = [ctypes.c_void_p] * 13 + [
-        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64,
-        ctypes.c_int64, ctypes.c_void_p]
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p]
 
 
-def predict_rows(flat, X: np.ndarray, k_classes: int = 1
-                 ) -> Optional[np.ndarray]:
+def predict_rows(flat, X: np.ndarray, k_classes: int = 1,
+                 num_threads: int = 0) -> Optional[np.ndarray]:
     """Raw-score ensemble prediction over `X` [n, F] f64 via the native
     tree walk: [n, K] with tree i accumulating into class i % K (the
     reference's multiclass interleaving).  `flat` is the dict built by
     `Booster._flatten_for_native` (contiguous per-tree-concatenated node
-    arrays + offsets).  None if the native library is unavailable."""
+    arrays + offsets); `num_threads` <= 0 keeps the OpenMP default and
+    applies per call.  None if the native library is unavailable."""
     lib = get_lib()
     if lib is None:
         return None
@@ -134,7 +135,8 @@ def predict_rows(flat, X: np.ndarray, k_classes: int = 1
         p(flat["right"]), p(flat["thr_bin"]), p(flat["leaf_value"]),
         p(flat["node_off"]), p(flat["leaf_off"]), p(flat["cb_off"]),
         p(flat["cat_bounds"]), p(flat["bits_off"]), p(flat["cat_bits"]),
-        ctypes.c_int64(flat["n_trees"]), ctypes.c_int64(k_classes), p(X),
+        ctypes.c_int64(flat["n_trees"]), ctypes.c_int64(k_classes),
+        ctypes.c_int32(int(num_threads)), p(X),
         ctypes.c_int64(X.shape[0]), ctypes.c_int64(X.shape[1]), p(out))
     return out
 

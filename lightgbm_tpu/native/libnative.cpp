@@ -15,6 +15,9 @@
 #include <cmath>
 #include <vector>
 #include <string>
+#ifdef _OPENMP
+#include <omp.h>
+#endif
 
 extern "C" {
 
@@ -283,7 +286,7 @@ void lgbtpu_values_to_bins(const double *vals, int64_t n,
 // mtime staleness check is defeated by archive/docker mtime
 // normalization, and a same-name signature change would otherwise read
 // scalars as pointers)
-int32_t lgbtpu_abi_version() { return 2; }
+int32_t lgbtpu_abi_version() { return 3; }
 
 static const double kZeroThreshold = 1e-35;
 
@@ -301,8 +304,9 @@ void lgbtpu_predict_rows(
     const int64_t *cat_bounds,  // concatenated per-tree cat_boundaries
     const int64_t *bits_off,    // [n_trees + 1] cat bitset word ranges
     const uint32_t *cat_bits,   // concatenated cat_threshold words
-    int64_t n_trees, int64_t k_classes, const double *X, int64_t n_rows,
-    int64_t n_feat, double *out) {  // out: [n_rows, k_classes]
+    int64_t n_trees, int64_t k_classes, int32_t num_threads,
+    const double *X, int64_t n_rows, int64_t n_feat,
+    double *out) {  // out: [n_rows, k_classes]
   // rows are independent — the same axis the reference's Predictor
   // parallelizes with OpenMP (predictor.hpp); a no-OpenMP toolchain
   // just compiles this serial (the Python builder retries without
@@ -310,9 +314,15 @@ void lgbtpu_predict_rows(
   // out of the parallel region (no barrier/dispatch overhead, and
   // fork()ed children doing small predicts never touch libgomp, which
   // is not fork-safe; large batch predicts in forked workers should
-  // use spawn).
+  // use spawn).  num_threads rides PER CALL (ref: config.h num_threads
+  // -> OMP_NUM_THREADS in c_api.cpp) — no process-global ICV games, so
+  // concurrent boosters with different settings can't clobber each
+  // other; <= 0 keeps the OpenMP default.
 #ifdef _OPENMP
-#pragma omp parallel for schedule(static) if (n_rows > 64)
+#pragma omp parallel for schedule(static) if (n_rows > 64) \
+    num_threads(num_threads > 0 ? num_threads : omp_get_max_threads())
+#else
+  (void)num_threads;
 #endif
   for (int64_t r = 0; r < n_rows; ++r) {
     const double *x = X + r * n_feat;

@@ -15,11 +15,13 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def test_parameters_md_is_fresh(tmp_path):
     committed = open(os.path.join(ROOT, "PARAMETERS.md")).read()
-    # regenerate in a scratch copy of the repo layout
+    # regenerate to a SCRATCH path so a stale doc fails without
+    # mutating (and thereby self-healing) the checkout
+    scratch = str(tmp_path / "PARAMETERS.md")
     r = subprocess.run(
-        [sys.executable, os.path.join(ROOT, "scripts", "gen_params_doc.py")],
+        [sys.executable, os.path.join(ROOT, "scripts", "gen_params_doc.py"),
+         scratch],
         capture_output=True, text=True, cwd=ROOT)
     assert r.returncode == 0, r.stderr
-    regenerated = open(os.path.join(ROOT, "PARAMETERS.md")).read()
-    assert regenerated == committed, \
+    assert open(scratch).read() == committed, \
         "PARAMETERS.md is stale — run scripts/gen_params_doc.py"

@@ -39,6 +39,12 @@ for policy in ("leafwise", "wave"):
                      "tree_grow_policy": policy}},
                     lgb.Dataset(X, label=y), num_boost_round=8)
     out[policy] = bst.predict(X[:200]).tolist()
+    # device batch path vs the host walk ON THIS BACKEND (f32 routing
+    # tolerance; exercises the jitted stacked-ensemble traversal on the
+    # real chip when it answers)
+    dev = bst.predict(X[:200], device_predict=True)
+    out[policy + "_dev_delta"] = float(
+        np.max(np.abs(dev - np.asarray(out[policy]))))
 print("RESULT " + json.dumps(out))
 """
 
@@ -82,3 +88,6 @@ def test_tpu_matches_cpu_when_chip_answers(tmp_path):
                                    np.asarray(cpu[policy]),
                                    rtol=2e-4, atol=2e-5,
                                    err_msg=f"policy={policy}")
+        # device traversal agreed with the host walk on both backends
+        assert tpu[policy + "_dev_delta"] < 1e-3, policy
+        assert cpu[policy + "_dev_delta"] < 1e-3, policy

@@ -305,6 +305,16 @@ class TestCV:
                       "verbosity": -1}, ds, 8, nfold=3)
         assert res["valid auc-mean"][-1] > 0.85
 
+    def test_cv_wave_policy(self):
+        # CV folds train under the TPU-first growth policy (short jobs:
+        # the compile-time fixes matter exactly here)
+        X, y = make_binary(900)
+        ds = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "binary", "metric": "auc",
+                      "verbosity": -1, "tree_grow_policy": "wave"},
+                     ds, 8, nfold=3)
+        assert res["valid auc-mean"][-1] > 0.85
+
 
 class TestMissing:
     def test_nan_handling(self):

@@ -90,6 +90,27 @@ def test_exact_parity_multiclass():
 
 
 @needs_native
+def test_constant_and_stump_models():
+    # min_gain so high no split ever fires: every tree is a single leaf
+    # (the C walk's empty-node-range branch) — predictions are the
+    # boost_from_average constant, exactly as the numpy path computes
+    rng = np.random.RandomState(7)
+    X = rng.randn(800, 4)
+    y = X[:, 0] + 0.1 * rng.randn(800)
+    bst = _train({"objective": "regression", "min_gain_to_split": 1e18},
+                 X, y, rounds=5)
+    assert all(t.num_leaves == 1 for t in bst.trees)
+    got = bst.predict(X, raw_score=True)
+    np.testing.assert_array_equal(got, _numpy_raw(bst, X))
+    np.testing.assert_allclose(got, np.full(800, y.mean()), rtol=1e-6)
+    # depth-1 stumps (num_leaves=2) keep parity too
+    stump = _train({"objective": "regression", "num_leaves": 2}, X, y,
+                   rounds=6)
+    np.testing.assert_array_equal(stump.predict(X, raw_score=True),
+                                  _numpy_raw(stump, X))
+
+
+@needs_native
 def test_linear_trees_fall_back():
     rng = np.random.RandomState(6)
     X = rng.randn(1500, 4)

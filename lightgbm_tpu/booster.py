@@ -359,6 +359,7 @@ class Booster:
             wave_overgrow=self._wave_overgrow(),
             wave_strict_tail=self._wave_strict_tail(),
             has_cat=bool(np.asarray(self._dd.is_cat).any()),
+            debug_checks=bool(self.config.tpu_debug_nans),
         )
         self._grow_policy = self._resolve_grow_policy()
         self._rng_key0 = jax.random.PRNGKey(
@@ -1809,8 +1810,12 @@ class Booster:
             cached = getattr(self, "_pred_dev_cache", None)
             stacked = cached[1] if ck and cached and cached[0] == ck \
                 else self._stack_for_device(trees)
-            if stacked is not None and X.shape[1] >= stacked["min_features"]:
+            # cache as soon as stacking succeeds — BEFORE the X-width
+            # gate, so repeated too-narrow predict calls don't re-stack
+            # (and re-upload) the full model each time (ADVICE r4)
+            if ck and stacked is not None:
                 self._pred_dev_cache = (ck, stacked)
+            if stacked is not None and X.shape[1] >= stacked["min_features"]:
                 raw = self._predict_raw_device(stacked, X)
                 if getattr(self, "_average_output", False) and len(trees):
                     raw = raw / max(len(trees), 1)

@@ -30,19 +30,39 @@ _lib = None
 _tried = False
 
 
-def _build() -> Optional[str]:
+def _build(out: str = None, openmp: bool = True) -> Optional[str]:
+    out = out or _SO
     base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-            "-o", _SO]
+            "-o", out]
     # OpenMP first (the prediction walk parallelizes over rows like the
-    # reference's Predictor); retry serial on toolchains without it
-    for cmd in (base[:1] + ["-fopenmp"] + base[1:], base):
+    # reference's Predictor); retry serial on toolchains without it.
+    # openmp=False skips straight to serial — for hosts where the
+    # -fopenmp COMPILE succeeds but dlopen fails at runtime (libgomp
+    # missing), which a compile-level retry can never detect.
+    cmds = ([base[:1] + ["-fopenmp"] + base[1:]] if openmp else []) + [base]
+    for cmd in cmds:
         try:
             r = subprocess.run(cmd, capture_output=True, timeout=120)
         except (OSError, subprocess.TimeoutExpired):
             return None
-        if r.returncode == 0 and os.path.exists(_SO):
-            return _SO
+        if r.returncode == 0 and os.path.exists(out):
+            return out
     return None
+
+
+def _retry_path(attempt: int) -> str:
+    # retries build to a UNIQUE filename: ctypes never dlcloses, and
+    # dlopen caches by pathname — rewriting the failed path can hand the
+    # second CDLL the stale mapped image (same dev/inode), silently
+    # discarding a good rebuild
+    path = os.path.join(
+        _DIR, f"libnative-{sys.platform}-v{_ABI_VERSION}"
+              f"-r{os.getpid()}.{attempt}.so")
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    return path
 
 
 def get_lib():
@@ -52,27 +72,59 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        so = _SO if (os.path.exists(_SO)
-                     and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)) \
-            else _build()
-        # one rebuild attempt covers every stale-artifact failure: a .so
-        # missing a symbol / failing the ABI check (AttributeError), or
-        # one whose runtime deps are absent on this host, e.g. a
-        # -fopenmp build shipped without libgomp (OSError — the serial
-        # retry inside _build handles that).  A second failure degrades
-        # to the numpy fallback as documented.
-        for attempt in range(2):
-            if so is None:
-                return None
-            try:
-                lib = ctypes.CDLL(so)
-                _register(lib)
-            except (OSError, AttributeError):
-                so = _build() if attempt == 0 else None
-                continue
-            _lib = lib
-            return _lib
-        return None
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            so, fresh = _SO, False
+        else:
+            so, fresh = _build(), True
+        # retry ladder over stale-artifact failures: a .so missing a
+        # symbol / failing the ABI check (AttributeError), or one whose
+        # runtime deps are absent on this host, e.g. a -fopenmp build
+        # shipped without libgomp (OSError).  Each retry rebuilds to a
+        # unique filename (_retry_path), and an OSError from a FRESHLY
+        # built .so — the compile worked, the runtime dep is missing —
+        # drops -fopenmp for the next build.  Exhausting the ladder
+        # degrades to the numpy fallback as documented.
+        openmp = True
+        retries = []
+        try:
+            for attempt in range(3):
+                if so is None:
+                    return None
+                try:
+                    lib = ctypes.CDLL(so)
+                    _register(lib)
+                except (OSError, AttributeError) as e:
+                    if attempt == 2:
+                        return None   # ladder exhausted — numpy fallback
+                    if isinstance(e, OSError) and fresh:
+                        openmp = False
+                    so, fresh = _build(_retry_path(attempt), openmp), True
+                    if so is not None:
+                        retries.append(so)
+                    continue
+                if so != _SO:
+                    # promote the good rebuild over the canonical name
+                    # so future processes skip this ladder — atomic
+                    # rename of a fresh copy (never rewrite a mapped
+                    # inode in place); unlinking the retry name below is
+                    # safe on Linux, the mapped inode outlives the entry
+                    try:
+                        import shutil
+                        tmp = so + ".promote"
+                        shutil.copy2(so, tmp)
+                        os.replace(tmp, _SO)
+                    except OSError:
+                        pass
+                _lib = lib
+                return _lib
+            return None
+        finally:
+            for p in retries:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
 
 
 def _register(lib) -> None:

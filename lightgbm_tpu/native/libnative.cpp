@@ -343,9 +343,25 @@ void lgbtpu_predict_rows(
         if (dt & 1) {  // categorical: category in bitset -> left
           const int64_t lo = cat_bounds[cb_off[t] + thr_bin[g]];
           const int64_t hi = cat_bounds[cb_off[t] + thr_bin[g] + 1];
-          const int64_t v = std::isnan(fv) ? -1 : (int64_t)fv;
-          go_left = v >= 0 && v < (hi - lo) * 32 &&
-                    ((cat_bits[bits_off[t] + lo + v / 32] >> (v % 32)) & 1u);
+          // range-check on the DOUBLE before narrowing: (int64_t)fv is
+          // UB for values outside int64 range (inf, 1e300, ...), and
+          // the bitset span always fits a double exactly, so comparing
+          // in double space keeps every input defined and matches the
+          // numpy host path (out-of-range / NaN -> right child).  The
+          // lower bound is EXCLUSIVE -1: truncation toward zero maps
+          // (-1, 0) to category 0, the reference's semantics
+          // (tree.h CategoricalDecision does (int)fval)
+          // span <= 0 (an empty bitset range, accepted by the model-text
+          // loader though never produced by training) must route right
+          // BEFORE the (-1, 0)->0 truncation path can index the bitset
+          const double span = (double)((hi - lo) * 32);
+          if (std::isnan(fv) || fv <= -1.0 || fv >= span || span <= 0.0) {
+            go_left = false;
+          } else {
+            const int64_t v = (int64_t)fv;  // defined: fv in (-1, span)
+            go_left =
+                ((cat_bits[bits_off[t] + lo + v / 32] >> (v % 32)) & 1u);
+          }
         } else {
           const int32_t missing_type = (dt >> 2) & 3;
           const bool default_left = (dt & 2) != 0;

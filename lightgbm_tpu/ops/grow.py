@@ -133,6 +133,12 @@ class GrowerSpec(NamedTuple):
     # False = every feature is numerical (static): the split finder skips
     # the categorical cases — four [F, MB] argsorts per call
     has_cat: bool = True
+    # debug mode (tpu_debug_nans): enable host-callback precondition
+    # checks inside the traced step — currently the quantized lattice's
+    # w ∈ {0, 1} invariant (pallas_hist.quantized_lattice_rows).  Part
+    # of the spec so flipping it re-traces instead of reusing a cached
+    # check-free program
+    debug_checks: bool = False
     # monotone_constraints_method=intermediate (ref:
     # monotone_constraints.hpp `IntermediateLeafConstraints`): per-leaf
     # bounds are recomputed every split from the CURRENT outputs of the
@@ -485,7 +491,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 pallas_histogram_multi_quantized_rows,
                 quantized_lattice_rows)
             pw_prep = quantized_lattice_rows(payload, feat["qscales"][0],
-                                             feat["qscales"][1])
+                                             feat["qscales"][1],
+                                             debug=spec.debug_checks)
         one_slot = jnp.zeros((1,), jnp.int32)
 
         def hist_of(mask_rows):

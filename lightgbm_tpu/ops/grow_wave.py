@@ -164,7 +164,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                 pallas_histogram_multi_quantized_rows,
                 quantized_lattice_rows)
             pw_prep = quantized_lattice_rows(payload, feat["qscales"][0],
-                                             feat["qscales"][1])
+                                             feat["qscales"][1],
+                                             debug=spec.debug_checks)
 
         # data_rs: each shard stores/searches only its feature block
         # (the SAME shared machinery as the strict grower's block path)

@@ -346,13 +346,31 @@ def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
         interpret=interpret)
 
 
-def quantized_lattice_rows(payload: Array, s_g: Array, s_h: Array) -> Array:
+def quantized_lattice_rows(payload: Array, s_g: Array, s_h: Array, *,
+                           debug: bool = False) -> Array:
     """[N, 3] quantized payload -> [3, N] int8 lattice rows: |gq|, hq <=
     num_grad_quant_bins (booster-gated <= 15), w in {0, 1} — exact in
     int8, 2x MXU rate vs bf16.
 
     PRECONDITION: payload[:, 2] ∈ {0, 1} (see pallas_histogram_quantized)
-    — fractional weights are binarized, corrupting the count channel."""
+    — fractional weights are binarized, corrupting the count channel.
+    `debug=True` (booster: tpu_debug_nans) enforces it with a host
+    callback: eager callers get the FloatingPointError directly; under
+    jit it surfaces as a runtime error at the next sync point with the
+    same "precondition violated" message (verified for the jitted path
+    in test_debug_mode.py)."""
+    if debug:
+        def _check_w(w):
+            import numpy as np
+            bad = int(np.count_nonzero((w != 0.0) & (w != 1.0)))
+            if bad:
+                raise FloatingPointError(
+                    f"quantized histogram precondition violated: {bad} "
+                    "weight(s) outside {0, 1} — the int8 lattice "
+                    "binarizes the count channel; quantized grads "
+                    "require binary bagging weights (the Booster's "
+                    "quant_ok gate excludes fractional-weight modes)")
+        jax.debug.callback(_check_w, payload[:, 2])
     gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int8)
     hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int8)
     w = (payload[:, 2] != 0).astype(jnp.int8)

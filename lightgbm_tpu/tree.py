@@ -250,13 +250,24 @@ class Tree:
         """Vectorized CategoricalDecision (ref: tree.h `Tree::CategoricalDecision`:
         int category in the node's bitset → left)."""
         out = np.zeros(len(node), dtype=bool)
-        isnan = np.isnan(fval)
-        ival = np.where(isnan, -1, fval).astype(np.int64)
+        # range-check in double space before narrowing: casting ±inf /
+        # 1e300 to int64 is implementation-defined (numpy warns, C is UB)
+        # — anything at or beyond int64 range can never be in a bitset,
+        # so map it to the right-child sentinel first.  The lower bound
+        # is EXCLUSIVE -1, not 0: the reference truncates toward zero
+        # ((int)(-0.5) == 0, tree.h CategoricalDecision), so fractional
+        # values in (-1, 0) test category 0.  Mirrors libnative.cpp.
+        with np.errstate(invalid="ignore"):
+            in_range = (fval > -1.0) & (fval < 2.0 ** 62)
+        ival = np.where(in_range, fval, -1).astype(np.int64)
         for u in np.unique(node):
             sel = node == u
             cat_idx = self.threshold_bin[u]  # index into cat_boundaries
             lo = self.cat_boundaries[cat_idx]
             hi = self.cat_boundaries[cat_idx + 1]
+            if hi <= lo:
+                continue   # empty bitset span (loader-accepted): no
+                # category can be in-set — every row routes right
             bitset = self.cat_threshold[lo:hi]
             v = ival[sel]
             ok = (v >= 0) & (v < (hi - lo) * 32)

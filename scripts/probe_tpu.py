@@ -1,0 +1,131 @@
+"""Instrumented TPU-tunnel probe (VERDICT r4 #7).
+
+Each invocation spawns ONE killable subprocess that tries to initialise the
+default JAX backend (the axon remote-TPU tunnel on this box) with verbose
+backend logging enabled, and appends ONE JSON record to PROBE_LOG.jsonl at
+the repo root — success or hang alike — so the tunnel's behavior becomes a
+diagnosable artifact for the infra owner instead of session folklore.
+
+Record fields:
+  ts            ISO-8601 UTC of probe start
+  outcome       "ok" | "hung" | "error" | "spawn-failed"
+  elapsed_sec   wall time of the child (to kill, for hangs)
+  timeout_sec   the budget the child was given
+  platform/n_devices   on success
+  stdout_tail / stderr_tail   last 2000 chars each (backend init logs ride
+                in stderr because TF_CPP_MIN_LOG_LEVEL=0 + JAX verbose
+                logging are forced in the child env)
+  env           the axon-relevant env vars the child saw
+
+Usage:
+  python scripts/probe_tpu.py [--timeout 30] [--label "pre-sweep"]
+Exit code: 0 if the backend answered, 1 otherwise (so shell chains like
+`probe && sweep` stay honest).
+
+The parent process NEVER imports jax — a wedged tunnel hangs jax.devices()
+in uninterruptible C++ (see bench.py docstring); only subprocess+kill
+survives it.
+"""
+from __future__ import annotations
+
+import argparse
+import datetime
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG_PATH = os.path.join(REPO, "PROBE_LOG.jsonl")
+
+AXON_KEYS = ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
+             "PALLAS_AXON_REMOTE_COMPILE", "AXON_LOOPBACK_RELAY",
+             "TPU_SKIP_MDS_QUERY", "PYTHONPATH")
+
+CHILD_CODE = r"""
+import os, sys, time
+t0 = time.time()
+def mark(msg):
+    print(f"[probe-child +{time.time()-t0:6.2f}s] {msg}", file=sys.stderr,
+          flush=True)
+mark("importing jax")
+import jax
+mark(f"jax {jax.__version__} imported")
+mark("calling jax.devices() (backend init)")
+d = jax.devices()
+mark(f"devices up: {[str(x) for x in d]}")
+import jax.numpy as jnp
+x = jnp.ones((256, 256), jnp.bfloat16)
+mark("compiling+running matmul")
+y = (x @ x)
+import numpy as np
+s = float(np.asarray(y[:2, :2]).sum())   # np.asarray forces real transfer
+mark(f"matmul done, checksum {s}")
+print(f"@ok {d[0].platform} {len(d)} {time.time()-t0:.2f}")
+"""
+
+
+def probe(timeout: float, label: str) -> bool:
+    env = dict(os.environ)
+    # force backend init logging into the child's stderr
+    env.setdefault("TF_CPP_MIN_LOG_LEVEL", "0")
+    env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
+    rec = {
+        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
+            timespec="seconds"),
+        "label": label,
+        "timeout_sec": timeout,
+        "env": {k: env.get(k) for k in AXON_KEYS if k in env},
+    }
+    t0 = time.time()
+    try:
+        r = subprocess.run([sys.executable, "-c", CHILD_CODE],
+                           capture_output=True, timeout=timeout, env=env,
+                           text=True)
+        rec["elapsed_sec"] = round(time.time() - t0, 2)
+        rec["stdout_tail"] = r.stdout[-2000:]
+        rec["stderr_tail"] = r.stderr[-2000:]
+        ok_line = next((l for l in r.stdout.splitlines()
+                        if l.startswith("@ok ")), None)
+        if r.returncode == 0 and ok_line:
+            _, plat, nd, secs = ok_line.split()
+            rec.update(outcome="ok", platform=plat, n_devices=int(nd),
+                       init_sec=float(secs))
+        else:
+            rec.update(outcome="error", returncode=r.returncode)
+    except subprocess.TimeoutExpired as e:
+        rec["elapsed_sec"] = round(time.time() - t0, 2)
+        rec["outcome"] = "hung"
+        # TimeoutExpired carries whatever the child wrote before the kill —
+        # this is the diagnostic payload: how far did backend init get?
+        rec["stdout_tail"] = (e.stdout or b"")[-2000:].decode(
+            "utf-8", "replace") if isinstance(e.stdout, bytes) else (
+            e.stdout or "")[-2000:]
+        rec["stderr_tail"] = (e.stderr or b"")[-2000:].decode(
+            "utf-8", "replace") if isinstance(e.stderr, bytes) else (
+            e.stderr or "")[-2000:]
+    except OSError as e:
+        rec["elapsed_sec"] = round(time.time() - t0, 2)
+        rec.update(outcome="spawn-failed", error=str(e))
+
+    with open(LOG_PATH, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    ok = rec["outcome"] == "ok"
+    print(f"[probe] {rec['outcome']} in {rec['elapsed_sec']}s"
+          + (f" — {rec.get('platform')}x{rec.get('n_devices')}" if ok else "")
+          + f" (logged to {os.path.basename(LOG_PATH)})",
+          file=sys.stderr, flush=True)
+    if not ok:
+        tail = (rec.get("stderr_tail") or "").strip().splitlines()[-6:]
+        for l in tail:
+            print(f"[probe]   {l}", file=sys.stderr, flush=True)
+    return ok
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=float, default=30.0)
+    ap.add_argument("--label", default="")
+    a = ap.parse_args()
+    sys.exit(0 if probe(a.timeout, a.label) else 1)

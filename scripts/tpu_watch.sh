@@ -1,0 +1,27 @@
+#!/bin/bash
+# TPU tunnel watcher (round 5).  Re-probes the axon tunnel on an interval;
+# the moment a chip answers, fires the staged round-4/5 measurement stack
+# IN PRIORITY ORDER (VERDICT r4 #1: "the measurement must come first, not
+# last" — the wedge follows sustained load):
+#   1. benchmarks/sweep_speed_r4.py at 2M  (the hybrid-tail decider)
+#   2. bench.py                            (the round's headline line)
+# then exits so the driver of this session sees the results.
+# Every probe is appended to PROBE_LOG.jsonl by probe_tpu.py.
+cd "$(dirname "$0")/.." || exit 1
+INTERVAL="${TPU_WATCH_INTERVAL:-400}"
+i=0
+while true; do
+  i=$((i+1))
+  if python scripts/probe_tpu.py --timeout 45 --label "watcher-$i"; then
+    date -u +"%FT%TZ tunnel ALIVE — firing staged measurements" \
+      | tee -a tpu_watch.log
+    touch .tpu_alive
+    SWEEP_TIMEOUT=420 python benchmarks/sweep_speed_r4.py 2000000 48 \
+      2>&1 | tee SWEEP_r5_tpu.log
+    BENCH_WALL_BUDGET=540 python bench.py \
+      > BENCH_r5_tpu.json 2> bench_r5_tpu.log
+    date -u +"%FT%TZ staged measurements done" | tee -a tpu_watch.log
+    exit 0
+  fi
+  sleep "$INTERVAL"
+done

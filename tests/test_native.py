@@ -96,3 +96,47 @@ def test_cli_train_with_native_parser(tmp_path):
                   "num_leaves=7", "num_iterations=3", "verbosity=-1",
                   f"output_model={model}"])
     assert rc == 0 and os.path.exists(model)
+
+
+def test_loader_recovers_from_corrupt_canonical_so():
+    """Retry-ladder behavior (ADVICE r4): a corrupt .so under the
+    canonical name must not end in the numpy fallback — the loader
+    rebuilds to a UNIQUE retry filename (dlopen caches by pathname),
+    loads that, and promotes the good image back over the canonical
+    path for future processes.  Runs in a subprocess so this process's
+    mapped library and module-level cache stay untouched."""
+    import subprocess
+    import sys
+    import textwrap
+
+    import lightgbm_tpu.native as native
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = textwrap.dedent(f"""
+        import glob, os, sys
+        sys.path.insert(0, {root!r})
+        import lightgbm_tpu.native as native
+        so = native._SO
+        assert os.path.exists(so), "canonical .so missing"
+        os.rename(so, so + ".bak")   # rename keeps the good inode safe
+        with open(so, "wb") as f:
+            f.write(b"this is not an ELF file")
+        try:
+            lib = native.get_lib()
+            assert lib is not None, "retry ladder degraded to numpy"
+            assert lib.lgbtpu_abi_version() == native._ABI_VERSION
+            with open(so, "rb") as f:   # promoted good rebuild
+                assert f.read(4) == b"\\x7fELF", "promotion did not land"
+        finally:
+            os.replace(so + ".bak", so)
+            for p in glob.glob(os.path.join(
+                    os.path.dirname(so),
+                    f"libnative-*-v{{native._ABI_VERSION}}-r*.so*")):
+                os.unlink(p)
+        print("LADDER-OK")
+    """)
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=240,
+                       env=dict(os.environ))
+    assert r.returncode == 0 and "LADDER-OK" in r.stdout, \
+        f"rc={r.returncode}\nstdout={r.stdout}\nstderr={r.stderr}"

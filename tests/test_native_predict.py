@@ -56,6 +56,48 @@ def test_exact_parity_categorical():
     Xo[50:100, 1] = np.nan
     np.testing.assert_array_equal(bst.predict(Xo, raw_score=True),
                                   _numpy_raw(bst, Xo))
+    # out-of-int64-range doubles in a categorical slot (1e300, ±inf,
+    # negatives) must route right-child like the numpy path — the C walk
+    # range-checks in double space before narrowing (a raw (int64_t)cast
+    # is UB there; ADVICE r4)
+    Xe = X.copy()
+    Xe[:20, 1] = 1e300
+    Xe[20:40, 1] = np.inf
+    Xe[40:60, 1] = -np.inf
+    Xe[60:80, 1] = -3.0
+    # fractional values in (-1, 0) truncate to category 0 (the
+    # reference's (int)fval semantics) — NOT the right-child default
+    Xe[80:100, 1] = -0.5
+    Xe[100:120, 1] = 2.7           # truncates to category 2 (in-set)
+    np.testing.assert_array_equal(bst.predict(Xe, raw_score=True),
+                                  _numpy_raw(bst, Xe))
+
+
+@needs_native
+def test_empty_categorical_bitset_span_routes_right():
+    # an empty cat_boundaries span (hi == lo) is never produced by
+    # training but is accepted by the model-text loader; the C walk must
+    # route right WITHOUT indexing the bitset — including for values in
+    # (-1, 0) whose truncation-to-0 path would otherwise read word 0 of
+    # a span that has no words (code-review r5 finding)
+    rng = np.random.RandomState(11)
+    X = rng.randn(1200, 4)
+    X[:, 0] = rng.randint(0, 8, 1200)
+    y = (np.isin(X[:, 0], [1, 3]) + 0.2 * rng.randn(1200) > 0.5)\
+        .astype(float)
+    bst = _train({"objective": "binary"}, X, y, rounds=4,
+                 categorical_feature=[0])
+    t = next(t for t in bst.trees if t.num_cat > 0)
+    # empty every span: keep the boundary array shape, drop the words
+    t.cat_boundaries = np.zeros_like(t.cat_boundaries)
+    t.cat_threshold = np.zeros(0, dtype=t.cat_threshold.dtype)
+    bst._invalidate_pred_caches()
+    Xq = X[:64].copy()
+    Xq[:16, 0] = -0.5
+    Xq[16:32, 0] = 0.0
+    Xq[32:48, 0] = 5.0
+    got = bst.predict(Xq, raw_score=True)      # native walk, no OOB read
+    np.testing.assert_array_equal(got, _numpy_raw(bst, Xq))
 
 
 @needs_native

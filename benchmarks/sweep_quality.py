@@ -1,0 +1,121 @@
+"""Growth-policy quality sweep — MULTI-SEED BY DEFAULT (round 5).
+
+Held-out AUC of candidate bench configs on the bench's Higgs-like data,
+run over n >= 3 seeds and reported as mean +/- spread.  The round-4
+addendum proved single-seed orderings at this scale are noise (seed
+77 -> 123 moved strict's 500k AUC by ~0.006 — more than every config
+delta in that table), so this harness REFUSES to print an ordering from
+fewer than 3 seeds unless --force-single-seed is given, and marks
+config deltas smaller than the observed cross-seed spread as ties.
+
+Speed is NOT measured here (run on CPU; kernel economics differ) — this
+sweep only orders configs by quality so the TPU speed sweep
+(sweep_speed_r4.py) can stay short.  Results feed PROFILE.md r5.
+
+Usage:
+  python benchmarks/sweep_quality.py [N] [ROUNDS] [names...]
+      [--seeds 77,123,2024] [--force-single-seed]
+"""
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from configs_r4 import BASE, CONFIGS  # noqa: E402 (one shared definition)
+
+DEFAULT_SEEDS = (77, 123, 2024)
+
+
+def parse_args(argv):
+    seeds = list(DEFAULT_SEEDS)
+    force_single = False
+    pos = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a == "--seeds":
+            seeds = [int(s) for s in argv[i + 1].split(",")]
+            i += 2
+        elif a == "--force-single-seed":
+            force_single = True
+            i += 1
+        else:
+            pos.append(a)
+            i += 1
+    n = int(pos[0]) if pos else 500_000
+    rounds = int(pos[1]) if len(pos) > 1 else 48
+    names = pos[2:] or list(CONFIGS)
+    if len(seeds) < 3 and not force_single:
+        sys.exit("REFUSING to order configs from fewer than 3 seeds: "
+                 "single-seed AUC deltas at this scale are seed noise "
+                 "(PROFILE.md r4 addendum).  Pass --seeds a,b,c or "
+                 "--force-single-seed to override for spot checks.")
+    return n, rounds, names, seeds, force_single
+
+
+def main():
+    N, ROUNDS, NAMES, SEEDS, forced = parse_args(sys.argv[1:])
+    import bench
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.metrics import _auc
+
+    unknown = set(NAMES) - CONFIGS.keys()
+    if unknown:
+        sys.exit(f"unknown config name(s): {sorted(unknown)}")
+    n_eval = max(100_000, N // 10)
+    per = {name: [] for name in NAMES}
+    for seed in SEEDS:
+        X, y = bench._make_higgs_like(N + n_eval, bench.F, seed=seed)
+        X_eval, y_eval = X[N:], y[N:]
+        Xs, ys = X[:N], y[:N]
+        for name in NAMES:
+            params = {**BASE, **CONFIGS[name]}
+            t0 = time.time()
+            bst = lgb.train(params, lgb.Dataset(Xs, label=ys),
+                            num_boost_round=ROUNDS)
+            auc = float(_auc(bst.predict(X_eval, raw_score=True),
+                             y_eval, None, None))
+            per[name].append(auc)
+            print(json.dumps({"seed": seed, "config": name,
+                              "auc": round(auc, 5),
+                              "train_s": round(time.time() - t0, 1)}),
+                  flush=True)
+
+    # mean +/- spread per config; the tie radius is the LARGEST
+    # cross-seed spread any config showed — a delta smaller than what
+    # one config does to itself across seeds is not a real ordering
+    stats = {}
+    for name, aucs in per.items():
+        stats[name] = {
+            "mean": round(statistics.fmean(aucs), 5),
+            "spread": round(max(aucs) - min(aucs), 5),
+            "stdev": round(statistics.stdev(aucs), 5) if len(aucs) > 1
+            else None,
+            "n_seeds": len(aucs),
+            "aucs": [round(a, 5) for a in aucs],
+        }
+    tie = max((s["spread"] for s in stats.values()), default=0.0)
+    ranked = sorted(stats, key=lambda n: -stats[n]["mean"])
+    print("\n== mean AUC over seeds "
+          f"{SEEDS} (tie radius = max cross-seed spread = {tie:.5f}) ==")
+    prev = None
+    for name in ranked:
+        s = stats[name]
+        marker = ""
+        if prev is not None and prev - s["mean"] < tie:
+            marker = "  (~tie with previous)"
+        flag = " [SINGLE SEED — NOT AN ORDERING]" if s["n_seeds"] < 3 \
+            else ""
+        print(f"  {name:28s} {s['mean']:.5f} +/- {s['spread']:.5f}"
+              f"{marker}{flag}")
+        prev = s["mean"]
+    print("RESULT " + json.dumps({"n": N, "rounds": ROUNDS,
+                                  "seeds": SEEDS, "tie_radius": tie,
+                                  "configs": stats}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,22 +32,35 @@ _tried = False
 
 def _build(out: str = None, openmp: bool = True) -> Optional[str]:
     out = out or _SO
+    # compile to a temp name and os.replace into place: `out` may be a
+    # stale .so that ANOTHER process has mapped (ctypes never dlcloses),
+    # and the linker truncating a mapped inode in place can SIGBUS that
+    # process / hand a torn ELF to a concurrent CDLL.  rename gives the
+    # new build a fresh inode atomically.
+    tmp = f"{out}.build{os.getpid()}"
     base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC,
-            "-o", out]
+            "-o", tmp]
     # OpenMP first (the prediction walk parallelizes over rows like the
     # reference's Predictor); retry serial on toolchains without it.
     # openmp=False skips straight to serial — for hosts where the
     # -fopenmp COMPILE succeeds but dlopen fails at runtime (libgomp
     # missing), which a compile-level retry can never detect.
     cmds = ([base[:1] + ["-fopenmp"] + base[1:]] if openmp else []) + [base]
-    for cmd in cmds:
+    try:
+        for cmd in cmds:
+            try:
+                r = subprocess.run(cmd, capture_output=True, timeout=120)
+            except (OSError, subprocess.TimeoutExpired):
+                return None
+            if r.returncode == 0 and os.path.exists(tmp):
+                os.replace(tmp, out)
+                return out
+        return None
+    finally:
         try:
-            r = subprocess.run(cmd, capture_output=True, timeout=120)
-        except (OSError, subprocess.TimeoutExpired):
-            return None
-        if r.returncode == 0 and os.path.exists(out):
-            return out
-    return None
+            os.unlink(tmp)
+        except OSError:
+            pass
 
 
 def _retry_path(attempt: int) -> str:
@@ -109,13 +122,18 @@ def get_lib():
                     # rename of a fresh copy (never rewrite a mapped
                     # inode in place); unlinking the retry name below is
                     # safe on Linux, the mapped inode outlives the entry
+                    tmp = so + ".promote"
                     try:
                         import shutil
-                        tmp = so + ".promote"
                         shutil.copy2(so, tmp)
                         os.replace(tmp, _SO)
                     except OSError:
                         pass
+                    finally:
+                        try:
+                            os.unlink(tmp)
+                        except OSError:
+                            pass
                 _lib = lib
                 return _lib
             return None

@@ -360,6 +360,46 @@ def child_bounds_basic(mono_f, l_sm, r_sm, lb, ub):
             l_lb, l_ub, r_lb, r_ub)
 
 
+def make_cegb_penalty(spec: GrowerSpec, feat: Dict[str, Array], F: int):
+    """(cegb_on, cegb_penalty) — per-candidate [F] gain penalties (ref:
+    cost_effective_gradient_boosting.hpp
+    `CostEfficientGradientBoosting::DetlaGain`: split cost +
+    once-per-model coupled feature cost + per-row lazy feature cost).
+    ONE definition shared by the strict and wave growers so the two
+    policies price identical candidates identically; `feat["cegb_used"]`
+    is frozen for the duration of a tree (the booster commits it
+    after each tree), so the penalty of a candidate depends only on its
+    leaf's count and path — not on growth order."""
+    cegb_on = spec.cegb_tradeoff > 0.0 and \
+        (spec.cegb_penalty_split > 0.0 or spec.cegb_coupled
+         or spec.cegb_lazy)
+
+    def cegb_penalty(n_child, path_used):
+        if not cegb_on:
+            return None
+        p = jnp.full((F,), spec.cegb_penalty_split * n_child,
+                     jnp.float32)
+        if spec.cegb_coupled:
+            p = p + feat["cegb_coupled"] * \
+                (1.0 - feat["cegb_used"].astype(jnp.float32))
+        if spec.cegb_lazy:
+            p = p + feat["cegb_lazy"] * n_child * \
+                (1.0 - path_used.astype(jnp.float32))
+        return spec.cegb_tradeoff * p
+
+    return cegb_on, cegb_penalty
+
+
+def ic_allowed_from_used(feat: Dict[str, Array], used: Array) -> Array:
+    """[F] features allowed under interaction constraints for a node
+    whose root path already used `used` [F] (ref: col_sampler.hpp
+    interaction-constraint filtering): the union of constraint groups
+    that contain the path's entire used set."""
+    groups = feat["ic_groups"]
+    ok_k = ~jnp.any(used[None, :] & ~groups, axis=1)
+    return jnp.any(groups & ok_k[:, None], axis=0)
+
+
 @functools.lru_cache(maxsize=64)
 def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 n_shards: int = 1):
@@ -533,27 +573,7 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                             h = jax.lax.psum(h, axes_dcn)
             return h
 
-        cegb_on = spec.cegb_tradeoff > 0.0 and \
-            (spec.cegb_penalty_split > 0.0 or spec.cegb_coupled
-             or spec.cegb_lazy)
-
-        def cegb_penalty(n_child, path_used):
-            """Per-feature gain penalty for a candidate split of a node
-            with `n_child` rows and `path_used` [F] features already on
-            its path (ref: CostEfficientGradientBoosting::DetlaGain —
-            split cost + once-per-model feature cost + per-row lazy
-            feature cost)."""
-            if not cegb_on:
-                return None
-            p = jnp.full((F,), spec.cegb_penalty_split * n_child,
-                         jnp.float32)
-            if spec.cegb_coupled:
-                p = p + feat["cegb_coupled"] * \
-                    (1.0 - feat["cegb_used"].astype(jnp.float32))
-            if spec.cegb_lazy:
-                p = p + feat["cegb_lazy"] * n_child * \
-                    (1.0 - path_used.astype(jnp.float32))
-            return spec.cegb_tradeoff * p
+        cegb_on, cegb_penalty = make_cegb_penalty(spec, feat, F)
 
         def split_of(hist, g, h, c, node_allowed, lb, ub, p_out,
                      cand_mask=None, penalty=None):
@@ -891,9 +911,7 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                             (st["leaf_depth"][i] < spec.max_depth)
                         a = allowed & deep
                         if spec.n_ic_groups:
-                            groups = feat["ic_groups"]
-                            ok_k = ~jnp.any(lu[None, :] & ~groups, axis=1)
-                            a = a & jnp.any(groups & ok_k[:, None], axis=0)
+                            a = a & ic_allowed_from_used(feat, lu)
                         a = a & bynode_mask(st["leaf_nid"][i])
                         s = split_of(st["hist"][i], st["leaf_g"][i],
                                      st["leaf_h"][i], st["leaf_c"][i], a,
@@ -952,10 +970,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                     .set(child_used).at[new].set(child_used)
             if spec.n_ic_groups:
                 # allowed = union of constraint groups containing the path
-                groups = feat["ic_groups"]
-                ok_k = ~jnp.any(child_used[None, :] & ~groups, axis=1)
                 child_allowed = child_allowed & \
-                    jnp.any(groups & ok_k[:, None], axis=0)
+                    ic_allowed_from_used(feat, child_used)
             ls = split_of(lhist, lg, lh, lc,
                           child_allowed & bynode_mask(2 * step + 1),
                           l_lb, l_ub, l_fin,

@@ -30,13 +30,16 @@ remains `leafwise` for stock-exact trees.
 Feature scope (the booster downgrades to the strict grower otherwise):
 numerical + categorical splits, missing handling, monotone basic,
 path smoothing, per-tree/per-node column sampling, extra_trees,
-max_depth/min_* constraints, EFB bundling, all histogram impls, and
-distributed data-parallel training — in the production reduce-scatter
-mode (`mode="data_rs"`: block-scattered wave histograms + per-wave
-SplitInfo allreduce-max; features block-padded), or full-histogram psum
-under EFB (see `make_wave_grower`).  Forced splits,
-CEGB, interaction constraints, monotone intermediate, and the bounded
-histogram pool keep the strict grower.
+max_depth/min_* constraints, EFB bundling, all histogram impls,
+interaction constraints + CEGB (r5: per-leaf used-feature tracking +
+the shared candidate pricing of `make_cegb_penalty`, order-independent
+within a tree because `cegb_used` is frozen per tree), and distributed
+data-parallel training — in the production reduce-scatter mode
+(`mode="data_rs"`: block-scattered wave histograms + per-wave SplitInfo
+allreduce-max; features block-padded), or full-histogram psum under EFB
+(see `make_wave_grower`).  Forced splits, monotone intermediate, and
+the bounded histogram pool keep the strict grower (priced downgrade
+warning in the booster).
 """
 from __future__ import annotations
 
@@ -48,7 +51,8 @@ import jax
 import jax.numpy as jnp
 
 from .grow import (DeviceTree, GrowerSpec, _split_to_arrays,
-                   child_bounds_basic, make_bundled_expander,
+                   child_bounds_basic, ic_allowed_from_used,
+                   make_bundled_expander, make_cegb_penalty,
                    make_feature_blocks, make_node_samplers,
                    rebase_and_merge_block_split, split_go_left)
 from .histogram import leaf_histogram_multi, leaf_histogram_packed_multi
@@ -209,12 +213,19 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                     h = jax.lax.psum(h, axes_all)
             return h
 
-        # per-node column sampling / extra_trees — the SAME shared
-        # derivations as the strict grower (ops/grow.py), so both
-        # policies draw identical per-node samples for the same tree
+        # per-node column sampling / extra_trees / CEGB pricing — the
+        # SAME shared derivations as the strict grower (ops/grow.py), so
+        # both policies draw identical per-node samples and price
+        # identical candidates identically for the same tree
         bynode_mask, extra_mask = make_node_samplers(spec, feat, F)
+        cegb_on, cegb_penalty = make_cegb_penalty(spec, feat, F)
+        # per-leaf used-feature tracking feeds interaction constraints
+        # and CEGB lazy costs; the state is a [LB, F] plane updated at
+        # every committed split (both children inherit path ∪ {f})
+        track_used = spec.n_ic_groups > 0 or (cegb_on and spec.cegb_lazy)
 
-        def split_of(hist, g, h, c, node_allowed, lb, ub, p_out, nid):
+        def split_of(hist, g, h, c, node_allowed, lb, ub, p_out, nid,
+                     penalty=None):
             na = node_allowed & bynode_mask(nid)
             cm = extra_mask(nid)
             if block:
@@ -226,10 +237,14 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                 if cm is not None:
                     cm = jax.lax.dynamic_slice_in_dim(cm, offset, Fb,
                                                       axis=0)
+                if penalty is not None:
+                    penalty = jax.lax.dynamic_slice_in_dim(
+                        penalty, offset, Fb, axis=0)
                 s = find(hist, g, h, c, bfeat["nb"], bfeat["missing"],
                          bfeat["default"], na, bfeat["is_cat"],
                          mono=bmono, out_lb=lb, out_ub=ub,
-                         parent_output=p_out, cand_mask=cm)
+                         parent_output=p_out, cand_mask=cm,
+                         gain_penalty=penalty)
                 return rebase_and_merge_block_split(s, offset, axis_last,
                                                     n_shards)
             if spec.bundled:
@@ -237,7 +252,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             return find(hist, g, h, c, feat["nb"], feat["missing"],
                         feat["default"], na, feat["is_cat"], mono=mono,
                         out_lb=lb, out_ub=ub, parent_output=p_out,
-                        cand_mask=cm)
+                        cand_mask=cm, gain_penalty=penalty)
 
         # ---- root ----
         # the root pass uses the SAME [W]-slot call shape as every wave
@@ -260,8 +275,12 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             root_h = jax.lax.psum(root_h, axes_all)
             root_c = jax.lax.psum(root_c, axes_all)
         root_out = clamp_output(root_g, root_h)
+        if spec.n_ic_groups:
+            # only features inside some constraint group may ever split
+            allowed = allowed & jnp.any(feat["ic_groups"], axis=0)
         s0 = split_of(hist0, root_g, root_h, root_c, allowed,
-                      jnp.float32(-INF), jnp.float32(INF), root_out, 0)
+                      jnp.float32(-INF), jnp.float32(INF), root_out, 0,
+                      penalty=cegb_penalty(root_c, jnp.zeros((F,), bool)))
 
         hist = jnp.zeros((LB,) + hist0.shape, dtype=jnp.float32)\
             .at[0].set(hist0)
@@ -301,6 +320,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             leaf_depth=jnp.zeros((LB,), jnp.int32),
             nodes=nodes,
         )
+        if track_used:
+            state["leaf_used"] = jnp.zeros((LB, F), bool)
 
         LEAF_KEYS = ("leaf_gain", "leaf_feat", "leaf_thr", "leaf_dl",
                      "leaf_lg", "leaf_lh", "leaf_lc", "leaf_rg", "leaf_rh",
@@ -313,10 +334,11 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             # ---- split phase: best-first among READY leaves (leaves
             # created this wave have no histogram yet and wait for the
             # next wave), up to the batch capacity W ----
-            istate = {k: st[k] for k in
-                      ("step", "nl", "leaf_id", "nodes", "leaf_g",
-                       "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
-                       "leaf_out", "leaf_depth") + LEAF_KEYS}
+            carry_keys = ("step", "nl", "leaf_id", "nodes", "leaf_g",
+                          "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
+                          "leaf_out", "leaf_depth") + \
+                (("leaf_used",) if track_used else ())
+            istate = {k: st[k] for k in carry_keys + LEAF_KEYS}
             istate["ready"] = jnp.arange(LB) < st["nl"]
             istate["w"] = jnp.int32(0)
             # hybrid wave/strict schedule (spec.wave_strict_tail): with
@@ -412,6 +434,11 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                 depth = s["leaf_depth"][best] + 1
 
                 out = dict(s)
+                if track_used:
+                    # both children share the path's used set ∪ {f}
+                    child_used = s["leaf_used"][best].at[f].set(True)
+                    out["leaf_used"] = s["leaf_used"].at[best]\
+                        .set(child_used).at[new].set(child_used)
                 out.update(
                     step=step + 1, nl=new + 1, leaf_id=leaf_id,
                     nodes=nodes, w=s["w"] + 1,
@@ -463,9 +490,15 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                         s1["leaf_c"][sl]
                     deep_ok = (spec.max_depth <= 0) | \
                         (s1["leaf_depth"][sl] < spec.max_depth)
-                    sr = split_of(hist[sl], g, h, c, allowed & deep_ok,
+                    lu = s1["leaf_used"][sl] if track_used \
+                        else jnp.zeros((F,), bool)
+                    a = allowed & deep_ok
+                    if spec.n_ic_groups:
+                        a = a & ic_allowed_from_used(feat, lu)
+                    sr = split_of(hist[sl], g, h, c, a,
                                   s1["leaf_lb"][sl], s1["leaf_ub"][sl],
-                                  s1["leaf_out"][sl], nid)
+                                  s1["leaf_out"][sl], nid,
+                                  penalty=cegb_penalty(c, lu))
                     return _split_to_arrays(sr)
 
                 res = jax.vmap(eval_child)(child_slots, node_ids)
@@ -482,10 +515,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             hist, leaf_upd = jax.lax.cond(s1["step"] >= LB - 1, tree_full,
                                           hist_and_find, None)
 
-            new_state = {k: s1[k] for k in
-                         ("step", "nl", "leaf_id", "nodes", "leaf_g",
-                          "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
-                          "leaf_out", "leaf_depth")}
+            new_state = {k: s1[k] for k in carry_keys}
             new_state["hist"] = hist
             for k, v in zip(LEAF_KEYS, leaf_upd):
                 new_state[k] = v

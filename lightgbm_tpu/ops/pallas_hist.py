@@ -327,13 +327,15 @@ def pallas_histogram_multi_rows(bins_fm: Array, pw9: Array, leaf_id: Array,
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
-                                             "feat_tile", "interpret"))
+                                             "feat_tile", "interpret",
+                                             "debug"))
 def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
                                      leaf_id: Array, slots: Array,
                                      max_bin: int, s_g: Array, s_h: Array,
                                      *, row_tile: int = ROW_TILE,
                                      feat_tile: int = 0,
-                                     interpret: bool = False) -> Array:
+                                     interpret: bool = False,
+                                     debug: bool = False) -> Array:
     """Multi-leaf quantized histogram: up to 42 leaves x 3 integer rows
     fill one MXU pass (see `pallas_histogram_quantized` for the lattice
     invariants, `pallas_histogram_multi` for the batching economics).
@@ -341,9 +343,9 @@ def pallas_histogram_multi_quantized(bins_fm: Array, payload: Array,
     Returns: [S, F, MB, 3] f32.
     """
     return pallas_histogram_multi_quantized_rows(
-        bins_fm, quantized_lattice_rows(payload, s_g, s_h), leaf_id,
-        slots, max_bin, s_g, s_h, row_tile=row_tile, feat_tile=feat_tile,
-        interpret=interpret)
+        bins_fm, quantized_lattice_rows(payload, s_g, s_h, debug=debug),
+        leaf_id, slots, max_bin, s_g, s_h, row_tile=row_tile,
+        feat_tile=feat_tile, interpret=interpret)
 
 
 def quantized_lattice_rows(payload: Array, s_g: Array, s_h: Array, *,
@@ -401,12 +403,14 @@ def pallas_histogram_multi_quantized_rows(
 
 
 @functools.partial(jax.jit, static_argnames=("max_bin", "row_tile",
-                                             "feat_tile", "interpret"))
+                                             "feat_tile", "interpret",
+                                             "debug"))
 def pallas_histogram_quantized(bins_fm: Array, payload: Array,
                                row_mask: Array, max_bin: int,
                                s_g: Array, s_h: Array, *,
                                row_tile: int = ROW_TILE, feat_tile: int = 0,
-                               interpret: bool = False) -> Array:
+                               interpret: bool = False,
+                               debug: bool = False) -> Array:
     """Quantized-gradient histogram: ONE bf16 matmul, integer-exact.
 
     Same contract as histogram.leaf_histogram_packed: payload carries
@@ -429,7 +433,8 @@ def pallas_histogram_quantized(bins_fm: Array, payload: Array,
     # single-leaf = the int8 multi driver with a mask-derived leaf id
     # (slot 0 = in-leaf, -1 = masked out): the lattice is exact in int8
     # and the int8 x int8 -> int32 dot runs at 2x the bf16 MXU rate
-    pw = quantized_lattice_rows(payload, s_g, s_h)   # [3, N] int8
+    pw = quantized_lattice_rows(payload, s_g, s_h,
+                                debug=debug)         # [3, N] int8
     lid = jnp.where(row_mask, 0, -1).astype(jnp.int32)
     out = _run_kernel_multi_i8(bins_fm, pw, lid,
                                jnp.zeros((1,), jnp.int32), max_bin,

@@ -421,18 +421,143 @@ class TestWavePolicy:
         assert aucs[-1] >= aucs[0]
         assert max(aucs) > 0.85
 
-    def test_downgrade_reasons(self):
+    def test_downgrade_reasons(self, tmp_path, caplog):
+        # r5: CEGB and interaction constraints are wave-ELIGIBLE; forced
+        # splits still downgrade, and the warning prices the fallback
+        import json as _json
+        import logging
         X, y = make_binary(1500)
-        bst = lgb.train({"objective": "binary", "num_leaves": 7,
-                         "verbosity": -1, "tree_grow_policy": "wave",
-                         "cegb_tradeoff": 1.0,
-                         "cegb_penalty_split": 0.1},
-                        lgb.Dataset(X, label=y), num_boost_round=3)
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            _json.dump({"feature": 0, "threshold": 0.0}, f)
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                             "verbosity": 1, "tree_grow_policy": "wave",
+                             "forcedsplits_filename": fn},
+                            lgb.Dataset(X, label=y), num_boost_round=3)
         assert bst._grow_policy == "leafwise"
-        bst = lgb.train({"objective": "binary", "num_leaves": 7,
-                         "verbosity": -1, "tree_grow_policy": "wave"},
-                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert "lower training throughput" in caplog.text, caplog.text
+        for extra in ({"cegb_tradeoff": 1.0, "cegb_penalty_split": 0.1},
+                      {"interaction_constraints": [[0, 1], [2, 3]]},
+                      {}):
+            bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                             "verbosity": -1, "tree_grow_policy": "wave",
+                             **extra},
+                            lgb.Dataset(X, label=y), num_boost_round=3)
+            assert bst._grow_policy == "wave", extra
+
+    def test_cegb_ic_strict_tail_byte_identical(self):
+        """r5: CEGB / interaction constraints under wave with a full
+        strict tail (width-1 waves ARE strict order) must produce
+        byte-identical models to the leafwise grower — candidate
+        pricing and allowed-feature filtering are shared code and
+        order-independent within a tree."""
+        X, y = make_binary(2500)
+        strip = ("[tree_grow_policy", "[tpu_wave")
+        F = X.shape[1]
+        for extra in ({"cegb_tradeoff": 0.8, "cegb_penalty_split": 0.05},
+                      {"cegb_tradeoff": 1.0,
+                       "cegb_penalty_feature_coupled": [5.0] * F,
+                       "cegb_penalty_feature_lazy": [0.01] * F},
+                      {"interaction_constraints": [[0, 1, 2], [3, 4, 5],
+                                                   [0, 6, 7]]}):
+            dumps = {}
+            for pol, wav in (("leafwise", {}),
+                             ("wave", {"tpu_wave_strict_tail": 1000,
+                                       "tpu_wave_gain_ratio": 0})):
+                bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                                 "verbosity": -1, "tree_grow_policy": pol,
+                                 "tpu_wave_overgrow": 0, **extra, **wav},
+                                lgb.Dataset(X, label=y),
+                                num_boost_round=6)
+                assert bst._grow_policy == pol, (pol, extra)
+                txt = bst.model_to_string()
+                body = "\n".join(ln for ln in txt.splitlines()
+                                 if not ln.startswith(strip))
+                dumps[pol] = (body, bst.predict(X))
+            assert dumps["leafwise"][0] == dumps["wave"][0], extra
+            np.testing.assert_array_equal(dumps["leafwise"][1],
+                                          dumps["wave"][1])
+
+    def test_ic_paths_respected_under_wide_waves(self):
+        """Real waves (W > 1, no tail): every root path must stay inside
+        one constraint group — the per-leaf used-feature plane threads
+        through the batched split phase."""
+        X, y = make_binary(3000)
+        groups = [[0, 1, 3], [2, 4, 5], [6, 7]]
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0,
+                         "tpu_wave_strict_tail": 0,
+                         "interaction_constraints": groups},
+                        lgb.Dataset(X, label=y), num_boost_round=6)
         assert bst._grow_policy == "wave"
+        gsets = [frozenset(g) for g in groups]
+
+        def paths(t):
+            # leaf slot k's path = features of splits on its root chain
+            out = []
+            for leaf in range(t.num_leaves):
+                feats, nd = set(), -leaf - 1
+                # walk up: find parent of node nd
+                def parent_of(target):
+                    for i in range(t.num_internal()):
+                        if t.left_child[i] == target \
+                                or t.right_child[i] == target:
+                            return i
+                    return None
+                cur = nd
+                while True:
+                    p = parent_of(cur)
+                    if p is None:
+                        break
+                    feats.add(int(t.split_feature[p]))
+                    cur = p
+                out.append(frozenset(feats))
+            return out
+
+        for t in bst.trees:
+            for path in paths(t):
+                assert any(path <= g for g in gsets), \
+                    f"path {set(path)} violates constraints"
+
+    def test_cegb_effects_hold_under_wide_waves(self):
+        """CEGB's qualitative behavior must survive real waves: the
+        split penalty still prunes leaves and the coupled penalty still
+        concentrates the used-feature set."""
+        rng = np.random.RandomState(0)
+        X = rng.randn(3000, 8)
+        y = X.sum(axis=1) * 0.5 + 0.5 * rng.randn(3000)
+        wave = {"tree_grow_policy": "wave", "tpu_wave_width": 8,
+                "tpu_wave_gain_ratio": 0, "tpu_wave_strict_tail": 0}
+        base = lgb.train({"objective": "regression", "num_leaves": 31,
+                          "verbosity": -1, **wave},
+                         lgb.Dataset(X, label=y), num_boost_round=3)
+        pen = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "cegb_tradeoff": 1.0, "cegb_penalty_split": 0.2,
+                         "verbosity": -1, **wave},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert pen._grow_policy == "wave"
+        n_base = sum(t.num_leaves for t in base.trees)
+        n_pen = sum(t.num_leaves for t in pen.trees)
+        assert n_pen < n_base, (n_pen, n_base)
+
+        coup = lgb.train({"objective": "regression", "num_leaves": 15,
+                          "cegb_tradeoff": 1.0,
+                          "cegb_penalty_feature_coupled": [50.0] * 8,
+                          "verbosity": -1, **wave},
+                         lgb.Dataset(X, label=y), num_boost_round=8)
+
+        def used(b):
+            s = set()
+            for t in b.trees:
+                s.update(t.split_feature[:t.num_internal()].tolist())
+            return s
+
+        free = lgb.train({"objective": "regression", "num_leaves": 15,
+                          "verbosity": -1, **wave},
+                         lgb.Dataset(X, label=y), num_boost_round=8)
+        assert len(used(coup)) <= len(used(free))
 
 
 class TestWaveDistributed:

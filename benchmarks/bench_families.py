@@ -106,12 +106,15 @@ def child(family: str) -> None:
 
     if family == "lambdarank":
         X, y, sizes = make_ranking(N + n_eval)
-        # split on a query boundary so eval groups stay whole
+        # split on a query boundary so train and eval groups stay whole
         cut_q = int(np.searchsorted(np.cumsum(sizes), N))
-        cut = int(np.cumsum(sizes)[:cut_q][-1]) if cut_q else N
+        if cut_q == 0 or cut_q >= len(sizes):
+            sys.exit(f"lambdarank family needs N >> one query "
+                     f"(~120 docs); got N={N}")
+        cut = int(np.cumsum(sizes)[cut_q - 1])
         Xt, yt, gt = X[:cut], y[:cut], sizes[:cut_q]
         Xe, ye, ge = X[cut:], y[cut:], sizes[cut_q:]
-        ge[-1] = len(ye) - ge[:-1].sum()
+        assert ge.sum() == len(ye), (ge.sum(), len(ye))
         params = {"objective": "lambdarank", "num_leaves": 31,
                   "max_bin": 255, "learning_rate": 0.1, "verbosity": -1,
                   "lambdarank_truncation_level": 30}

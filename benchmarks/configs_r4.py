@@ -1,7 +1,9 @@
-"""Round-4 candidate bench configs — ONE definition shared by the
-quality sweep (sweep_quality_r4.py, CPU-runnable, orders configs by
+"""Candidate bench configs — ONE definition shared by the quality
+sweep (sweep_quality.py, CPU-runnable, multi-seed, orders configs by
 held-out AUC) and the speed sweep (sweep_speed_r4.py, TPU), so the two
-sweeps can never silently measure different configs under one name."""
+sweeps can never silently measure different configs under one name.
+(The r4 single-seed harness sweep_quality_r4.py is retired: single-seed
+orderings at these scales are seed noise — PROFILE.md r4 addendum.)"""
 
 BASE = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
         "learning_rate": 0.1, "verbosity": -1}
@@ -34,4 +36,17 @@ CONFIGS = {
                           "tpu_wave_gain_ratio": 0},
     "wave_w8_tail16": {"tree_grow_policy": "wave", "tpu_wave_width": 8,
                        "tpu_wave_gain_ratio": 0, "tpu_wave_strict_tail": 16},
+    # r5: wide-wave quantized challengers — the int8 lattice fits 42 leaf
+    # slots per MXU pass vs f32's 14 (PROFILE r3c kernel economics), so
+    # IF the kernel width curve holds end-to-end these trade a known
+    # small AUC cost for many fewer passes per tree.  The capacity-aware
+    # floor keeps depth; tail16 keeps the strict endgame.
+    "wave_w16_tail16+quant": {"tree_grow_policy": "wave",
+                              "tpu_wave_width": 16,
+                              "tpu_wave_gain_ratio": 0.8,
+                              "tpu_wave_strict_tail": 16, **QUANT},
+    "wave_w28_tail16+quant": {"tree_grow_policy": "wave",
+                              "tpu_wave_width": 28,
+                              "tpu_wave_gain_ratio": 0.8,
+                              "tpu_wave_strict_tail": 16, **QUANT},
 }

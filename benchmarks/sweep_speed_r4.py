@@ -29,7 +29,8 @@ PER_CONFIG_TIMEOUT = float(os.environ.get("SWEEP_TIMEOUT", 420))
 # wave_w8_tail16 is the cross-seed-stable quality challenger (PROFILE r4
 # addendum); r3bench+tail is the shipped bench config.
 SPEED_DEFAULT = ["wave_r3bench+tail", "wave_w8_tail16", "wave_r3bench",
-                 "strict", "wave_w8_tail_auto+quant", "wave_w8_tail_auto",
+                 "strict", "wave_w28_tail16+quant", "wave_w16_tail16+quant",
+                 "wave_w8_tail_auto+quant", "wave_w8_tail_auto",
                  "strict+quant"]
 
 

@@ -1804,9 +1804,9 @@ class Booster:
         # scan-of-vmapped-traversals over stacked padded trees
         # (ops/predict.py predict_raw_ensemble) instead of the host
         # per-tree walk — the batched analog of predictor.hpp's OpenMP
-        # row loop.  Falls back silently to the host path for shapes it
-        # does not cover (multiclass, categorical splits, linear trees,
-        # early stop).
+        # row loop.  Covers categorical splits (r5: per-node bitset
+        # planes); falls back silently to the host path for multiclass,
+        # linear trees, and prediction early stop.
         if (_b(kwargs.get("device_predict",
                           self.params.get("device_predict", False)))
                 and K == 1 and not es):
@@ -1896,11 +1896,13 @@ class Booster:
 
     def _stack_for_device(self, trees: List[Tree]):
         """Pad host trees into the stacked [T, NI]/[T, NL] arrays that
-        `ops.predict.predict_raw_ensemble` scans.  Returns None when any
-        tree needs a path the device traversal does not implement
-        (categorical splits, linear leaves) — callers fall back to the
-        host walk."""
-        if not trees or any(t.num_cat > 0 or t.is_linear for t in trees):
+        `ops.predict.predict_raw_ensemble` scans.  Categorical ensembles
+        (r5) add per-node bitset planes `cat_words` [T, NI, MW] +
+        `cat_nwords` [T, NI] (MW = widest bitset in the ensemble; the
+        per-node word count drives the same double-space range guard as
+        the host walks).  Returns None only for linear leaves — callers
+        fall back to the host walk."""
+        if not trees or any(t.is_linear for t in trees):
             return None
         ni = max(max(t.num_leaves - 1, 1) for t in trees)
         T = len(trees)
@@ -1912,6 +1914,14 @@ class Booster:
         left = np.full((T, ni), -1, np.int32)
         right = np.full((T, ni), -1, np.int32)
         value = np.zeros((T, ni + 1), np.float32)
+        has_cat = any(t.num_cat > 0 for t in trees)
+        if has_cat:
+            mw = 1
+            for t in trees:
+                if t.num_cat > 0 and len(t.cat_boundaries) > 1:
+                    mw = max(mw, int(np.max(np.diff(t.cat_boundaries))))
+            cat_words = np.zeros((T, ni, mw), np.uint32)
+            cat_nwords = np.zeros((T, ni), np.int32)
         for i, t in enumerate(trees):
             k = t.num_leaves - 1
             feat[i, :k] = t.split_feature[:k]
@@ -1920,10 +1930,22 @@ class Booster:
             left[i, :k] = t.left_child[:k]
             right[i, :k] = t.right_child[:k]
             value[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
-        return dict(feat=jnp.asarray(feat), thr=jnp.asarray(thr),
-                    dtype=jnp.asarray(dtype_), left=jnp.asarray(left),
-                    right=jnp.asarray(right), value=jnp.asarray(value),
-                    min_features=int(feat.max()) + 1 if feat.size else 0)
+            if has_cat and t.num_cat > 0:
+                for nd in range(k):
+                    if t.decision_type[nd] & 1:
+                        cb = int(t.threshold_bin[nd])
+                        lo = int(t.cat_boundaries[cb])
+                        hi = int(t.cat_boundaries[cb + 1])
+                        cat_nwords[i, nd] = hi - lo
+                        cat_words[i, nd, :hi - lo] = t.cat_threshold[lo:hi]
+        out = dict(feat=jnp.asarray(feat), thr=jnp.asarray(thr),
+                   dtype=jnp.asarray(dtype_), left=jnp.asarray(left),
+                   right=jnp.asarray(right), value=jnp.asarray(value),
+                   min_features=int(feat.max()) + 1 if feat.size else 0)
+        if has_cat:
+            out["cat_words"] = jnp.asarray(cat_words)
+            out["cat_nwords"] = jnp.asarray(cat_nwords)
+        return out
 
     @staticmethod
     def _tree_slice_key(trees: List[Tree]):

@@ -102,12 +102,20 @@ def replay_leaf_ids(tree, bins_fm: Array, feat_nb: Array,
 
 def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
                  node_left: Array, node_right: Array, leaf_value: Array,
-                 X: Array) -> Array:
+                 X: Array, cat_words: Array = None,
+                 cat_nwords: Array = None) -> Array:
     """Raw-value traversal of ONE tree over a batch (jitted bench path).
 
-    Decision semantics mirror tree.h `Tree::NumericalDecision`:
-    NaN with missing_type!=NaN → 0.0; Zero/NaN missing → default_left.
+    Decision semantics mirror tree.h `Tree::NumericalDecision` /
+    `Tree::CategoricalDecision`: NaN with missing_type!=NaN → 0.0;
+    Zero/NaN missing → default_left; categorical nodes (decision_type
+    bit 0) bit-test the category in the node's bitset `cat_words`
+    [NI, MW] (per-node word count `cat_nwords` [NI]), with the same
+    double-space range guard as the host walks — NaN / out-of-span /
+    v <= -1 route right.  Category indices are exact in f32 (< 2^24).
     """
+    has_cat = cat_words is not None
+
     def row_fn(x):
         def cond(nd):
             return nd >= 0
@@ -124,6 +132,15 @@ def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
                          ((missing_type == 2) & isnan)
             go_left = jnp.where(is_missing, default_left,
                                 fv <= node_thr[nd])
+            if has_cat:
+                mw = cat_words.shape[-1]
+                span = (cat_nwords[nd] * 32).astype(jnp.float32)
+                ok = ~isnan & (fval > -1.0) & (fval < span)
+                v = jnp.where(ok, fval, 0.0).astype(jnp.int32)
+                w = cat_words[nd, jnp.clip(v // 32, 0, max(mw - 1, 0))]
+                bit = (w >> (v % 32).astype(jnp.uint32)) & jnp.uint32(1)
+                go_left = jnp.where((dt & 1) == 1, ok & (bit == 1),
+                                    go_left)
             return jnp.where(go_left, node_left[nd], node_right[nd])
 
         nd = jax.lax.while_loop(cond, body, jnp.int32(0))
@@ -136,11 +153,15 @@ def predict_raw_ensemble(stacked, X: Array) -> Array:
     """Sum of all trees via lax.scan over padded stacked tree arrays.
 
     `stacked` is a dict of [T, NI]/[T, NL] arrays (padded with leaf-0
-    self-loops so short trees terminate immediately).
+    self-loops so short trees terminate immediately); categorical
+    ensembles carry [T, NI, MW] `cat_words` + [T, NI] `cat_nwords`
+    bitset planes (absent = all-numerical fast path, no gather).
     """
     def step(carry, tree):
         out = traverse_raw(tree["feat"], tree["thr"], tree["dtype"],
-                           tree["left"], tree["right"], tree["value"], X)
+                           tree["left"], tree["right"], tree["value"], X,
+                           cat_words=tree.get("cat_words"),
+                           cat_nwords=tree.get("cat_nwords"))
         return carry + out, None
 
     init = jnp.zeros((X.shape[0],), dtype=jnp.float32)

@@ -1,10 +1,12 @@
 #!/bin/bash
 # TPU tunnel watcher (round 5).  Re-probes the axon tunnel on an interval;
-# the moment a chip answers, fires the staged round-4/5 measurement stack
+# the moment a chip answers, fires the staged round-5 measurement stack
 # IN PRIORITY ORDER (VERDICT r4 #1: "the measurement must come first, not
 # last" — the wedge follows sustained load):
 #   1. benchmarks/sweep_speed_r4.py at 2M  (the hybrid-tail decider)
 #   2. bench.py                            (the round's headline line)
+#   3. benchmarks/sweep_kernel_r5.py       (tile/width floor attack)
+#   4. benchmarks/bench_families.py        (per-capability perf rows)
 # then exits so the driver of this session sees the results.
 # Every probe is appended to PROBE_LOG.jsonl by probe_tpu.py.
 cd "$(dirname "$0")/.." || exit 1
@@ -20,6 +22,10 @@ while true; do
       2>&1 | tee SWEEP_r5_tpu.log
     BENCH_WALL_BUDGET=540 python bench.py \
       > BENCH_r5_tpu.json 2> bench_r5_tpu.log
+    SWEEP_KERNEL_BUDGET=900 python benchmarks/sweep_kernel_r5.py \
+      2>&1 | tee KERNEL_r5_tpu.log
+    SWEEP_TIMEOUT=600 python benchmarks/bench_families.py 500000 32 \
+      2>&1 | tee FAMILIES_r5_tpu.log
     date -u +"%FT%TZ staged measurements done" | tee -a tpu_watch.log
     exit 0
   fi

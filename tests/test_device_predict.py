@@ -51,7 +51,9 @@ def test_device_predict_regression_and_rf():
         rtol=2e-5, atol=2e-6)
 
 
-def test_categorical_model_falls_back_to_host():
+def test_categorical_device_parity():
+    # r5: categorical ensembles run ON DEVICE (per-node bitset planes in
+    # the stacked scan) — f32-tolerance parity with the exact host walk
     X, _ = _data()
     rng = np.random.RandomState(1)
     X[:, 2] = rng.randint(0, 10, len(X))
@@ -63,6 +65,51 @@ def test_categorical_model_falls_back_to_host():
                     lgb.Dataset(X, label=y, categorical_feature=[2]),
                     num_boost_round=10)
     assert any(t.num_cat > 0 for t in bst.trees)
+    assert bst._stack_for_device(bst.trees) is not None
+    np.testing.assert_allclose(
+        bst.predict(X, device_predict=True), bst.predict(X),
+        rtol=2e-5, atol=2e-6)
+    # unseen / NaN / out-of-range / (-1, 0) categories route like host
+    Xo = X.copy()
+    Xo[:40, 2] = 99
+    Xo[40:80, 2] = np.nan
+    Xo[80:120, 2] = 1e300
+    Xo[120:160, 2] = -0.5
+    np.testing.assert_allclose(
+        bst.predict(Xo, device_predict=True), bst.predict(Xo),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_categorical_high_cardinality_device_parity():
+    # wider bitsets (multiple uint32 words per node) + mixed cat columns
+    rng = np.random.RandomState(7)
+    n = 4000
+    X = rng.randn(n, 5)
+    X[:, 0] = rng.randint(0, 300, n)     # ~10 words
+    X[:, 3] = rng.randint(0, 40, n)      # 2 words
+    eff = rng.randn(300)
+    y = (eff[X[:, 0].astype(int)] + 0.3 * X[:, 1]
+         + 0.2 * rng.randn(n) > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "max_cat_threshold": 64},
+                    lgb.Dataset(X, label=y, categorical_feature=[0, 3]),
+                    num_boost_round=10)
+    assert any(t.num_cat > 0 for t in bst.trees)
+    stacked = bst._stack_for_device(bst.trees)
+    assert stacked is not None and stacked["cat_words"].shape[-1] > 1
+    np.testing.assert_allclose(
+        bst.predict(X, device_predict=True), bst.predict(X),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_linear_model_falls_back_to_host():
+    X, _ = _data()
+    rng = np.random.RandomState(3)
+    y = X[:, 0] * 2 + X[:, 1] + 0.05 * rng.randn(len(X))
+    bst = lgb.train({"objective": "regression", "linear_tree": True,
+                     "num_leaves": 8, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=6)
+    assert any(t.is_linear for t in bst.trees)
     # silent host fallback: results must be EXACTLY the host path's
     np.testing.assert_array_equal(
         bst.predict(X, device_predict=True), bst.predict(X))

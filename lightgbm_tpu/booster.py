@@ -663,9 +663,10 @@ class Booster:
         # ic_allowed_from_used, and CEGB's coupled state is frozen
         # within a tree so candidate pricing is order-independent
         # (width-1 waves stay byte-identical to strict; tests/test_wave)
+        # r5 (later): forced splits are wave-eligible too — the BFS
+        # prefix runs as width-1 waves (strict order by construction),
+        # then free growth resumes at full width
         reasons = []
-        if spec.forced_splits:
-            reasons.append("forced splits")
         if spec.monotone_intermediate:
             reasons.append("monotone_constraints_method=intermediate")
         if spec.hist_pool_slots:

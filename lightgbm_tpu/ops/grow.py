@@ -390,6 +390,25 @@ def make_cegb_penalty(spec: GrowerSpec, feat: Dict[str, Array], F: int):
     return cegb_on, cegb_penalty
 
 
+def forced_split_arrays(spec: GrowerSpec):
+    """(forced_leaf, forced_feat, forced_bin) [n_forced] i32 arrays from
+    the spec's BFS-ordered forced-splits tuple — shared by both growers."""
+    return (jnp.array([s[0] for s in spec.forced_splits], jnp.int32),
+            jnp.array([s[1] for s in spec.forced_splits], jnp.int32),
+            jnp.array([s[2] for s in spec.forced_splits], jnp.int32))
+
+
+def empty_split_arrays(MB: int):
+    """The SplitResult-shaped all-infeasible placeholder (gain -inf) used
+    as the lax.cond partner of a forced-split evaluation.  ONE definition
+    so the tuple layout can never drift between the growers — must match
+    `_split_to_arrays` element-for-element."""
+    return (jnp.float32(NEG_INF), jnp.int32(-1), jnp.int32(0),
+            jnp.bool_(False), jnp.float32(0), jnp.float32(0),
+            jnp.float32(0), jnp.float32(0), jnp.float32(0),
+            jnp.float32(0), jnp.bool_(False), jnp.zeros((MB,), bool))
+
+
 def ic_allowed_from_used(feat: Dict[str, Array], used: Array) -> Array:
     """[F] features allowed under interaction constraints for a node
     whose root path already used `used` [F] (ref: col_sampler.hpp
@@ -640,12 +659,7 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         # forced splits (BFS order), applied before best-gain growth
         n_forced = len(spec.forced_splits)
         if n_forced:
-            forced_leaf = jnp.array([s[0] for s in spec.forced_splits],
-                                    jnp.int32)
-            forced_feat = jnp.array([s[1] for s in spec.forced_splits],
-                                    jnp.int32)
-            forced_bin = jnp.array([s[2] for s in spec.forced_splits],
-                                   jnp.int32)
+            forced_leaf, forced_feat, forced_bin = forced_split_arrays(spec)
 
         # ---- root ----
         root_mask = jnp.ones((N,), dtype=bool)
@@ -787,15 +801,8 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                                   st["leaf_out"][fl], cand_mask=cand)
                     return _split_to_arrays(fs)
 
-                def no_forced(_):
-                    return (jnp.float32(NEG_INF), jnp.int32(-1),
-                            jnp.int32(0), jnp.bool_(False),
-                            jnp.float32(0), jnp.float32(0), jnp.float32(0),
-                            jnp.float32(0), jnp.float32(0), jnp.float32(0),
-                            jnp.bool_(False), jnp.zeros((MB,), bool))
-
-                fa = jax.lax.cond(active_forced, eval_forced, no_forced,
-                                  None)
+                fa = jax.lax.cond(active_forced, eval_forced,
+                                  lambda _: empty_split_arrays(MB), None)
                 forced_ok = active_forced & jnp.isfinite(fa[0])
                 best = jnp.where(forced_ok, forced_leaf[idx], free_best)
                 # infeasible forced split → abandon the remaining prefix

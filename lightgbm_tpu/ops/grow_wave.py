@@ -37,9 +37,10 @@ within a tree because `cegb_used` is frozen per tree), and distributed
 data-parallel training — in the production reduce-scatter mode
 (`mode="data_rs"`: block-scattered wave histograms + per-wave SplitInfo
 allreduce-max; features block-padded), or full-histogram psum under EFB
-(see `make_wave_grower`).  Forced splits, monotone intermediate, and
-the bounded histogram pool keep the strict grower (priced downgrade
-warning in the booster).
+(see `make_wave_grower`), and forced splits (r5: the BFS prefix runs as
+width-1 waves — strict order by construction — then free growth resumes
+at full width).  Monotone intermediate and the bounded histogram pool
+keep the strict grower (priced downgrade warning in the booster).
 """
 from __future__ import annotations
 
@@ -51,7 +52,8 @@ import jax
 import jax.numpy as jnp
 
 from .grow import (DeviceTree, GrowerSpec, _split_to_arrays,
-                   child_bounds_basic, ic_allowed_from_used,
+                   child_bounds_basic, empty_split_arrays,
+                   forced_split_arrays, ic_allowed_from_used,
                    make_bundled_expander, make_cegb_penalty,
                    make_feature_blocks, make_node_samplers,
                    rebase_and_merge_block_split, split_go_left)
@@ -108,6 +110,7 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
     MB = spec.max_bin
     # grow-then-prune: grow to LB leaves, prune back to L (off: LB == L)
     LB, W = wave_sizes(spec)
+    n_forced = len(spec.forced_splits)
     find = functools.partial(
         find_best_split,
         l1=spec.lambda_l1, l2=spec.lambda_l2,
@@ -224,10 +227,25 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         # every committed split (both children inherit path ∪ {f})
         track_used = spec.n_ic_groups > 0 or (cegb_on and spec.cegb_lazy)
 
+        # forced splits (ref: serial_tree_learner.cpp `ForceSplits`) —
+        # r5: wave-eligible.  The BFS-ordered prefix runs as WIDTH-1
+        # waves (each forced child needs its histogram before the next
+        # forced split, exactly strict order — which width-1 waves are),
+        # then free growth resumes at full wave width.
+        if n_forced:
+            forced_leaf, forced_feat, forced_bin = forced_split_arrays(spec)
+
         def split_of(hist, g, h, c, node_allowed, lb, ub, p_out, nid,
-                     penalty=None):
-            na = node_allowed & bynode_mask(nid)
-            cm = extra_mask(nid)
+                     penalty=None, cand=None):
+            if cand is None:
+                na = node_allowed & bynode_mask(nid)
+                cm = extra_mask(nid)
+            else:
+                # forced split: the designated (feature, bin) only,
+                # bypassing column sampling / extra_trees (the reference
+                # forces before the ColSampler-gated search)
+                na = node_allowed
+                cm = cand
             if block:
                 # block search on this shard's scattered histogram, then
                 # SplitInfo allreduce-max (vmapped over the wave's
@@ -322,13 +340,21 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         )
         if track_used:
             state["leaf_used"] = jnp.zeros((LB, F), bool)
+        if n_forced:
+            # shrinks to `step` if a forced split proves infeasible —
+            # abandoning the rest of the prefix (its BFS leaf numbering
+            # no longer matches the tree), same as the strict grower
+            state["forced_n"] = jnp.int32(n_forced)
 
         LEAF_KEYS = ("leaf_gain", "leaf_feat", "leaf_thr", "leaf_dl",
                      "leaf_lg", "leaf_lh", "leaf_lc", "leaf_rg", "leaf_rh",
                      "leaf_rc", "leaf_iscat", "leaf_catmask")
 
         def cond(st):
-            return (st["step"] < LB - 1) & (jnp.max(st["leaf_gain"]) > 0.0)
+            go = jnp.max(st["leaf_gain"]) > 0.0
+            if n_forced:
+                go = go | (st["step"] < st["forced_n"])
+            return (st["step"] < LB - 1) & go
 
         def body(st):
             # ---- split phase: best-first among READY leaves (leaves
@@ -337,8 +363,13 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             carry_keys = ("step", "nl", "leaf_id", "nodes", "leaf_g",
                           "leaf_h", "leaf_c", "leaf_lb", "leaf_ub",
                           "leaf_out", "leaf_depth") + \
-                (("leaf_used",) if track_used else ())
+                (("leaf_used",) if track_used else ()) + \
+                (("forced_n",) if n_forced else ())
             istate = {k: st[k] for k in carry_keys + LEAF_KEYS}
+            if n_forced:
+                # the forced evaluation searches the designated leaf's
+                # stored histogram inside the pick loop (read-only ride)
+                istate["hist"] = st["hist"]
             istate["ready"] = jnp.arange(LB) < st["nl"]
             istate["w"] = jnp.int32(0)
             # hybrid wave/strict schedule (spec.wave_strict_tail): with
@@ -360,6 +391,10 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                                 (remaining - tail).astype(jnp.int32)))
             else:
                 istate["wcap"] = jnp.int32(W)
+            if n_forced:
+                # forced prefix = width-1 waves (strict BFS order)
+                istate["wcap"] = jnp.where(st["step"] < st["forced_n"],
+                                           jnp.int32(1), istate["wcap"])
             # per-wave pair records; pad slot LB drops out of every scatter
             istate["p_small"] = jnp.full((W,), LB, jnp.int32)
             istate["p_left"] = jnp.full((W,), LB, jnp.int32)
@@ -379,16 +414,56 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
 
             def icond(s):
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
-                return (s["w"] < s["wcap"]) & (s["step"] < LB - 1) & \
-                    (jnp.max(rg) > jnp.maximum(s["g_floor"], 0.0))
+                go = jnp.max(rg) > jnp.maximum(s["g_floor"], 0.0)
+                if n_forced:
+                    # a forced split proceeds regardless of cached gains
+                    go = go | (s["step"] < s["forced_n"])
+                return (s["w"] < s["wcap"]) & (s["step"] < LB - 1) & go
 
             def ibody(s):
                 step = s["step"]
                 new = step + 1           # nl == step + 1 invariant
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
-                best = jnp.argmax(rg).astype(jnp.int32)
+                free_best = jnp.argmax(rg).astype(jnp.int32)
+                if n_forced:
+                    # evaluate the designated (feature, bin) on ITS
+                    # leaf's stored histogram — same semantics (and the
+                    # same no-penalty, sampling-bypassing search) as the
+                    # strict grower's forced prefix
+                    idx = jnp.clip(step, 0, n_forced - 1)
+                    active_forced = step < s["forced_n"]
+
+                    def eval_forced(_):
+                        fl = forced_leaf[idx]
+                        cand = jnp.zeros((F, MB), bool)\
+                            .at[forced_feat[idx], forced_bin[idx]]\
+                            .set(True)
+                        fs = split_of(
+                            s["hist"][fl], s["leaf_g"][fl],
+                            s["leaf_h"][fl], s["leaf_c"][fl],
+                            allowed.at[forced_feat[idx]].set(True),
+                            s["leaf_lb"][fl], s["leaf_ub"][fl],
+                            s["leaf_out"][fl], 0, cand=cand)
+                        return _split_to_arrays(fs)
+
+                    fa = jax.lax.cond(active_forced, eval_forced,
+                                      lambda _: empty_split_arrays(MB),
+                                      None)
+                    forced_ok = active_forced & jnp.isfinite(fa[0])
+                    best = jnp.where(forced_ok, forced_leaf[idx],
+                                     free_best)
+                    forced_n_new = jnp.where(active_forced & ~forced_ok,
+                                             step, s["forced_n"])
+                else:
+                    best = free_best
+                stored = tuple(s[k][best] for k in LEAF_KEYS)
+                if n_forced:
+                    chosen = tuple(jnp.where(forced_ok, a, b)
+                                   for a, b in zip(fa, stored))
+                else:
+                    chosen = stored
                 (gain_s, f, t, dl, lg, lh, lc, rg_, rh, rc, node_cat,
-                 node_mask) = tuple(s[k][best] for k in LEAF_KEYS)
+                 node_mask) = chosen
                 in_leaf = s["leaf_id"] == best
 
                 # ---- partition (shared decode with the strict grower) --
@@ -439,6 +514,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                     child_used = s["leaf_used"][best].at[f].set(True)
                     out["leaf_used"] = s["leaf_used"].at[best]\
                         .set(child_used).at[new].set(child_used)
+                if n_forced:
+                    out["forced_n"] = forced_n_new
                 out.update(
                     step=step + 1, nl=new + 1, leaf_id=leaf_id,
                     nodes=nodes, w=s["w"] + 1,
@@ -462,6 +539,23 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                     leaf_out=put2(s["leaf_out"], l_fin, r_fin),
                     leaf_depth=put2(s["leaf_depth"], depth, depth),
                 )
+                if n_forced:
+                    # if neither the forced split nor the free best is
+                    # applicable (both infeasible), keep the state
+                    # untouched — the shrunken forced_n flips icond's
+                    # forced clause off so the pick loop exits or moves
+                    # on cleanly (mirrors the strict grower's apply_ok
+                    # mask; without it this iteration would commit a
+                    # gain=-inf split with zero child stats → NaN leaf
+                    # outputs)
+                    apply_ok = forced_ok | (gain_s > 0.0)
+                    hist_ride = out.pop("hist")   # read-only: keep out
+                    fallback = {**s, "forced_n": forced_n_new}
+                    fallback.pop("hist")          # of the select
+                    out = jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(apply_ok, a, b),
+                        out, fallback)
+                    out["hist"] = hist_ride
                 return out
 
             s1 = jax.lax.while_loop(icond, ibody, istate)
@@ -579,6 +673,16 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         sl = nd["split_leaf"]
         target = jnp.minimum(n, L - 1)
 
+        # forced splits are NEVER prune candidates — the forced-split
+        # contract outranks gain-based pruning.  They occupy the BFS
+        # prefix (indices < the applied forced count), clamped to the
+        # prune target so an absurdly deep forced chain cannot make the
+        # prune loop unsatisfiable.
+        if n_forced:
+            forced_floor = jnp.minimum(st["forced_n"], target)
+        else:
+            forced_floor = jnp.int32(0)
+
         def pcond(ps):
             return ps["n_alive"] > target
 
@@ -589,7 +693,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             later = alive[None, :] & (idx[None, :] > idx[:, None])
             hit = (sl[None, :] == sl[:, None]) \
                 | (sl[None, :] == idx[:, None] + 1)
-            removable = alive & ~jnp.any(later & hit, axis=1)
+            removable = alive & ~jnp.any(later & hit, axis=1) \
+                & (idx >= forced_floor)
             cand = jnp.where(removable, nd["split_gain"], jnp.inf)
             r = jnp.argmin(cand).astype(jnp.int32)
             b = sl[r]

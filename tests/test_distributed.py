@@ -171,6 +171,79 @@ class TestShardedGrower:
                                    serial.predict(X, raw_score=True),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_wave_data_rs_with_cegb_and_ic_parity(self):
+        """r5: CEGB penalties + interaction constraints must survive the
+        distributed wave grower's block split search (penalty/mask
+        vectors are block-sliced per shard before the SplitInfo merge) —
+        same trees as the serial wave grower."""
+        X, y = make_data(1200, f=8, seed=33)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "learning_rate": 0.1,
+                  "tree_grow_policy": "wave", "verbosity": -1,
+                  "cegb_tradeoff": 0.5, "cegb_penalty_split": 0.01,
+                  "cegb_penalty_feature_coupled": [2.0] * 8,
+                  "interaction_constraints": [[0, 1, 2, 3], [4, 5, 6, 7]]}
+        serial = lgb.train({**params, "tree_learner": "serial"},
+                           lgb.Dataset(X, label=y), num_boost_round=5)
+        assert serial._grow_policy == "wave"
+        dist = lgb.train({**params, "tree_learner": "data"},
+                         lgb.Dataset(X, label=y), num_boost_round=5)
+        assert dist._mesh is not None and dist._grow_policy == "wave"
+        for ts, td in zip(serial.trees, dist.trees):
+            np.testing.assert_array_equal(
+                ts.split_feature[:ts.num_internal()],
+                td.split_feature[:td.num_internal()])
+        gsets = [frozenset(g) for g in ([0, 1, 2, 3], [4, 5, 6, 7])]
+        for t in dist.trees:
+            ni = t.num_internal()
+            for leaf in range(t.num_leaves):
+                feats, cur = set(), -leaf - 1
+                while True:
+                    p = next((i for i in range(ni)
+                              if t.left_child[i] == cur
+                              or t.right_child[i] == cur), None)
+                    if p is None:
+                        break
+                    feats.add(int(t.split_feature[p]))
+                    cur = p
+                assert any(frozenset(feats) <= g for g in gsets), feats
+        np.testing.assert_allclose(dist.predict(X, raw_score=True),
+                                   serial.predict(X, raw_score=True),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_wave_data_rs_forced_splits_parity(self, tmp_path):
+        """r5: forced splits under the distributed wave grower — the
+        forced feature lives on ONE shard's block; its shard proposes
+        the forced split, the others propose -inf, and the SplitInfo
+        merge must still honor the BFS prefix.  Same trees as serial."""
+        import json
+        X, y = make_data(1200, f=8, seed=35)
+        forced = {"feature": 6, "threshold": 0.0,
+                  "left": {"feature": 1, "threshold": 0.3}}
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            json.dump(forced, f)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "min_data_in_leaf": 20, "learning_rate": 0.1,
+                  "tree_grow_policy": "wave", "verbosity": -1,
+                  "forcedsplits_filename": fn}
+        serial = lgb.train({**params, "tree_learner": "serial"},
+                           lgb.Dataset(X, label=y), num_boost_round=4)
+        dist = lgb.train({**params, "tree_learner": "data"},
+                         lgb.Dataset(X, label=y), num_boost_round=4)
+        assert serial._grow_policy == dist._grow_policy == "wave"
+        for b in (serial, dist):
+            for t in b.trees:
+                assert t.split_feature[0] == 6
+                assert t.split_feature[1] == 1
+        for ts, td in zip(serial.trees, dist.trees):
+            np.testing.assert_array_equal(
+                ts.split_feature[:ts.num_internal()],
+                td.split_feature[:td.num_internal()])
+        np.testing.assert_allclose(dist.predict(X, raw_score=True),
+                                   serial.predict(X, raw_score=True),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_distributed_fused_chunks_match_periter(self):
         """The fused chunk trainer accepts the shard_map'ped grower —
         multi-chip training syncs once per chunk and must equal the

@@ -421,19 +421,17 @@ class TestWavePolicy:
         assert aucs[-1] >= aucs[0]
         assert max(aucs) > 0.85
 
-    def test_downgrade_reasons(self, tmp_path, caplog):
-        # r5: CEGB and interaction constraints are wave-ELIGIBLE; forced
-        # splits still downgrade, and the warning prices the fallback
-        import json as _json
+    def test_downgrade_reasons(self, caplog):
+        # r5: CEGB, interaction constraints, and forced splits are all
+        # wave-ELIGIBLE; monotone intermediate still downgrades, and the
+        # warning prices the fallback
         import logging
         X, y = make_binary(1500)
-        fn = str(tmp_path / "forced.json")
-        with open(fn, "w") as f:
-            _json.dump({"feature": 0, "threshold": 0.0}, f)
         with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
             bst = lgb.train({"objective": "binary", "num_leaves": 7,
                              "verbosity": 1, "tree_grow_policy": "wave",
-                             "forcedsplits_filename": fn},
+                             "monotone_constraints": [1] + [0] * 7,
+                             "monotone_constraints_method": "intermediate"},
                             lgb.Dataset(X, label=y), num_boost_round=3)
         assert bst._grow_policy == "leafwise"
         assert "lower training throughput" in caplog.text, caplog.text
@@ -445,6 +443,91 @@ class TestWavePolicy:
                              **extra},
                             lgb.Dataset(X, label=y), num_boost_round=3)
             assert bst._grow_policy == "wave", extra
+
+    def test_forced_splits_under_wave(self, tmp_path):
+        """r5: forced splits run under wave — the BFS prefix is honored
+        (width-1 waves), free growth resumes after, and a full strict
+        tail stays byte-identical to the leafwise grower."""
+        import json as _json
+        X, y = make_binary(2500)
+        forced = {"feature": 4, "threshold": 0.0,
+                  "left": {"feature": 5, "threshold": 0.5}}
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            _json.dump(forced, f)
+        # real waves: prefix honored, policy stays wave, still learns
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0,
+                         "forcedsplits_filename": fn},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        assert bst._grow_policy == "wave"
+        for t in bst.trees:
+            assert t.split_feature[0] == 4
+            assert t.split_feature[1] == 5
+        # byte-identity at full strict tail (width-1 waves == strict)
+        strip = ("[tree_grow_policy", "[tpu_wave")
+        dumps = {}
+        for pol, wav in (("leafwise", {}),
+                         ("wave", {"tpu_wave_strict_tail": 1000,
+                                   "tpu_wave_gain_ratio": 0})):
+            b = lgb.train({"objective": "binary", "num_leaves": 15,
+                           "verbosity": -1, "tree_grow_policy": pol,
+                           "tpu_wave_overgrow": 0,
+                           "forcedsplits_filename": fn, **wav},
+                          lgb.Dataset(X, label=y), num_boost_round=6)
+            assert b._grow_policy == pol
+            txt = b.model_to_string()
+            dumps[pol] = "\n".join(ln for ln in txt.splitlines()
+                                   if not ln.startswith(strip))
+        assert dumps["leafwise"] == dumps["wave"]
+
+    def test_forced_splits_survive_overgrow_prune(self, tmp_path):
+        """Grow-then-prune must never prune the forced prefix — the
+        forced-split contract outranks gain-based pruning (code-review
+        r5 finding: argmin over split_gain had no prefix exclusion)."""
+        import json as _json
+        X, y = make_binary(2500)
+        # force a LOW-VALUE split (a feature the data barely uses) so
+        # the prune would certainly remove it if allowed to
+        forced = {"feature": 7, "threshold": 0.0}
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            _json.dump(forced, f)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0,
+                         "tpu_wave_overgrow": 2.0,
+                         "forcedsplits_filename": fn},
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+        assert bst._grow_policy == "wave"
+        for t in bst.trees:
+            assert t.num_leaves <= 15
+            assert t.split_feature[0] == 7, \
+                "overgrow prune removed the forced root split"
+
+    def test_infeasible_forced_split_under_wave(self, tmp_path):
+        """A forced chain deeper than min_data_in_leaf allows must
+        abandon the remaining prefix under wave too, not corrupt the
+        tree (mirrors the strict grower's regression test)."""
+        import json as _json
+        X, y = make_binary(300)
+        deep = {"feature": 0, "threshold": 0.0}
+        node = deep
+        for i in range(1, 6):
+            node["left"] = {"feature": i % 8, "threshold": 0.0}
+            node = node["left"]
+        fn = str(tmp_path / "deep.json")
+        with open(fn, "w") as f:
+            _json.dump(deep, f)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "min_data_in_leaf": 100,
+                         "forcedsplits_filename": fn},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        assert bst._grow_policy == "wave"
+        p = bst.predict(X)
+        assert np.isfinite(p).all()
 
     def test_cegb_ic_strict_tail_byte_identical(self):
         """r5: CEGB / interaction constraints under wave with a full

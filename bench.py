@@ -57,16 +57,24 @@ ANCHOR_ROWS = 10_500_000
 
 # training config the worker runs, emitted verbatim in the JSON line so a
 # consumer comparing against the stock-leafwise anchor can see the policy
-# difference (the emitted `auc` field keeps quality honest).  r4: the r3c
-# AUC-parity knobs (W=8, capacity-aware gain floor 0.8) PLUS the hybrid
-# strict tail (auto ~num_leaves/3), which collapses the capacity-scarce
-# endgame to exact strict order — the mechanism behind the r3 2M AUC gap
-# (PROFILE.md r4: the 500k quality sweep orders floor+tail >= floor >
-# neither; tail-only-small is the worst config).
+# difference (the emitted `auc` field keeps quality honest).  Derived
+# from benchmarks/configs_r4.py's SHIPPED entry — ONE definition across
+# bench/quality-sweep/family-bench (importlib-by-path: the module is
+# pure dicts, safe for this never-imports-jax orchestrator).  r5: the
+# multi-seed decider (PROFILE.md r5: 3 seeds at BOTH 500k and 2M)
+# picked W=8 + strict tail 16 + no gain floor; the remaining mean gap
+# to strict at 2M is -0.00275 (same sign on 3/3 seeds) — the price of
+# wave throughput; the default user policy stays `leafwise`.
+import importlib.util as _ilu
+
+_spec = _ilu.spec_from_file_location(
+    "_bench_configs",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "benchmarks", "configs_r4.py"))
+_cfg = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(_cfg)
 BENCH_CONFIG = {"num_leaves": NUM_LEAVES, "max_bin": MAX_BIN,
-                "learning_rate": 0.1, "tree_grow_policy": "wave",
-                "tpu_wave_width": 8, "tpu_wave_gain_ratio": 0.8,
-                "tpu_wave_strict_tail": -1}
+                "learning_rate": 0.1, **_cfg.CONFIGS[_cfg.SHIPPED]}
 
 WALL_BUDGET = float(os.environ.get("BENCH_WALL_BUDGET", 540))
 PROBE_BUDGET = float(os.environ.get("BENCH_PROBE_BUDGET", 90))

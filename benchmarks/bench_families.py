@@ -40,10 +40,11 @@ PER_FAMILY_TIMEOUT = float(os.environ.get("SWEEP_TIMEOUT", 600))
 
 FAMILIES = ["lambdarank", "categorical_efb", "goss", "dart", "binary"]
 
-# the bench wave knobs (AUC-parity point) where the family allows wave;
-# lambdarank and categorical paths exercise their own eligibility
-WAVE = {"tree_grow_policy": "wave", "tpu_wave_width": 8,
-        "tpu_wave_gain_ratio": 0.8, "tpu_wave_strict_tail": -1}
+# the SHIPPED bench wave knobs — single-sourced from configs_r4 so the
+# family rows always measure the same config as the headline bench
+from configs_r4 import CONFIGS, SHIPPED  # noqa: E402
+
+WAVE = dict(CONFIGS[SHIPPED])
 
 
 def make_ranking(n_rows, n_feat=136, docs_per_query=120, seed=7):

@@ -8,6 +8,13 @@ orderings at these scales are seed noise — PROFILE.md r4 addendum.)"""
 BASE = {"objective": "binary", "num_leaves": 31, "max_bin": 255,
         "learning_rate": 0.1, "verbosity": -1}
 
+# the SHIPPED bench config (bench.py + bench_families.py derive theirs
+# from this name, so the headline bench, the quality sweep, and the
+# family rows can never silently measure different "shipped" configs).
+# r5 decider: W8 + strict tail 16 + no gain floor — best wave mean AND
+# most seed-stable at both 500k and 2M (PROFILE.md r5).
+SHIPPED = "wave_w8_tail16"
+
 QUANT = {"use_quantized_grad": True, "num_grad_quant_bins": 15}
 
 CONFIGS = {

@@ -26,11 +26,13 @@ ROUNDS = int(sys.argv[2]) if len(sys.argv) > 2 else 48
 PER_CONFIG_TIMEOUT = float(os.environ.get("SWEEP_TIMEOUT", 420))
 
 # speed-sweep default: the TPU-relevant head of the shared table.
-# wave_w8_tail16 is the cross-seed-stable quality challenger (PROFILE r4
-# addendum); r3bench+tail is the shipped bench config.
-SPEED_DEFAULT = ["wave_r3bench+tail", "wave_w8_tail16", "wave_r3bench",
-                 "strict", "wave_w28_tail16+quant", "wave_w16_tail16+quant",
-                 "wave_w8_tail_auto+quant", "wave_w8_tail_auto",
+# wave_w8_tail16 is the SHIPPED bench config as of r5 (multi-seed
+# decider at 500k + 2M, PROFILE.md r5); the r4 floor+auto config and
+# strict follow for the speed/AUC trade rows, then the wide-quant
+# challengers that the int8 42-slot kernel economics motivate.
+SPEED_DEFAULT = ["wave_w8_tail16", "strict", "wave_r3bench+tail",
+                 "wave_w28_tail16+quant", "wave_w16_tail16+quant",
+                 "wave_w8_tail_auto+quant", "wave_r3bench",
                  "strict+quant"]
 
 

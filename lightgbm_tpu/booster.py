@@ -574,19 +574,24 @@ class Booster:
 
     def _wave_strict_tail(self) -> int:
         """Hybrid wave/strict schedule knob: `tpu_wave_strict_tail=-1`
-        (auto) resolves to ~num_leaves/3 — enough strict endgame to
+        (auto) resolves to ~num_leaves/2 — enough strict endgame to
         recover the strict policy's capacity allocation where it binds,
-        small enough that most splits stay wave-batched; 0 disables.
-        The grower caps it at its grow budget (LB - 1, which exceeds
-        num_leaves - 1 under overgrow — the tail is the endgame of the
-        grow phase).  Auto resolves to 0 under overgrow: the prune
-        already reallocates capacity by gain, and a strict tail on the
+        small enough that the early wide waves stay wave-batched; 0
+        disables.  (r4's auto was ~L/3; the r5 multi-seed data moved
+        it: at num_leaves=31, ratio0+tail16 beat ratio0+tail-auto(11)
+        on every 500k seed — a clean tail A/B — and beat the r4
+        floor0.8+tail-auto bench config on every 2M seed; PROFILE.md
+        r5.  16 ≈ L/2.)  The grower caps
+        it at its grow budget (LB - 1, which exceeds num_leaves - 1
+        under overgrow — the tail is the endgame of the grow phase).
+        Auto resolves to 0 under overgrow: the prune already
+        reallocates capacity by gain, and a strict tail on the
         pre-prune growth measurably hurts it (tests/test_wave.py
         overgrow-quality); an explicit value is honored either way."""
         t = int(self.config.tpu_wave_strict_tail)
         if t < 0:
             t = 0 if self._wave_overgrow() > 1.0 \
-                else (self.config.num_leaves + 2) // 3
+                else (self.config.num_leaves + 1) // 2
         return max(t, 0)
 
     def _wave_overgrow(self) -> float:

@@ -141,7 +141,7 @@ class TestWavePolicy:
                                     yv, None, None))
             for t in bst.trees:
                 assert t.num_internal() + 1 <= 31
-        # auto tail (~L/3 strict endgame) should not hurt; allow noise
+        # auto tail (~L/2 strict endgame since r5) should not hurt; allow noise
         assert aucs[-1] >= aucs[0] - 0.004, aucs
 
     def test_overgrow_prune_invariants(self):

@@ -29,9 +29,31 @@ from configs_r4 import BASE, CONFIGS  # noqa: E402 (one shared definition)
 DEFAULT_SEEDS = (77, 123, 2024)
 
 
+def make_deep_binary(n, f=28, seed=77):
+    """Depth-hungry alternative generator (--data deep): the signal
+    lives in nested conditional interactions (sign-gated products and
+    thresholded branches), the regime where strict best-first's
+    capacity allocation matters most — a harder test for the wave
+    policy's strict-tail claim than the additive-ish Higgs-like data."""
+    import numpy as np
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    b0 = X[:, 0] > 0
+    b1 = X[:, 1] > 0.5
+    b2 = X[:, 2] < -0.3
+    score = np.where(b0,
+                     np.where(b1, X[:, 3] * X[:, 4], -X[:, 5] + X[:, 6] ** 2),
+                     np.where(b2, X[:, 7] - X[:, 8] * X[:, 9],
+                              np.sin(2 * X[:, 10]) * X[:, 11]))
+    score = score + 0.3 * X[:, 12]
+    y = (score + rng.randn(n) * 0.8 > 0).astype(np.float64)
+    return X, y
+
+
 def parse_args(argv):
     seeds = list(DEFAULT_SEEDS)
     force_single = False
+    data = "higgs"
     pos = []
     i = 0
     while i < len(argv):
@@ -39,12 +61,17 @@ def parse_args(argv):
         if a == "--seeds":
             seeds = [int(s) for s in argv[i + 1].split(",")]
             i += 2
+        elif a == "--data":
+            data = argv[i + 1]
+            i += 2
         elif a == "--force-single-seed":
             force_single = True
             i += 1
         else:
             pos.append(a)
             i += 1
+    if data not in ("higgs", "deep"):
+        sys.exit(f"unknown --data {data!r} (higgs | deep)")
     n = int(pos[0]) if pos else 500_000
     rounds = int(pos[1]) if len(pos) > 1 else 48
     names = pos[2:] or list(CONFIGS)
@@ -53,11 +80,11 @@ def parse_args(argv):
                  "single-seed AUC deltas at this scale are seed noise "
                  "(PROFILE.md r4 addendum).  Pass --seeds a,b,c or "
                  "--force-single-seed to override for spot checks.")
-    return n, rounds, names, seeds, force_single
+    return n, rounds, names, seeds, data, force_single
 
 
 def main():
-    N, ROUNDS, NAMES, SEEDS, forced = parse_args(sys.argv[1:])
+    N, ROUNDS, NAMES, SEEDS, DATA, forced = parse_args(sys.argv[1:])
     import bench
     import lightgbm_tpu as lgb
     from lightgbm_tpu.metrics import _auc
@@ -65,10 +92,11 @@ def main():
     unknown = set(NAMES) - CONFIGS.keys()
     if unknown:
         sys.exit(f"unknown config name(s): {sorted(unknown)}")
+    gen = bench._make_higgs_like if DATA == "higgs" else make_deep_binary
     n_eval = max(100_000, N // 10)
     per = {name: [] for name in NAMES}
     for seed in SEEDS:
-        X, y = bench._make_higgs_like(N + n_eval, bench.F, seed=seed)
+        X, y = gen(N + n_eval, bench.F, seed=seed)
         X_eval, y_eval = X[N:], y[N:]
         Xs, ys = X[:N], y[:N]
         for name in NAMES:
@@ -113,7 +141,8 @@ def main():
               f"{marker}{flag}")
         prev = s["mean"]
     print("RESULT " + json.dumps({"n": N, "rounds": ROUNDS,
-                                  "seeds": SEEDS, "tie_radius": tie,
+                                  "seeds": SEEDS, "data": DATA,
+                                  "tie_radius": tie,
                                   "configs": stats}), flush=True)
 
 

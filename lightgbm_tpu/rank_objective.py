@@ -19,8 +19,6 @@ Shapes are static: Q × P is fixed at Dataset bind time, masks cover padding.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -32,19 +30,70 @@ from .utils.log import LightGBMError
 Array = jax.Array
 
 
-def _pad_queries(query_boundaries: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-    """Build a [Q, P] gather map (−1 padded) from query boundaries."""
-    qb = np.asarray(query_boundaries, dtype=np.int64)
-    sizes = np.diff(qb)
-    if len(sizes) == 0:
-        raise LightGBMError("Ranking objective requires query information "
-                            "(set group in the Dataset)")
-    P = int(sizes.max())
+def _bucket_queries(sizes: np.ndarray, max_buckets: int = 3,
+                    min_saving: float = 0.2):
+    """Group queries into <= `max_buckets` length buckets, each padded
+    to ITS OWN max (r5).  Real LTR data has long-tailed query sizes
+    (MSLR: median ~120 docs, max ~1k+), and one global pad length makes
+    every median query pay the longest query's [Q, T, P] pair tensor —
+    ~5-8x padded FLOPs.  Per-query math is independent, so bucketing is
+    exactly equivalent; cuts sit at the ~50%/~90% length quantiles, tiny
+    buckets merge into their neighbor, and the flat single-bucket layout
+    is kept unless bucketing saves >= `min_saving` of the padded area
+    (so small/uniform datasets keep the identical old layout and
+    jit-compile exactly one block shape).
+
+    Returns a list of ascending-length query-index arrays."""
     Q = len(sizes)
-    idx = np.full((Q, P), -1, dtype=np.int32)
-    for q in range(Q):
-        idx[q, :sizes[q]] = np.arange(qb[q], qb[q + 1], dtype=np.int32)
-    return idx, sizes
+    order = np.argsort(sizes, kind="stable")
+    flat_area = Q * int(sizes[order[-1]])
+    cuts = sorted({int(Q * 0.5), int(Q * 0.9)})
+    cuts = [c for c in cuts if 0 < c < Q][:max_buckets - 1]
+    groups = []
+    prev = 0
+    for c in cuts + [Q]:
+        if c > prev:
+            groups.append(order[prev:c])
+            prev = c
+    # merge tiny buckets into their successor (last one merges backward)
+    merged = []
+    pending = None
+    for g in groups:
+        if pending is not None:
+            g = np.concatenate([pending, g])
+            pending = None
+        if len(g) < 8:
+            pending = g
+        else:
+            merged.append(g)
+    if pending is not None:
+        if merged:
+            merged[-1] = np.concatenate([merged[-1], pending])
+        else:
+            merged.append(pending)
+    area = sum(len(g) * int(sizes[g].max()) for g in merged)
+    if len(merged) <= 1 or area > (1.0 - min_saving) * flat_area:
+        return [np.arange(Q, dtype=np.int64)]
+    return merged
+
+
+def _build_buckets(qb: np.ndarray, sizes: np.ndarray):
+    """The padded gather maps for each length bucket — ONE builder shared
+    by both ranking objectives.  Each bucket dict carries the host map
+    (`idx_np`, -1 padded, for position binding), the device-side
+    pre-clipped gather index (`gather` — padding already clipped to row
+    0; `mask` is the truth about padding), and the pad mask."""
+    buckets = []
+    for qidx in _bucket_queries(sizes):
+        Pb = int(sizes[qidx].max())
+        idx = np.full((len(qidx), Pb), -1, dtype=np.int32)
+        for row, q in enumerate(qidx):
+            idx[row, :sizes[q]] = np.arange(qb[q], qb[q + 1],
+                                            dtype=np.int32)
+        buckets.append({"idx_np": idx, "qidx": qidx,
+                        "gather": jnp.asarray(np.maximum(idx, 0)),
+                        "mask": jnp.asarray(idx >= 0)})
+    return buckets
 
 
 class LambdarankNDCG(ObjectiveFunction):
@@ -76,7 +125,6 @@ class LambdarankNDCG(ObjectiveFunction):
         self.label_gain = np.asarray(label_gain, dtype=np.float64)
         self.has_state = False        # set by set_positions
         self.num_positions = 0
-        self.pos_padded = None
 
     def init_meta(self, label, weight, query_boundaries):
         super().init_meta(label, weight, query_boundaries)
@@ -87,21 +135,31 @@ class LambdarankNDCG(ObjectiveFunction):
         if int(label.max()) >= len(self.label_gain):
             raise LightGBMError(
                 f"Label {int(label.max())} exceeds label_gain size")
-        self.pad_idx_np, sizes = _pad_queries(query_boundaries)
-        self.pad_idx = jnp.asarray(self.pad_idx_np)
-        self.pad_mask = jnp.asarray(self.pad_idx_np >= 0)
+        qb = np.asarray(query_boundaries, dtype=np.int64)
+        sizes = np.diff(qb)
+        if len(sizes) == 0:
+            raise LightGBMError("Ranking objective requires query "
+                                "information (set group in the Dataset)")
+        self._num_data = int(qb[-1])
         # per-query inverse max DCG over the full query (ref: LambdarankNDCG
         # Init computes inverse_max_dcgs_ at truncation_level)
         gains = self.label_gain[label.astype(np.int64)]
         inv_max = np.zeros(len(sizes), dtype=np.float64)
-        qb = np.asarray(query_boundaries)
         T = self.truncation_level
         for q in range(len(sizes)):
             g = np.sort(gains[qb[q]:qb[q + 1]])[::-1][:T]
             dcg = np.sum(g / np.log2(np.arange(2, len(g) + 2)))
             inv_max[q] = 1.0 / dcg if dcg > 0 else 0.0
-        self.inv_max_dcg = jnp.asarray(inv_max.astype(np.float32))
+        inv_max = inv_max.astype(np.float32)
         self.gain_table = jnp.asarray(self.label_gain.astype(np.float32))
+        # r5: length-bucketed padded layout — each bucket pads to its
+        # own max, so median queries stop paying the longest query's
+        # [Q, T, P] pair tensor (see _bucket_queries; single bucket ==
+        # the old flat layout, bit-for-bit)
+        self._buckets = _build_buckets(qb, sizes)
+        for b in self._buckets:
+            b["inv_max"] = jnp.asarray(inv_max[b["qidx"]])
+            b["pos"] = None
 
     # ------------------------------------------------- position debiasing
     def set_positions(self, position: np.ndarray) -> None:
@@ -113,19 +171,19 @@ class LambdarankNDCG(ObjectiveFunction):
         gappy encodings would otherwise leave the anchor empty and blow
         up the normalizer."""
         pos = np.asarray(position, dtype=np.int64).reshape(-1)
-        num_data = int(self.pad_idx_np.max()) + 1
-        if len(pos) != num_data:
+        if len(pos) != self._num_data:
             raise LightGBMError(
                 f"Length of position ({len(pos)}) does not match "
-                f"number of data ({num_data})")
+                f"number of data ({self._num_data})")
         if pos.min() < 0:
             raise LightGBMError("positions must be non-negative integers")
         uniq, inv = np.unique(pos, return_inverse=True)
         self.num_positions = len(uniq)
         pos_ids = inv.astype(np.int32)
-        grid = pos_ids[np.maximum(self.pad_idx_np, 0)]
-        grid[self.pad_idx_np < 0] = 0
-        self.pos_padded = jnp.asarray(grid)                     # [Q, P]
+        for b in self._buckets:
+            grid = pos_ids[np.maximum(b["idx_np"], 0)]
+            grid[b["idx_np"] < 0] = 0
+            b["pos"] = jnp.asarray(grid)                # [Qb, Pb]
         self.has_state = True
 
     def init_state(self):
@@ -133,26 +191,31 @@ class LambdarankNDCG(ObjectiveFunction):
         k = max(self.num_positions, 1)
         return (jnp.ones((k,), jnp.float32), jnp.ones((k,), jnp.float32))
 
-    def grad_hess(self, score, label, weight, state=None):
-        P = self.pad_idx.shape[1]
+    def _bucket_lambdas(self, b, score, label, state):
+        """Per-bucket [Qb, T, Pb] pair computation → (lam_q, h_q, lp, lm):
+        padded per-row lambdas/hessians plus this bucket's raw
+        propensity mass (lp/lm are None without position state).  The
+        math is per-query, so bucketing changes nothing (the single-
+        bucket case is the pre-r5 flat layout bit-for-bit)."""
+        mask = b["mask"]
+        P = b["gather"].shape[1]
         T = min(self.truncation_level, P)
         sig = self.sigmoid
-        idx = jnp.maximum(self.pad_idx, 0)
-        s = jnp.where(self.pad_mask, score[idx], -jnp.inf)     # [Q, P]
-        y = jnp.where(self.pad_mask, label[idx].astype(jnp.int32), -1)
-        gains = jnp.where(self.pad_mask, self.gain_table[jnp.maximum(y, 0)],
-                          0.0)
+        idx = b["gather"]
+        s = jnp.where(mask, score[idx], -jnp.inf)              # [Qb, Pb]
+        y = jnp.where(mask, label[idx].astype(jnp.int32), -1)
+        gains = jnp.where(mask, self.gain_table[jnp.maximum(y, 0)], 0.0)
 
         # rank by score desc (padding sinks to the bottom via -inf)
-        order = jnp.argsort(-s, axis=1)                         # [Q, P]
+        order = jnp.argsort(-s, axis=1)                        # [Qb, Pb]
         s_sorted = jnp.take_along_axis(s, order, axis=1)
         g_sorted = jnp.take_along_axis(gains, order, axis=1)
-        m_sorted = jnp.take_along_axis(self.pad_mask, order, axis=1)
+        m_sorted = jnp.take_along_axis(mask, order, axis=1)
         discount = 1.0 / jnp.log2(jnp.arange(P, dtype=jnp.float32) + 2.0)
 
         # pairs: i over top-T ranks, j over all ranks (i < j by rank)
-        si = s_sorted[:, :T, None]                              # [Q, T, 1]
-        sj = s_sorted[:, None, :]                               # [Q, 1, P]
+        si = s_sorted[:, :T, None]                             # [Qb, T, 1]
+        sj = s_sorted[:, None, :]                              # [Qb, 1, Pb]
         gi = g_sorted[:, :T, None]
         gj = g_sorted[:, None, :]
         di = discount[None, :T, None]
@@ -170,46 +233,40 @@ class LambdarankNDCG(ObjectiveFunction):
         dcg_gap = jnp.abs(gi - gj)
         paired_discount = jnp.abs(di - dj)
         delta = dcg_gap * paired_discount * \
-            self.inv_max_dcg[:, None, None]                     # [Q, T, P]
+            b["inv_max"][:, None, None]                        # [Qb, T, Pb]
 
-        new_state = None
-        if state is not None and self.pos_padded is not None:
+        lp = lm = None
+        if state is not None and b["pos"] is not None:
             # unbiased-LambdaMART correction: divide each pair's weight by
-            # the learned click propensities, then re-estimate them from
-            # this iteration's raw lambda mass (alternating minimization)
+            # the learned click propensities; the raw lambda mass (lp/lm)
+            # is returned for the caller's cross-bucket re-estimate
             t_plus, t_minus = state
-            pos_sorted = jnp.take_along_axis(self.pos_padded, order, axis=1)
-            p_i = jnp.broadcast_to(pos_sorted[:, :T, None],
-                                   valid.shape)
+            pos_sorted = jnp.take_along_axis(b["pos"], order, axis=1)
+            p_i = jnp.broadcast_to(pos_sorted[:, :T, None], valid.shape)
             p_j = jnp.broadcast_to(pos_sorted[:, None, :], valid.shape)
             pos_high = jnp.where(high_is_i, p_i, p_j)
             pos_low = jnp.where(high_is_i, p_j, p_i)
             prob = jax.nn.sigmoid(-sig * (s_high - s_low))
             lam_mag = jnp.where(valid, sig * prob * delta, 0.0)
-            lp = jnp.zeros_like(t_plus).at[pos_high.reshape(-1)].add(
+            lp = jnp.zeros((max(self.num_positions, 1),), jnp.float32)\
+                .at[pos_high.reshape(-1)].add(
                 (lam_mag / t_minus[pos_low]).reshape(-1))
-            lm = jnp.zeros_like(t_minus).at[pos_low.reshape(-1)].add(
+            lm = jnp.zeros((max(self.num_positions, 1),), jnp.float32)\
+                .at[pos_low.reshape(-1)].add(
                 (lam_mag / t_plus[pos_high]).reshape(-1))
-            exponent = 1.0 / (1.0 + self.bias_reg)
-            tp_new = jnp.where(
-                lp > 0, (lp / jnp.maximum(lp[0], 1e-20)) ** exponent, 1.0)
-            tm_new = jnp.where(
-                lm > 0, (lm / jnp.maximum(lm[0], 1e-20)) ** exponent, 1.0)
-            new_state = (tp_new.astype(jnp.float32),
-                         tm_new.astype(jnp.float32))
             delta = delta / (t_plus[pos_high] * t_minus[pos_low])
 
-        p = jax.nn.sigmoid(-sig * (s_high - s_low))             # 1/(1+e^{σΔ})
-        lam = -sig * p * delta                                  # d/ds_high
+        p = jax.nn.sigmoid(-sig * (s_high - s_low))            # 1/(1+e^{σΔ})
+        lam = -sig * p * delta                                 # d/ds_high
         hess = sig * sig * p * (1.0 - p) * delta
         lam = jnp.where(valid, lam, 0.0)
         hess = jnp.where(valid, hess, 0.0)
 
         # accumulate onto sorted positions: high gets +lam, low gets -lam
-        lam_i = jnp.where(high_is_i, lam, -lam).sum(axis=2)     # [Q, T]
-        lam_j = jnp.where(high_is_i, -lam, lam).sum(axis=1)     # [Q, P]
-        h_i = hess.sum(axis=2)                                  # [Q, T]
-        h_j = hess.sum(axis=1)                                  # [Q, P]
+        lam_i = jnp.where(high_is_i, lam, -lam).sum(axis=2)    # [Qb, T]
+        lam_j = jnp.where(high_is_i, -lam, lam).sum(axis=1)    # [Qb, Pb]
+        h_i = hess.sum(axis=2)
+        h_j = hess.sum(axis=1)
         lam_sorted = jnp.zeros(s.shape, dtype=jnp.float32)\
             .at[:, :T].add(lam_i) + lam_j
         h_sorted = jnp.zeros(s.shape, dtype=jnp.float32)\
@@ -223,17 +280,43 @@ class LambdarankNDCG(ObjectiveFunction):
             lam_sorted = lam_sorted * factor
             h_sorted = h_sorted * factor
 
-        # unsort back to query positions, then scatter to flat rows
+        # unsort back to query positions; zero the pad slots so the
+        # caller's scatter-add at clipped index 0 adds nothing
         inv_order = jnp.argsort(order, axis=1)
-        lam_q = jnp.take_along_axis(lam_sorted, inv_order, axis=1)
-        h_q = jnp.take_along_axis(h_sorted, inv_order, axis=1)
-        lam_q = jnp.where(self.pad_mask, lam_q, 0.0)
-        h_q = jnp.where(self.pad_mask, h_q, 0.0)
+        lam_q = jnp.where(mask, jnp.take_along_axis(lam_sorted, inv_order,
+                                                    axis=1), 0.0)
+        h_q = jnp.where(mask, jnp.take_along_axis(h_sorted, inv_order,
+                                                  axis=1), 0.0)
+        return lam_q, h_q, lp, lm
 
-        grad = jnp.zeros_like(score).at[idx.reshape(-1)].add(
-            lam_q.reshape(-1))
-        hessian = jnp.zeros_like(score).at[idx.reshape(-1)].add(
-            h_q.reshape(-1))
+    def grad_hess(self, score, label, weight, state=None):
+        grad = jnp.zeros_like(score)
+        hessian = jnp.zeros_like(score)
+        lp_acc = lm_acc = None
+        for b in self._buckets:
+            lam_q, h_q, lp, lm = self._bucket_lambdas(b, score, label,
+                                                      state)
+            flat = b["gather"].reshape(-1)
+            grad = grad.at[flat].add(lam_q.reshape(-1))
+            hessian = hessian.at[flat].add(h_q.reshape(-1))
+            if lp is not None:
+                lp_acc = lp if lp_acc is None else lp_acc + lp
+                lm_acc = lm if lm_acc is None else lm_acc + lm
+        new_state = None
+        if lp_acc is not None:
+            # re-estimate propensities from the GLOBAL raw lambda mass
+            # (alternating minimization, normalized to position 0,
+            # exponent 1/(1+reg)) — summed across buckets first so the
+            # estimate is identical to the flat layout's
+            exponent = 1.0 / (1.0 + self.bias_reg)
+            tp_new = jnp.where(
+                lp_acc > 0,
+                (lp_acc / jnp.maximum(lp_acc[0], 1e-20)) ** exponent, 1.0)
+            tm_new = jnp.where(
+                lm_acc > 0,
+                (lm_acc / jnp.maximum(lm_acc[0], 1e-20)) ** exponent, 1.0)
+            new_state = (tp_new.astype(jnp.float32),
+                         tm_new.astype(jnp.float32))
         if weight is not None:
             grad = grad * weight
             hessian = hessian * weight
@@ -253,32 +336,40 @@ class RankXENDCG(ObjectiveFunction):
         super().init_meta(label, weight, query_boundaries)
         if query_boundaries is None:
             raise LightGBMError("Ranking tasks require query information")
-        self.pad_idx_np, _ = _pad_queries(query_boundaries)
-        self.pad_idx = jnp.asarray(self.pad_idx_np)
-        self.pad_mask = jnp.asarray(self.pad_idx_np >= 0)
+        qb = np.asarray(query_boundaries, dtype=np.int64)
+        sizes = np.diff(qb)
+        if len(sizes) == 0:
+            raise LightGBMError("Ranking objective requires query "
+                                "information (set group in the Dataset)")
+        # same length-bucketed layout as LambdarankNDCG (per-query math)
+        self._buckets = _build_buckets(qb, sizes)
 
     def grad_hess(self, score, label, weight, key=None):
         if key is None:
             key = jax.random.PRNGKey(self.config.objective_seed)
-        idx = jnp.maximum(self.pad_idx, 0)
-        mask = self.pad_mask
-        s = jnp.where(mask, score[idx], -jnp.inf)
-        y = jnp.where(mask, label[idx], 0.0)
-        gammas = jax.random.uniform(key, s.shape)
-        # phi = 2^y - gamma (ref: RankXENDCG::GetGradientsForOneQuery)
-        phi = jnp.where(mask, jnp.exp2(y) - gammas, 0.0)
-        phi_sum = phi.sum(axis=1, keepdims=True)
-        p_target = phi / jnp.maximum(phi_sum, 1e-20)
-        rho = jax.nn.softmax(s, axis=1)
-        rho = jnp.where(mask, rho, 0.0)
-        grad_q = rho - p_target
-        hess_q = rho * (1.0 - rho)
-        grad_q = jnp.where(mask, grad_q, 0.0)
-        hess_q = jnp.where(mask, jnp.maximum(hess_q, 1e-16), 0.0)
-        grad = jnp.zeros_like(score).at[idx.reshape(-1)].add(
-            grad_q.reshape(-1))
-        hessian = jnp.zeros_like(score).at[idx.reshape(-1)].add(
-            hess_q.reshape(-1))
+        grad = jnp.zeros_like(score)
+        hessian = jnp.zeros_like(score)
+        single = len(self._buckets) == 1
+        for k, b in enumerate(self._buckets):
+            # single bucket keeps the PRE-bucketing RNG stream (the raw
+            # key), so uniform-query datasets reproduce old-seed models
+            bkey = key if single else jax.random.fold_in(key, k)
+            idx = b["gather"]
+            mask = b["mask"]
+            s = jnp.where(mask, score[idx], -jnp.inf)
+            y = jnp.where(mask, label[idx], 0.0)
+            gammas = jax.random.uniform(bkey, s.shape)
+            # phi = 2^y - gamma (ref: RankXENDCG::GetGradientsForOneQuery)
+            phi = jnp.where(mask, jnp.exp2(y) - gammas, 0.0)
+            phi_sum = phi.sum(axis=1, keepdims=True)
+            p_target = phi / jnp.maximum(phi_sum, 1e-20)
+            rho = jax.nn.softmax(s, axis=1)
+            rho = jnp.where(mask, rho, 0.0)
+            grad_q = jnp.where(mask, rho - p_target, 0.0)
+            hess_q = jnp.where(mask,
+                               jnp.maximum(rho * (1.0 - rho), 1e-16), 0.0)
+            grad = grad.at[idx.reshape(-1)].add(grad_q.reshape(-1))
+            hessian = hessian.at[idx.reshape(-1)].add(hess_q.reshape(-1))
         if weight is not None:
             grad = grad * weight
             hessian = hessian * weight

@@ -90,6 +90,47 @@ def _log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+# structured orchestrator telemetry: probe/worker lifecycle events as JSONL
+# (machine-diagnosable wedged-tunnel rounds — ISSUE observability; the
+# bare-string probe _logs they replace were unparseable).  The sinks module
+# is loaded by FILE PATH like configs_r4 above: this process never imports
+# jax or the lightgbm_tpu package.
+_SINKS_MOD = None
+_SINKS = None
+
+
+def _telemetry_sinks():
+    global _SINKS_MOD, _SINKS
+    if _SINKS is None:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lightgbm_tpu", "telemetry", "sinks.py")
+        spec = _ilu.spec_from_file_location("_bench_sinks", path)
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _SINKS_MOD = mod
+        _SINKS = [mod.JsonlSink(sys.stderr)]
+        extra = os.environ.get("BENCH_TELEMETRY_JSONL")
+        if extra:
+            _SINKS.append(mod.JsonlSink(extra))
+    return _SINKS_MOD, _SINKS
+
+
+def _event(name: str, **fields) -> None:
+    """Emit one structured orchestrator event to stderr (+ optional
+    BENCH_TELEMETRY_JSONL file).  Never raises — a dead sink must not
+    take down the bench."""
+    try:
+        mod, sinks = _telemetry_sinks()
+        ev = mod.make_event("event", name, **fields)
+        for s in sinks:
+            try:
+                s.emit(ev)
+            except Exception:
+                pass
+    except Exception:
+        pass
+
+
 # last end-to-end measurement on REAL TPU hardware (builder session;
 # full provenance in PROFILE.md "round 3c").  Attached as clearly-labeled
 # context when a wedged tunnel forces the CPU fallback, so the round's
@@ -99,7 +140,8 @@ TPU_RECORD = {"value": 2.956, "auc": 0.8978, "n": 2_000_000,
 
 
 def _emit(rounds_per_sec: float, n_rows: int, backend: str,
-          partial: bool, auc=None, pred=None) -> None:
+          partial: bool, auc=None, pred=None, probe=None,
+          telemetry=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -122,6 +164,15 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         # batch-predict throughput (device jitted ensemble vs host walk)
         line["predict_device_rows_per_sec"] = pred[0]
         line["predict_host_rows_per_sec"] = pred[1]
+    if probe is not None:
+        # full backend-probe attempt history (per-attempt rc/duration/
+        # hang), so a wedged-tunnel round is diagnosable from the JSON
+        # line alone
+        line["probe"] = probe
+    if telemetry is not None:
+        # worker-side metrics snapshot (@telemetry line): rounds trained,
+        # span timings, fallback counters
+        line["telemetry"] = telemetry
     if backend.startswith("cpu-fallback"):
         line["tpu_record"] = TPU_RECORD
     print(json.dumps(line), flush=True)
@@ -131,8 +182,9 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
 # orchestrator
 # --------------------------------------------------------------------------
 
-def _probe_backend() -> bool:
-    """True iff the default JAX backend initialises and runs a matmul.
+def _probe_backend():
+    """(ok, attempts): whether the default JAX backend initialises and
+    runs a matmul, plus the per-attempt history for the BENCH JSON.
 
     Short KILLABLE attempts (<= 30 s each) inside a hard total budget
     (<= PROBE_BUDGET, default 90 s): a healthy TPU answers the matmul in
@@ -140,12 +192,14 @@ def _probe_backend() -> bool:
     the axon tunnel occasionally drops exactly one connection attempt,
     so up to 3 tries fit the budget (VERDICT r3 #2; round 2 burned ~11
     minutes on 4x150 s probes, round 3's single 90 s attempt gave a
-    flaky tunnel no second chance)."""
+    flaky tunnel no second chance).  Each attempt emits one structured
+    `probe.attempt` event (attempt/outcome/rc/duration/timeout)."""
     code = ("import jax; d = jax.devices(); import jax.numpy as jnp; "
             "x = jnp.ones((64,64)); (x@x).block_until_ready(); "
             "print(d[0].platform, len(d))")
     deadline = time.time() + min(PROBE_BUDGET, max(_remaining() - 60, 10))
     attempt = 0
+    attempts = []
     while time.time() < deadline:
         attempt += 1
         timeout = max(5.0, min(30.0, deadline - time.time()))
@@ -156,27 +210,40 @@ def _probe_backend() -> bool:
                                env=dict(os.environ), text=True)
         except subprocess.TimeoutExpired:
             # the flaky-tunnel case the retry exists for
-            _log(f"backend probe attempt {attempt} HUNG (>{timeout:.0f}s)")
+            attempts.append({"attempt": attempt, "outcome": "hang",
+                             "rc": None, "timeout_s": round(timeout, 1),
+                             "duration_s": round(time.time() - t0, 2)})
+            _event("probe.attempt", **attempts[-1])
             continue
         except OSError as e:
-            _log(f"backend probe failed to launch: {e}")
-            return False
+            attempts.append({"attempt": attempt, "outcome": "launch_failed",
+                             "rc": None, "error": str(e),
+                             "duration_s": round(time.time() - t0, 2)})
+            _event("probe.attempt", **attempts[-1])
+            return False, attempts
         if r.returncode == 0:
-            _log(f"backend probe ok in {time.time() - t0:.1f}s "
-                 f"(attempt {attempt}): {r.stdout.strip()}")
-            return True
+            attempts.append({"attempt": attempt, "outcome": "ok", "rc": 0,
+                             "duration_s": round(time.time() - t0, 2),
+                             "backend": r.stdout.strip()})
+            _event("probe.attempt", **attempts[-1])
+            return True, attempts
         # a nonzero exit is DETERMINISTIC (broken jax/backend, not a
         # dropped connection) — fail fast, don't burn the budget
         # re-spawning an instant failure
-        _log(f"backend probe attempt {attempt} rc={r.returncode}: "
-             f"{r.stderr.strip()[-300:]}")
-        return False
-    _log("backend probe budget exhausted — backend unavailable")
-    return False
+        attempts.append({"attempt": attempt, "outcome": "error",
+                         "rc": r.returncode,
+                         "duration_s": round(time.time() - t0, 2),
+                         "stderr_tail": r.stderr.strip()[-300:]})
+        _event("probe.attempt", **attempts[-1])
+        return False, attempts
+    _event("probe.budget_exhausted", attempts=attempt,
+           budget_s=round(PROBE_BUDGET, 1))
+    return False, attempts
 
 
 def _run_orchestrator() -> None:
-    backend_ok = _probe_backend()
+    backend_ok, probe_attempts = _probe_backend()
+    probe_info = {"ok": backend_ok, "attempts": probe_attempts}
     env = dict(os.environ)
     if backend_ok:
         n = int(os.environ.get("BENCH_N", 2_000_000))
@@ -214,6 +281,7 @@ def _run_orchestrator() -> None:
     final = None
     auc = None
     pred = None
+    worker_telemetry = None
     platform = backend_tag
     deadline = time.time() + worker_timeout
     try:
@@ -260,6 +328,13 @@ def _run_orchestrator() -> None:
                     pred = tuple(float(v) for v in line.split()[1:3])
                 elif line.startswith("@final "):
                     final = float(line.split()[1])
+                elif line.startswith("@telemetry "):
+                    # worker metrics snapshot, one JSON object on the line
+                    try:
+                        worker_telemetry = json.loads(
+                            line.split(None, 1)[1])
+                    except (ValueError, IndexError):
+                        pass
     finally:
         try:
             proc.kill()
@@ -269,16 +344,19 @@ def _run_orchestrator() -> None:
     if backend_tag == "cpu-fallback":
         platform = "cpu-fallback"
     if final is not None:
-        _emit(final, n, platform, partial=False, auc=auc, pred=pred)
+        _emit(final, n, platform, partial=False, auc=auc, pred=pred,
+              probe=probe_info, telemetry=worker_telemetry)
     elif chunks:
         tot_r = sum(c[0] for c in chunks)
         tot_s = sum(c[1] for c in chunks)
-        _emit(tot_r / tot_s, n, platform, partial=True, auc=auc, pred=pred)
+        _emit(tot_r / tot_s, n, platform, partial=True, auc=auc, pred=pred,
+              probe=probe_info, telemetry=worker_telemetry)
     else:
         # nothing measured — still emit a parseable line (value 0) so the
         # round records an explicit failure instead of rc=124/None
-        _log("worker produced no timed chunks")
-        _emit(0.0, n, platform + "-failed", partial=True)
+        _event("worker.no_chunks", backend=platform)
+        _emit(0.0, n, platform + "-failed", partial=True,
+              probe=probe_info, telemetry=worker_telemetry)
 
 
 # --------------------------------------------------------------------------
@@ -315,7 +393,24 @@ def _run_worker() -> None:
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     import lightgbm_tpu as lgb
+    from lightgbm_tpu import telemetry
     from lightgbm_tpu.booster import Booster
+
+    def _stream_telemetry():
+        # one compact registry snapshot for the orchestrator to embed in
+        # the BENCH JSON (emitted after @final AND at exit, so a
+        # wall-budget kill mid predict-bench keeps the training metrics)
+        try:
+            snap = telemetry.REGISTRY.snapshot()
+            print("@telemetry " + json.dumps(snap, separators=(",", ":")),
+                  flush=True)
+        except Exception:
+            pass
+
+    if os.environ.get("BENCH_TELEMETRY_JSONL"):
+        # full span stream (dataset.bin / train.chunk / compile_warmup /
+        # predict.*) to the same file the orchestrator events go to
+        telemetry.TRACER.attach_jsonl(os.environ["BENCH_TELEMETRY_JSONL"])
 
     # TPU-first growth: wave-batched multi-leaf histograms fill the MXU's
     # 128-row LHS (PROFILE.md round 3c); BENCH_CONFIG picks the AUC-parity
@@ -367,6 +462,7 @@ def _run_worker() -> None:
     # predict-bench compile past the wall deadline must not demote it
     # to a partial chunk-reconstructed result
     print(f"@final {rounds_per_sec:.4f}", flush=True)
+    _stream_telemetry()
 
     # batch-predict throughput (VERDICT r3 #6: prediction was never
     # measured): device jitted stacked-ensemble path vs the host walk
@@ -388,6 +484,8 @@ def _run_worker() -> None:
              f"host {host_rps:,.0f} rows/s ({dev_rps / host_rps:.1f}x)")
     except Exception as e:  # pragma: no cover
         _log(f"predict bench failed: {e}")
+    _stream_telemetry()
+    telemetry.TRACER.flush()
 
 
 if __name__ == "__main__":

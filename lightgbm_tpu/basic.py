@@ -228,6 +228,14 @@ class Dataset:
     def construct(self) -> "Dataset":
         if self._handle_constructed:
             return self
+        from .telemetry import span
+        with span("dataset.bin") as sp:
+            out = self._construct_impl()
+            sp.set(rows=getattr(self, "_num_data", None),
+                   cols=getattr(self, "_num_feature", None))
+        return out
+
+    def _construct_impl(self) -> "Dataset":
         if self.reference is not None:
             self.reference.construct()
         if self.used_indices is not None and self.reference is not None:

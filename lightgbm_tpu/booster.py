@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import telemetry
 from .basic import Dataset, _to_2d_float
 from .metrics import Metric, create_metrics
 from .objectives import ObjectiveFunction, create_objective
@@ -231,6 +232,11 @@ class Booster:
             self.params["objective"] = "none"
         self.config = Config(self.params)
         self._warn_inert_params()
+        if self.config.telemetry_sink:
+            # attach BEFORE _DeviceData so the dataset.bin span is captured;
+            # idempotent per path, so re-init / multiple boosters share one
+            # appender
+            telemetry.TRACER.attach_jsonl(self.config.telemetry_sink)
         self._debug_nans = bool(self.config.tpu_debug_nans)
         if self._debug_nans:
             # numeric-sanitizer mode (ref: cmake/Sanitizer.cmake posture):
@@ -708,6 +714,8 @@ class Booster:
             # slower than the wave AUC-parity config on TPU at the 2M
             # bench shape (1.4 vs 2.96 rounds/s, PROFILE.md r3c) — tell
             # users what the fallback costs, not just that it happened
+            telemetry.REGISTRY.counter("fallback.events").inc()
+            telemetry.event("fallback.wave_downgrade", reasons=reasons)
             log.warning("tree_grow_policy=wave is not supported with "
                         + "; ".join(reasons)
                         + " — using the strict leafwise policy (expect "
@@ -764,8 +772,11 @@ class Booster:
             from .ops.pallas_hist import probe_cached
             if probe_cached(*self._probe_shape()):
                 return "pallas_q" if quant_ok else "pallas"
-            log.warning("Pallas histogram probe failed on this backend; "
-                        "falling back to segment-sum")
+            telemetry.REGISTRY.counter("fallback.events").inc()
+            telemetry.event("fallback.pallas_probe",
+                            shape=list(self._probe_shape()))
+            log.error("Pallas histogram probe failed on this backend; "
+                      "falling back to segment-sum")
         if quant_ok:
             # packed-int scatter: one sweep covers (g, h) — the CPU
             # backend's quantized fast path
@@ -986,8 +997,11 @@ class Booster:
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration (ref: basic.py Booster.update →
         LGBM_BoosterUpdateOneIter → GBDT::TrainOneIter)."""
-        with self._nan_check_ctx():
-            return self._update_impl(train_set, fobj)
+        with telemetry.span("train.chunk", rounds=1, fused=False), \
+                self._nan_check_ctx():
+            out = self._update_impl(train_set, fobj)
+        telemetry.REGISTRY.counter("train.rounds").inc()
+        return out
 
     def _update_impl(self, train_set: Optional[Dataset] = None,
                      fobj=None) -> bool:
@@ -1103,9 +1117,15 @@ class Booster:
                     jax.random.fold_in(self._ff_key0, 2 ** 20 + it), k)}
             if qscales is not None:
                 feat = {**feat, "qscales": qscales}
-            dev = self._grower(self._train_bins, gk.astype(jnp.float32),
-                               hk.astype(jnp.float32), sw,
-                               feat, allowed)
+            # first dispatch of a (re)built grower traces + compiles
+            # synchronously — the span wall time is the compile cost
+            warm = getattr(self, "_grower_warmed", None) is self._grower
+            with telemetry.span("compile_warmup", kind="grower") \
+                    if not warm else telemetry.NOOP:
+                dev = self._grower(self._train_bins, gk.astype(jnp.float32),
+                                   hk.astype(jnp.float32), sw,
+                                   feat, allowed)
+            self._grower_warmed = self._grower
             tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
             if "cegb_used" in self._feat and tree.num_leaves > 1:
                 # coupled penalties charge a feature once per MODEL
@@ -1482,21 +1502,32 @@ class Booster:
         """Run ONE compiled chunk; returns (finished, per-iter train scores
         or None, per-valid list of per-iter scores)."""
         trainer = self._bulk_trainer(spec)
+        # first dispatch of a (re)built trainer traces + compiles the whole
+        # chunk program synchronously — span it as compile_warmup
+        warm = getattr(self, "_bulk_warm_key", None) == self._bulk_key
         dd = self._dd
         valid_bins = tuple(v.bins_fm for v in self._valid_dd[:spec.n_valid])
-        with self._nan_check_ctx():
-            score, vfinal, stacked, v_iter, t_iter = trainer(
-                self._train_score, tuple(self._valid_scores[:spec.n_valid]),
-                jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
-                self._grad_key0, self._train_bins, self._feat,
-                jnp.asarray(dd.base_allowed), valid_bins)
-        self._train_score = score
-        if spec.n_valid:
-            self._valid_scores[:spec.n_valid] = list(vfinal)
-        finished = self._decode_stacked(stacked)
-        t_np = np.asarray(jax.device_get(t_iter)) if spec.emit_train_scores \
-            else None
-        v_np = [np.asarray(jax.device_get(v)) for v in v_iter]
+        with telemetry.span("train.chunk", rounds=spec.chunk, fused=True):
+            with telemetry.span("compile_warmup", kind="bulk_trainer") \
+                    if not warm else telemetry.NOOP, self._nan_check_ctx():
+                score, vfinal, stacked, v_iter, t_iter = trainer(
+                    self._train_score,
+                    tuple(self._valid_scores[:spec.n_valid]),
+                    jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
+                    self._grad_key0, self._train_bins, self._feat,
+                    jnp.asarray(dd.base_allowed), valid_bins)
+            self._bulk_warm_key = self._bulk_key
+            self._train_score = score
+            if spec.n_valid:
+                self._valid_scores[:spec.n_valid] = list(vfinal)
+            # _decode_stacked device_gets the finished trees, so the chunk
+            # span ends on real results, not on async dispatch
+            finished = self._decode_stacked(stacked)
+            t_np = np.asarray(jax.device_get(t_iter)) \
+                if spec.emit_train_scores else None
+            v_np = [np.asarray(jax.device_get(v)) for v in v_iter]
+        telemetry.REGISTRY.counter("train.rounds").inc(spec.chunk)
+        telemetry.REGISTRY.counter("train.chunks").inc()
         return finished, t_np, v_np
 
     def update_many(self, n_rounds: int) -> bool:
@@ -1687,6 +1718,11 @@ class Booster:
     # ------------------------------------------------------------------ eval
     def _eval_one(self, score: np.ndarray, ds: Dataset, data_name: str,
                   feval) -> List[Tuple[str, str, float, bool]]:
+        with telemetry.span("eval", dataset=data_name):
+            return self._eval_one_impl(score, ds, data_name, feval)
+
+    def _eval_one_impl(self, score: np.ndarray, ds: Dataset, data_name: str,
+                       feval) -> List[Tuple[str, str, float, bool]]:
         label = ds.get_label()
         weight = ds.get_weight()
         qb = ds._query_boundaries
@@ -1788,6 +1824,7 @@ class Booster:
         n = X.shape[0]
         K = self.num_tree_per_iteration
         trees = self._slice_trees(start_iteration, num_iteration)
+        telemetry.REGISTRY.counter("predict.rows").inc(n)
         if pred_leaf:
             out = np.zeros((n, len(trees)), dtype=np.int32)
             for i, t in enumerate(trees):
@@ -1830,7 +1867,9 @@ class Booster:
             if ck and stacked is not None:
                 self._pred_dev_cache = (ck, stacked)
             if stacked is not None and X.shape[1] >= stacked["min_features"]:
-                raw = self._predict_raw_device(stacked, X)
+                with telemetry.span("predict.device", rows=n,
+                                    trees=len(trees)):
+                    raw = self._predict_raw_device(stacked, X)
                 if getattr(self, "_average_output", False) and len(trees):
                     raw = raw / max(len(trees), 1)
                 if raw_score or self.objective_ is None:
@@ -1838,59 +1877,61 @@ class Booster:
                 return np.asarray(jax.device_get(
                     self.objective_.convert_output(jnp.asarray(raw))))
         raw = None  # allocated by whichever path fills it
-        if es and len(trees):
-            raw = np.zeros((n, K), dtype=np.float64)
-            freq = int(kwargs.get(
-                "pred_early_stop_freq",
-                self.params.get("pred_early_stop_freq", 10)))
-            margin = float(kwargs.get(
-                "pred_early_stop_margin",
-                self.params.get("pred_early_stop_margin", 10.0)))
-            active = np.ones(n, dtype=bool)
-            all_active = True  # avoid masked copies until a row is decided
-            for i, t in enumerate(trees):
-                if all_active:
-                    raw[:, i % K] += t.predict(X)
-                else:
-                    if not active.any():
-                        break
-                    raw[active, i % K] += t.predict(X[active])
-                if (i + 1) % (max(freq, 1) * K) == 0:
-                    if K == 1:
-                        decided = 2.0 * np.abs(raw[:, 0]) >= margin
-                    else:
-                        part = np.partition(raw, K - 2, axis=1)
-                        decided = (part[:, K - 1] - part[:, K - 2]) >= margin
-                    active &= ~decided
-                    all_active = bool(active.all())
-        else:
-            # native tight-loop ensemble walk (ref: predictor.hpp +
-            # c_api.cpp PredictSingleRowFast: model arrays resolved
-            # once, each call is pure traversal; tree i accumulates
-            # into class i % K like the reference's interleaving).
-            # Exact f64 drop-in for the numpy path — same decision
-            # semantics, same tree-order summation — so no behavior
-            # flag is needed.  The library check comes FIRST (no point
-            # flattening a model copy on toolchain-less hosts), and a
-            # too-narrow X skips to the numpy path so it raises the
-            # same IndexError it always did.
-            from . import native
-            nr = None
-            flat = self._flatten_for_native(trees) \
-                if native.get_lib() is not None else None
-            if flat is not None and X.shape[1] >= flat["min_features"]:
-                # num_threads rides per call (works for loaded models
-                # too — model_from_string builds self.config; no global
-                # OpenMP state, so concurrent boosters can't clobber
-                # each other)
-                nthr = int(getattr(self.config, "num_threads", 0) or 0)
-                nr = native.predict_rows(flat, X, K, nthr)
-            if nr is not None:
-                raw = nr            # the C walk zero-inits and fills
-            else:
+        with telemetry.span("predict.host", rows=n, trees=len(trees)):
+            if es and len(trees):
                 raw = np.zeros((n, K), dtype=np.float64)
+                freq = int(kwargs.get(
+                    "pred_early_stop_freq",
+                    self.params.get("pred_early_stop_freq", 10)))
+                margin = float(kwargs.get(
+                    "pred_early_stop_margin",
+                    self.params.get("pred_early_stop_margin", 10.0)))
+                active = np.ones(n, dtype=bool)
+                all_active = True  # avoid masked copies until row decided
                 for i, t in enumerate(trees):
-                    raw[:, i % K] += t.predict(X)
+                    if all_active:
+                        raw[:, i % K] += t.predict(X)
+                    else:
+                        if not active.any():
+                            break
+                        raw[active, i % K] += t.predict(X[active])
+                    if (i + 1) % (max(freq, 1) * K) == 0:
+                        if K == 1:
+                            decided = 2.0 * np.abs(raw[:, 0]) >= margin
+                        else:
+                            part = np.partition(raw, K - 2, axis=1)
+                            decided = (part[:, K - 1] - part[:, K - 2]) \
+                                >= margin
+                        active &= ~decided
+                        all_active = bool(active.all())
+            else:
+                # native tight-loop ensemble walk (ref: predictor.hpp +
+                # c_api.cpp PredictSingleRowFast: model arrays resolved
+                # once, each call is pure traversal; tree i accumulates
+                # into class i % K like the reference's interleaving).
+                # Exact f64 drop-in for the numpy path — same decision
+                # semantics, same tree-order summation — so no behavior
+                # flag is needed.  The library check comes FIRST (no point
+                # flattening a model copy on toolchain-less hosts), and a
+                # too-narrow X skips to the numpy path so it raises the
+                # same IndexError it always did.
+                from . import native
+                nr = None
+                flat = self._flatten_for_native(trees) \
+                    if native.get_lib() is not None else None
+                if flat is not None and X.shape[1] >= flat["min_features"]:
+                    # num_threads rides per call (works for loaded models
+                    # too — model_from_string builds self.config; no global
+                    # OpenMP state, so concurrent boosters can't clobber
+                    # each other)
+                    nthr = int(getattr(self.config, "num_threads", 0) or 0)
+                    nr = native.predict_rows(flat, X, K, nthr)
+                if nr is not None:
+                    raw = nr            # the C walk zero-inits and fills
+                else:
+                    raw = np.zeros((n, K), dtype=np.float64)
+                    for i, t in enumerate(trees):
+                        raw[:, i % K] += t.predict(X)
         if getattr(self, "_average_output", False) and len(trees) >= K:
             raw /= max(len(trees) // K, 1)
         if K == 1:

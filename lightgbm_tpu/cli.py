@@ -220,9 +220,14 @@ def _snapshot_callback(freq: int, output_model: str):
 def run(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
-              "tasks: train | predict | refit | convert_model",
+              "tasks: train | predict | refit | convert_model\n"
+              "       python -m lightgbm_tpu telemetry-report <events.jsonl>",
               file=sys.stderr)
         return 0
+    if argv[0] == "telemetry-report":
+        # subcommand, not a key=value task — handled before parse_args
+        from .telemetry.report import main as report_main
+        return report_main(argv[1:])
     params = parse_args(argv)
     config = Config(params)
     task = config.task

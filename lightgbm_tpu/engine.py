@@ -15,6 +15,7 @@ from typing import Any, Dict, Iterable, List, Optional, Union
 import numpy as np
 
 from . import callback as callback_mod
+from . import telemetry
 from .basic import Dataset
 from .booster import Booster
 from .utils import log
@@ -32,6 +33,38 @@ def train(params: Dict[str, Any], train_set: Dataset,
           keep_training_booster: bool = False,
           callbacks: Optional[List] = None) -> Booster:
     """Train one model (ref: engine.py `train`)."""
+    # attach the sink BEFORE opening the root span — Booster._init_train
+    # would attach it too, but by then train.loop would already have been
+    # handed out as a no-op
+    sink = (params or {}).get("telemetry_sink")
+    if sink:
+        telemetry.TRACER.attach_jsonl(str(sink))
+    # the root telemetry span: Booster construction (dataset.bin), the
+    # boosting loop (train.chunk / compile_warmup / eval) all nest inside
+    with telemetry.span("train.loop", num_boost_round=num_boost_round):
+        booster = _train_impl(params, train_set, num_boost_round,
+                              valid_sets, valid_names, feval, init_model,
+                              keep_training_booster, callbacks)
+    _finish_telemetry(booster)
+    return booster
+
+
+def _finish_telemetry(booster: Booster) -> None:
+    """End-of-train telemetry flush: embed a registry snapshot in any
+    attached JSONL (so `telemetry-report` sees final counters) and write
+    the Prometheus textfile if `telemetry_prometheus` is set."""
+    if telemetry.TRACER.active:
+        telemetry.TRACER.emit_metrics_snapshot()
+        telemetry.TRACER.flush()
+    prom = getattr(getattr(booster, "config", None),
+                   "telemetry_prometheus", "")
+    if prom:
+        telemetry.write_prometheus(prom)
+
+
+def _train_impl(params, train_set, num_boost_round, valid_sets, valid_names,
+                feval, init_model, keep_training_booster,
+                callbacks) -> Booster:
     params = copy.deepcopy(params) if params else {}
     # num_boost_round aliases in params win (reference behavior)
     for key in list(params.keys()):

@@ -187,11 +187,15 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None,
         score, vscores = carry
         # RF trees are independent: gradients at the constant base score
         # (ref: rf.hpp RF::Boosting)
-        grad_at = jnp.zeros_like(score) if spec.rf else score
-        if spec.needs_rng:
-            grad, hess = grad_fn(grad_at, jax.random.fold_in(grad_key0, it))
-        else:
-            grad, hess = grad_fn(grad_at)
+        # named scopes (grad_hess / grow_tree / update_scores) label the
+        # XProf device timeline per phase — compile-time metadata only
+        with jax.named_scope("grad_hess"):
+            grad_at = jnp.zeros_like(score) if spec.rf else score
+            if spec.needs_rng:
+                grad, hess = grad_fn(grad_at,
+                                     jax.random.fold_in(grad_key0, it))
+            else:
+                grad, hess = grad_fn(grad_at)
         # row count from the score, NOT bins_fm — the distributed grower's
         # bin matrix is pre-padded to the mesh shard multiple
         n = score.shape[0]
@@ -234,31 +238,35 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None,
                 # same per-tree stream derivation as booster.__boost
                 tree_feat = {**feat, "ff_key": jax.random.fold_in(
                     jax.random.fold_in(ff_key0, 2 ** 20 + it), k)}
-            dev = grow(bins_fm, gk.astype(jnp.float32),
-                       hk.astype(jnp.float32), sw, tree_feat, allowed)
-            if spec.renew_alpha >= 0.0:
-                renewed = renew_leaf_values(
-                    dev.leaf_value, renew_label - score, renew_w, sw,
-                    dev.leaf_id, spec.grower.num_leaves,
-                    spec.renew_alpha, spec.renew_weighted)
-                # stump trees keep the closed-form output — the per-iteration
-                # path gates renew on num_leaves > 1 (ref: RenewTreeOutput
-                # is only invoked for trees that actually split)
-                dev = dev._replace(leaf_value=jnp.where(
-                    dev.n_splits > 0, renewed, dev.leaf_value))
-            contrib = dev.leaf_value[dev.leaf_id] * lr
-            if K == 1:
-                new_score = new_score + contrib
-            else:
-                new_score = new_score.at[:, k].add(contrib)
-            for vi, vbins in enumerate(valid_bins):
-                vlid = replay_leaf_ids(dev, vbins, feat["nb"],
-                                       feat["missing"])
-                vcontrib = dev.leaf_value[vlid] * lr
+            with jax.named_scope("grow_tree"):
+                dev = grow(bins_fm, gk.astype(jnp.float32),
+                           hk.astype(jnp.float32), sw, tree_feat, allowed)
+                if spec.renew_alpha >= 0.0:
+                    renewed = renew_leaf_values(
+                        dev.leaf_value, renew_label - score, renew_w, sw,
+                        dev.leaf_id, spec.grower.num_leaves,
+                        spec.renew_alpha, spec.renew_weighted)
+                    # stump trees keep the closed-form output — the
+                    # per-iteration path gates renew on num_leaves > 1
+                    # (ref: RenewTreeOutput is only invoked for trees that
+                    # actually split)
+                    dev = dev._replace(leaf_value=jnp.where(
+                        dev.n_splits > 0, renewed, dev.leaf_value))
+            with jax.named_scope("update_scores"):
+                contrib = dev.leaf_value[dev.leaf_id] * lr
                 if K == 1:
-                    new_vscores[vi] = new_vscores[vi] + vcontrib
+                    new_score = new_score + contrib
                 else:
-                    new_vscores[vi] = new_vscores[vi].at[:, k].add(vcontrib)
+                    new_score = new_score.at[:, k].add(contrib)
+                for vi, vbins in enumerate(valid_bins):
+                    vlid = replay_leaf_ids(dev, vbins, feat["nb"],
+                                           feat["missing"])
+                    vcontrib = dev.leaf_value[vlid] * lr
+                    if K == 1:
+                        new_vscores[vi] = new_vscores[vi] + vcontrib
+                    else:
+                        new_vscores[vi] = \
+                            new_vscores[vi].at[:, k].add(vcontrib)
             # leaf_id is per-row train state — not part of the model output
             trees.append(dev._replace(leaf_id=jnp.zeros((0,), jnp.int32)))
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *trees) \

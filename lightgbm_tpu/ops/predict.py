@@ -164,6 +164,9 @@ def predict_raw_ensemble(stacked, X: Array) -> Array:
                            cat_nwords=tree.get("cat_nwords"))
         return carry + out, None
 
-    init = jnp.zeros((X.shape[0],), dtype=jnp.float32)
-    total, _ = jax.lax.scan(step, init, stacked)
-    return total
+    # names the XProf region for the device-predict path (the host-side
+    # analog is the `predict.device` telemetry span in booster.predict)
+    with jax.named_scope("predict_ensemble"):
+        init = jnp.zeros((X.shape[0],), dtype=jnp.float32)
+        total, _ = jax.lax.scan(step, init, stacked)
+        return total

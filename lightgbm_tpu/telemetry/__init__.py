@@ -1,0 +1,31 @@
+"""Unified telemetry: spans, metrics, structured event sinks.
+
+Three always-available pieces (see ISSUE: observability tentpole):
+
+ - `TRACER` / `span()` — named, nested wall-clock phases mirrored into
+   `jax.profiler.TraceAnnotation` when jax is loaded (spans.py);
+ - `REGISTRY` — process-global counters / gauges / timing accumulators
+   with JSON snapshot + Prometheus text export (metrics.py);
+ - sinks — JSONL event log + in-memory capture (sinks.py), summarized
+   by `python -m lightgbm_tpu telemetry-report` (report.py).
+
+This package NEVER imports jax, so `bench.py`'s orchestrator and
+`scripts/probe_tpu.py` can load the submodules by file path from
+jax-free processes.  (Importing it as `lightgbm_tpu.telemetry` runs
+`lightgbm_tpu/__init__.py`, which does pull jax — jax-free callers must
+use `importlib.util.spec_from_file_location` on the submodule files, as
+bench.py already does for utils/env.py.)
+"""
+from .metrics import (Counter, Gauge, MetricsRegistry, REGISTRY, Timing,
+                      write_prometheus)
+from .sinks import JsonlSink, MemorySink, Sink, iso_ts, make_event, read_jsonl
+from .spans import NOOP, Span, TRACER, Tracer, event, span
+from .report import render, summarize
+
+__all__ = [
+    "Counter", "Gauge", "MetricsRegistry", "REGISTRY", "Timing",
+    "write_prometheus",
+    "JsonlSink", "MemorySink", "Sink", "iso_ts", "make_event", "read_jsonl",
+    "NOOP", "Span", "TRACER", "Tracer", "event", "span",
+    "render", "summarize",
+]

@@ -1,0 +1,172 @@
+"""Process-global metrics registry: counters, gauges, timing histograms.
+
+The registry is the always-on half of the telemetry layer (the spans in
+`spans.py` are the other): incrementing a counter is a dict lookup plus an
+integer add under one lock, cheap enough to leave in production hot paths
+(ref: the reference's USE_TIMETAG chrono accumulators in
+serial_tree_learner.cpp — ours are always compiled in, never ifdef'd).
+
+STDLIB-ONLY by design: `bench.py`'s orchestrator and `scripts/probe_tpu.py`
+load telemetry modules by file path in processes that must never import
+jax (a wedged remote-TPU tunnel hangs backend init in uninterruptible
+C++), so nothing in this module may import jax or lightgbm_tpu.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+
+class Counter:
+    """Monotonic counter (rounds trained, rows predicted, probe hangs...)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self.value += n
+
+
+class Gauge:
+    """Last-write-wins value (current chunk size, device count...)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+class Timing:
+    """Timing accumulator: count / total / min / max seconds.
+
+    A fixed-cardinality histogram would need bucket boundaries chosen per
+    phase; min/mean/max covers the per-phase attribution the bench and the
+    report CLI need without that tuning surface.
+    """
+
+    __slots__ = ("name", "count", "total", "min", "max")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+
+    def observe(self, seconds: float) -> None:
+        s = float(seconds)
+        self.count += 1
+        self.total += s
+        if s < self.min:
+            self.min = s
+        if s > self.max:
+            self.max = s
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
+
+class MetricsRegistry:
+    """Thread-safe name -> metric map with snapshot/Prometheus export."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._timings: Dict[str, Timing] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter(name)
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge(name)
+            return m
+
+    def timing(self, name: str) -> Timing:
+        with self._lock:
+            m = self._timings.get(name)
+            if m is None:
+                m = self._timings[name] = Timing(name)
+            return m
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._timings.clear()
+
+    def snapshot(self) -> Dict:
+        """JSON-serializable dump of everything recorded so far."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "timings": {
+                    n: {"count": t.count, "total_s": round(t.total, 6),
+                        "mean_s": round(t.mean, 6),
+                        "min_s": round(t.min, 6) if t.count else 0.0,
+                        "max_s": round(t.max, 6)}
+                    for n, t in self._timings.items()},
+            }
+
+    def to_prometheus(self, prefix: str = "lgbm_tpu") -> str:
+        """Prometheus text-exposition dump of the registry.
+
+        Dotted metric names become underscore-separated (`train.rounds`
+        -> `lgbm_tpu_train_rounds`); timings expand into the conventional
+        `_seconds_count` / `_seconds_sum` pair plus min/max gauges.
+        """
+        def norm(name: str) -> str:
+            out = "".join(c if c.isalnum() else "_" for c in name)
+            return f"{prefix}_{out}"
+
+        lines = []
+        with self._lock:
+            for n, c in sorted(self._counters.items()):
+                m = norm(n)
+                lines.append(f"# TYPE {m} counter")
+                lines.append(f"{m} {c.value}")
+            for n, g in sorted(self._gauges.items()):
+                m = norm(n)
+                lines.append(f"# TYPE {m} gauge")
+                lines.append(f"{m} {g.value:g}")
+            for n, t in sorted(self._timings.items()):
+                m = norm(n) + "_seconds"
+                lines.append(f"# TYPE {m} summary")
+                lines.append(f"{m}_count {t.count}")
+                lines.append(f"{m}_sum {t.total:.6f}")
+                lines.append(f"{m}_min {t.min if t.count else 0.0:.6f}")
+                lines.append(f"{m}_max {t.max:.6f}")
+        return "\n".join(lines) + "\n"
+
+
+#: The process-global registry every instrumented path records into.
+REGISTRY = MetricsRegistry()
+
+
+def write_prometheus(path: str, registry: Optional[MetricsRegistry] = None,
+                     prefix: str = "lgbm_tpu") -> None:
+    """Write a Prometheus text dump of the registry to `path` (atomic
+    enough for a node-exporter textfile collector: write + rename)."""
+    reg = registry if registry is not None else REGISTRY
+    tmp = f"{path}.tmp.{int(time.time() * 1e6)}"
+    with open(tmp, "w") as f:
+        f.write(reg.to_prometheus(prefix))
+    import os
+    os.replace(tmp, path)

@@ -1,0 +1,169 @@
+"""Summarize a telemetry JSONL into a per-phase table.
+
+Backs `python -m lightgbm_tpu telemetry-report <file.jsonl>`: aggregates
+span events by name (count / total / mean / min / max seconds, plus each
+phase's share of the top-level span time), lists point events, and shows
+the final counters from the last embedded metrics snapshot if the run
+wrote one.
+
+STDLIB-ONLY by design (see metrics.py): usable from jax-free processes
+and loadable by file path.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+try:
+    from .sinks import read_jsonl
+except ImportError:  # loaded by file path, outside the package
+    import importlib.util as _ilu
+    import os as _os
+    _spec = _ilu.spec_from_file_location(
+        "_telemetry_report_sinks",
+        _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "sinks.py"))
+    _sinks = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_sinks)
+    read_jsonl = _sinks.read_jsonl
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate parsed events into a JSON-friendly summary dict."""
+    phases: Dict[str, Dict[str, Any]] = {}
+    point_events: Dict[str, int] = {}
+    snapshot: Optional[Dict[str, Any]] = None
+    root_total = 0.0
+    for rec in events:
+        kind = rec.get("ev")
+        if kind == "span":
+            name = rec.get("name", "?")
+            dur = float(rec.get("dur_s", 0.0) or 0.0)
+            p = phases.get(name)
+            if p is None:
+                p = phases[name] = {
+                    "count": 0, "total_s": 0.0,
+                    "min_s": float("inf"), "max_s": 0.0,
+                    "depth": rec.get("depth", 0),
+                    "parents": set(),
+                }
+            p["count"] += 1
+            p["total_s"] += dur
+            p["min_s"] = min(p["min_s"], dur)
+            p["max_s"] = max(p["max_s"], dur)
+            p["depth"] = min(p["depth"], rec.get("depth", 0))
+            if rec.get("parent"):
+                p["parents"].add(rec["parent"])
+            if rec.get("depth", 0) == 0:
+                root_total += dur
+        elif kind == "event":
+            n = rec.get("name", "?")
+            point_events[n] = point_events.get(n, 0) + 1
+        elif kind == "metrics":
+            snapshot = rec.get("snapshot") or snapshot
+    for name, p in phases.items():
+        p["mean_s"] = p["total_s"] / p["count"] if p["count"] else 0.0
+        if p["min_s"] == float("inf"):
+            p["min_s"] = 0.0
+        p["pct_of_root"] = (100.0 * p["total_s"] / root_total
+                            if root_total > 0 else 0.0)
+        p["parents"] = sorted(p["parents"])
+    return {
+        "n_events": len(events),
+        "root_total_s": root_total,
+        "phases": phases,
+        "events": point_events,
+        "metrics": snapshot,
+    }
+
+
+def _tree_order(phases: Dict[str, Dict[str, Any]]) -> List[Any]:
+    """DFS order over the phase parent links: each phase prints under its
+    (first observed) parent, siblings by total time descending.  Returns
+    [(name, render_depth)].  Cycle/self-parent safe (a recursive phase
+    like nested dataset.bin constructs parents to itself)."""
+    children: Dict[str, List[str]] = {}
+    roots: List[str] = []
+    for name, p in phases.items():
+        par = p["parents"][0] if p["parents"] else None
+        if par and par != name and par in phases:
+            children.setdefault(par, []).append(name)
+        else:
+            roots.append(name)
+    by_total = lambda n: -phases[n]["total_s"]  # noqa: E731
+    out: List[Any] = []
+    seen = set()
+
+    def visit(name: str, depth: int) -> None:
+        if name in seen:
+            return
+        seen.add(name)
+        out.append((name, depth))
+        for c in sorted(children.get(name, []), key=by_total):
+            visit(c, depth + 1)
+
+    for r in sorted(roots, key=by_total):
+        visit(r, 0)
+    for name in sorted(phases, key=by_total):  # orphans (cycles)
+        visit(name, phases[name]["depth"])
+    return out
+
+
+def _fmt_s(v: float) -> str:
+    if v >= 100:
+        return f"{v:.1f}"
+    if v >= 1:
+        return f"{v:.3f}"
+    return f"{v * 1e3:.2f}m"  # milliseconds
+
+
+def render(summary: Dict[str, Any]) -> str:
+    """Render a summary dict as a fixed-width text table."""
+    lines: List[str] = []
+    phases = summary["phases"]
+    lines.append(f"events: {summary['n_events']}   "
+                 f"top-level span time: {summary['root_total_s']:.3f}s")
+    if phases:
+        lines.append("")
+        header = (f"{'phase':<34} {'count':>6} {'total_s':>10} "
+                  f"{'mean':>9} {'min':>9} {'max':>9} {'%root':>6}")
+        lines.append(header)
+        lines.append("-" * len(header))
+        for name, depth in _tree_order(phases):
+            p = phases[name]
+            label = ("  " * depth) + name
+            lines.append(
+                f"{label:<34} {p['count']:>6} {p['total_s']:>10.4f} "
+                f"{_fmt_s(p['mean_s']):>9} {_fmt_s(p['min_s']):>9} "
+                f"{_fmt_s(p['max_s']):>9} {p['pct_of_root']:>5.1f}%")
+    if summary["events"]:
+        lines.append("")
+        lines.append("point events:")
+        for name, n in sorted(summary["events"].items()):
+            lines.append(f"  {name:<40} x{n}")
+    snap = summary.get("metrics")
+    if snap and snap.get("counters"):
+        lines.append("")
+        lines.append("counters (final snapshot):")
+        for name, v in sorted(snap["counters"].items()):
+            lines.append(f"  {name:<40} {v}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print("usage: python -m lightgbm_tpu telemetry-report <events.jsonl>")
+        return 0 if argv else 2
+    path = argv[0]
+    try:
+        events = read_jsonl(path)
+    except OSError as e:
+        print(f"telemetry-report: cannot read {path}: {e}", file=sys.stderr)
+        return 2
+    print(render(summarize(events)))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

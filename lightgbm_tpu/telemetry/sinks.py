@@ -1,0 +1,117 @@
+"""Structured event sinks: where telemetry events go.
+
+An *event* is one flat JSON-serializable dict with at least an `"ev"` kind
+tag and a `"name"`.  Sinks receive finished events — span exits, point
+events (probe attempts, fallbacks), metric snapshots — and persist them.
+
+`JsonlSink` supersedes the ad-hoc append-a-JSON-line writers that grew in
+`scripts/probe_tpu.py` (PROBE_LOG.jsonl) and `bench.py`: one shared,
+thread-safe, line-flushed implementation whose records the
+`telemetry-report` CLI can always parse back.
+
+STDLIB-ONLY by design: `bench.py`'s orchestrator and `scripts/probe_tpu.py`
+load this module by file path in processes that must never import jax
+(see metrics.py); nothing here may import jax or lightgbm_tpu.
+"""
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+def make_event(ev: str, name: str, **fields: Any) -> Dict[str, Any]:
+    """Build a well-formed event dict (kind tag + name + UTC timestamp)."""
+    out: Dict[str, Any] = {"ev": ev, "name": name, "ts": round(time.time(), 6)}
+    out.update(fields)
+    return out
+
+
+def iso_ts(epoch: Optional[float] = None) -> str:
+    t = time.time() if epoch is None else epoch
+    return datetime.datetime.fromtimestamp(
+        t, datetime.timezone.utc).isoformat(timespec="seconds")
+
+
+class Sink:
+    """Event consumer interface."""
+
+    def emit(self, event: Dict[str, Any]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemorySink(Sink):
+    """Keep events in a list (tests; bench probe-history accumulation)."""
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        self.events.append(event)
+
+
+class JsonlSink(Sink):
+    """Append events as JSON lines to a file path or open text stream.
+
+    Every emit is one `write(line)` + `flush()` under a lock, so partial
+    records never interleave even with concurrent emitters, and a killed
+    process (the bench's wall-budget kill, a wedged-tunnel abort) loses at
+    most the event in flight — the property the probe log exists for.
+    """
+
+    def __init__(self, path_or_file):
+        self._lock = threading.Lock()
+        if hasattr(path_or_file, "write"):
+            self._f = path_or_file
+            self._owns = False
+            self.path = getattr(path_or_file, "name", "<stream>")
+        else:
+            self.path = os.path.abspath(path_or_file)
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._f = open(self.path, "a")
+            self._owns = True
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        line = json.dumps(event, default=str)
+        with self._lock:
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._owns:
+                self._f.close()
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL event file, skipping unparseable lines (a killed
+    writer may leave one truncated tail line — that must not take the
+    whole report down)."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out

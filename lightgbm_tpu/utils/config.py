@@ -221,6 +221,15 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # ref: cmake/Sanitizer.cmake — TPU/XLA is functional so memory races
     # can't happen; numeric poison is the failure class that remains)
     "tpu_debug_nans": (False, "bool", ()),
+    # telemetry (lightgbm_tpu/telemetry/): JSONL event sink path — spans
+    # (dataset bin, compile/warmup, train chunks, eval, predict), point
+    # events (probe attempts, fallbacks) and a final metrics snapshot are
+    # appended there; summarize with `python -m lightgbm_tpu
+    # telemetry-report <path>`.  Empty = no sink, near-zero overhead
+    "telemetry_sink": ("", "str", ()),
+    # Prometheus text-exposition dump of the metrics registry, written at
+    # the end of engine.train() (node-exporter textfile collector format)
+    "telemetry_prometheus": ("", "str", ()),
     "saved_feature_importance_type": (0, "int", ()),
     "snapshot_freq": (-1, "int", ("save_period",)),
     "output_model": ("LightGBM_model.txt", "str", ("model_output", "model_out")),

@@ -29,8 +29,7 @@ survives it.
 from __future__ import annotations
 
 import argparse
-import datetime
-import json
+import importlib.util
 import os
 import subprocess
 import sys
@@ -38,6 +37,16 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG_PATH = os.path.join(REPO, "PROBE_LOG.jsonl")
+
+# the shared telemetry sink implementation, loaded by FILE PATH (importing
+# the lightgbm_tpu package would pull jax into this deliberately jax-free
+# parent — see module docstring); supersedes the ad-hoc append-a-line
+# writer this script started with
+_spec = importlib.util.spec_from_file_location(
+    "_probe_sinks", os.path.join(REPO, "lightgbm_tpu", "telemetry",
+                                 "sinks.py"))
+_sinks = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_sinks)
 
 AXON_KEYS = ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
              "PALLAS_AXON_REMOTE_COMPILE", "AXON_LOOPBACK_RELAY",
@@ -72,8 +81,7 @@ def probe(timeout: float, label: str) -> bool:
     env.setdefault("TF_CPP_MIN_LOG_LEVEL", "0")
     env.setdefault("TPU_STDERR_LOG_LEVEL", "0")
     rec = {
-        "ts": datetime.datetime.now(datetime.timezone.utc).isoformat(
-            timespec="seconds"),
+        "ts": _sinks.iso_ts(),
         "label": label,
         "timeout_sec": timeout,
         "env": {k: env.get(k) for k in AXON_KEYS if k in env},
@@ -109,8 +117,9 @@ def probe(timeout: float, label: str) -> bool:
         rec["elapsed_sec"] = round(time.time() - t0, 2)
         rec.update(outcome="spawn-failed", error=str(e))
 
-    with open(LOG_PATH, "a") as f:
-        f.write(json.dumps(rec) + "\n")
+    sink = _sinks.JsonlSink(LOG_PATH)
+    sink.emit(rec)
+    sink.close()
     ok = rec["outcome"] == "ok"
     print(f"[probe] {rec['outcome']} in {rec['elapsed_sec']}s"
           + (f" — {rec.get('platform')}x{rec.get('n_devices')}" if ok else "")

@@ -1,0 +1,193 @@
+"""Unit coverage for callback.py (ISSUE 1 satellite).
+
+Drives the callbacks with hand-built `CallbackEnv`s (model=None), the way
+the reference's tests/python_package_test/test_callback.py isolates the
+bookkeeping from training: early_stopping's best_iter/best_score state,
+record_evaluation's dict shape, and log_evaluation through a captured
+registered logger.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.callback import CallbackEnv, EarlyStopException
+from lightgbm_tpu.utils import log
+
+pytestmark = pytest.mark.quick
+
+
+def make_env(iteration, results, params=None, end_iteration=100):
+    return CallbackEnv(model=None, params=params or {}, iteration=iteration,
+                       begin_iteration=0, end_iteration=end_iteration,
+                       evaluation_result_list=results)
+
+
+class CapturingLogger:
+    """Duck-typed logger recording (level, message) pairs."""
+
+    def __init__(self):
+        self.records = []
+
+    def info(self, msg):
+        self.records.append(("info", msg))
+
+    def warning(self, msg):
+        self.records.append(("warning", msg))
+
+
+@pytest.fixture
+def restored_logger():
+    """Snapshot the module-level logger state and restore it afterwards —
+    register_logger mutates process globals."""
+    saved = (log._logger, log._info_method_name, log._warning_method_name,
+             log._verbosity)
+    yield
+    log._logger, log._info_method_name, log._warning_method_name, \
+        log._verbosity = saved
+    log._sync_level()
+
+
+class TestEarlyStopping:
+    def test_best_iter_on_plateau(self):
+        cb = lgb.early_stopping(stopping_rounds=3, verbose=False)
+        scores = [0.50, 0.60, 0.70, 0.70, 0.70, 0.70, 0.70]
+        with pytest.raises(EarlyStopException) as exc:
+            for it, s in enumerate(scores):
+                cb(make_env(it, [("valid_0", "auc", s, True)]))
+        # best was iteration 2 (0.70 first seen); stop 3 rounds later
+        assert exc.value.best_iteration == 2
+        assert exc.value.best_score[0][2] == pytest.approx(0.70)
+
+    def test_lower_is_better_metric(self):
+        cb = lgb.early_stopping(stopping_rounds=2, verbose=False)
+        scores = [1.0, 0.8, 0.9, 0.9, 0.9]
+        with pytest.raises(EarlyStopException) as exc:
+            for it, s in enumerate(scores):
+                cb(make_env(it, [("valid_0", "l2", s, False)]))
+        assert exc.value.best_iteration == 1
+
+    def test_min_delta_ignores_tiny_gains(self):
+        cb = lgb.early_stopping(stopping_rounds=2, verbose=False,
+                                min_delta=0.05)
+        # +0.01 per round never clears the 0.05 delta -> best stays at 0
+        scores = [0.50, 0.51, 0.52, 0.53]
+        with pytest.raises(EarlyStopException) as exc:
+            for it, s in enumerate(scores):
+                cb(make_env(it, [("valid_0", "auc", s, True)]))
+        assert exc.value.best_iteration == 0
+
+    def test_final_iteration_raises_with_best(self):
+        cb = lgb.early_stopping(stopping_rounds=50, verbose=False)
+        scores = [0.5, 0.6, 0.7]
+        with pytest.raises(EarlyStopException) as exc:
+            for it, s in enumerate(scores):
+                cb(make_env(it, [("valid_0", "auc", s, True)],
+                            end_iteration=3))
+        # never degraded: the end-of-training check reports the last/best
+        assert exc.value.best_iteration == 2
+
+    def test_disabled_in_dart_mode(self, restored_logger):
+        cap = CapturingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)  # a prior verbosity=-1 train would gate warning
+        cb = lgb.early_stopping(stopping_rounds=1, verbose=False)
+        for it in range(10):  # way past stopping_rounds; must never raise
+            cb(make_env(it, [("valid_0", "auc", 0.5, True)],
+                        params={"boosting": "dart"}))
+        assert any("dart" in m for _, m in cap.records)
+
+    def test_validates_stopping_rounds(self):
+        with pytest.raises(ValueError):
+            lgb.early_stopping(stopping_rounds=0)
+        with pytest.raises(ValueError):
+            lgb.early_stopping(stopping_rounds=-5)
+
+    def test_requires_eval_results(self):
+        cb = lgb.early_stopping(stopping_rounds=3, verbose=False)
+        with pytest.raises(ValueError):
+            cb(make_env(0, []))
+
+
+class TestRecordEvaluation:
+    def test_records_curves(self):
+        evals = {}
+        cb = lgb.record_evaluation(evals)
+        for it in range(3):
+            cb(make_env(it, [("valid_0", "auc", 0.5 + 0.1 * it, True),
+                             ("valid_0", "binary_logloss",
+                              0.7 - 0.1 * it, False)]))
+        assert evals["valid_0"]["auc"] == pytest.approx([0.5, 0.6, 0.7])
+        assert evals["valid_0"]["binary_logloss"] == \
+            pytest.approx([0.7, 0.6, 0.5])
+
+    def test_rejects_non_dict(self):
+        with pytest.raises(TypeError):
+            lgb.record_evaluation([])
+
+    def test_end_to_end_training(self):
+        rng = np.random.RandomState(5)
+        X = rng.randn(400, 6)
+        y = 2 * X[:, 0] + 0.1 * rng.randn(400)
+        dtr = lgb.Dataset(X[:300], label=y[:300])
+        dva = dtr.create_valid(X[300:], label=y[300:])
+        evals = {}
+        lgb.train({"objective": "regression", "metric": "l2",
+                   "verbosity": -1}, dtr, 5, valid_sets=[dva],
+                  callbacks=[lgb.record_evaluation(evals)])
+        curve = evals["valid_0"]["l2"]
+        assert len(curve) == 5
+        assert curve[-1] < curve[0]
+
+
+class TestLogEvaluation:
+    def test_logs_through_registered_logger(self, restored_logger):
+        cap = CapturingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        cb = lgb.log_evaluation(period=1)
+        cb(make_env(0, [("valid_0", "auc", 0.625, True)]))
+        assert cap.records == [("info", "[1]\tvalid_0's auc: 0.625")]
+
+    def test_period_gating(self, restored_logger):
+        cap = CapturingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        cb = lgb.log_evaluation(period=2)
+        for it in range(4):
+            cb(make_env(it, [("valid_0", "auc", 0.5, True)]))
+        logged = [m for _, m in cap.records]
+        assert len(logged) == 2
+        assert logged[0].startswith("[2]\t")
+        assert logged[1].startswith("[4]\t")
+
+    def test_stdv_formatting(self, restored_logger):
+        cap = CapturingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        cb = lgb.log_evaluation(period=1, show_stdv=True)
+        cb(make_env(0, [("cv_agg", "auc", 0.6, True, 0.02)]))
+        assert cap.records == [("info", "[1]\tcv_agg's auc: 0.6 + 0.02")]
+
+    def test_stdlib_logger_receives_records(self, restored_logger, caplog):
+        logger = logging.getLogger("test_callback_capture")
+        log.register_logger(logger)
+        log.set_verbosity(1)
+        cb = lgb.log_evaluation(period=1)
+        with caplog.at_level(logging.INFO, logger=logger.name):
+            cb(make_env(0, [("valid_0", "auc", 0.9, True)]))
+        assert any("valid_0's auc: 0.9" in r.message for r in caplog.records)
+
+
+class TestResetParameter:
+    def test_list_length_validated(self):
+        cb = lgb.reset_parameter(learning_rate=[0.1, 0.05])
+        with pytest.raises(ValueError):
+            cb(make_env(0, [], params={}, end_iteration=3))
+
+    def test_callable_schedule_updates_params(self):
+        cb = lgb.reset_parameter(learning_rate=lambda it: 0.1 * (it + 1))
+        params = {"learning_rate": 0.0}
+        cb(make_env(2, [], params=params, end_iteration=5))
+        assert params["learning_rate"] == pytest.approx(0.3)

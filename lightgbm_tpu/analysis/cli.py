@@ -1,0 +1,88 @@
+"""`python -m lightgbm_tpu lint` — run graft-lint against the repo.
+
+Exit codes: 0 clean (or everything suppressed by the baseline),
+1 new findings (or stale baseline entries under --strict-baseline),
+2 usage/configuration error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import Optional, Sequence
+
+from .engine import LintEngine
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu lint",
+        description="JAX-aware static analysis (host syncs, recompile "
+                    "traps, numpy-in-ops, shape/dtype contracts, "
+                    "telemetry purity)")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to lint (default: the package)")
+    p.add_argument("--format", choices=("text", "json"),
+                   default="text",
+                   help="text (default) or telemetry-event JSONL")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite lint_baseline.json from the current "
+                        "findings (keeps notes on kept entries)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline path (default: <root>/"
+                        "lint_baseline.json)")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: the checkout containing "
+                        "this package)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="also fail when the baseline has stale "
+                        "entries")
+    return p
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(
+        list(argv) if argv is not None else None)
+    engine = LintEngine(root=args.root, baseline_path=args.baseline)
+    findings = engine.run(args.paths or None)
+
+    if args.update_baseline:
+        engine.write_baseline(findings)
+        print(f"baseline written: {engine.baseline_path} "
+              f"({len(findings)} suppressed finding(s))")
+        return 0
+
+    if args.no_baseline:
+        new, kept, stale = list(findings), [], []
+    else:
+        new, kept, stale = engine.compare(findings)
+
+    if args.format == "json":
+        from ..telemetry.sinks import JsonlSink
+        sink = JsonlSink(sys.stdout)
+        for f in new:
+            sink.emit(f.to_event())
+    else:
+        for f in new:
+            print(f.text())
+
+    notes = []
+    if kept:
+        notes.append(f"{len(kept)} baselined")
+    if stale:
+        notes.append(f"{len(stale)} stale baseline entr"
+                     f"{'y' if len(stale) == 1 else 'ies'} "
+                     "(run --update-baseline)")
+    tail = f" ({', '.join(notes)})" if notes else ""
+    print(f"graft-lint: {len(new)} new finding(s){tail}",
+          file=sys.stderr)
+    if stale and args.strict_baseline:
+        for fp in stale:
+            print(f"stale baseline entry: {fp}", file=sys.stderr)
+        return 1
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,279 @@
+"""Lightweight shape/dtype contracts for the ops/ public entry points.
+
+A contract is a set of per-parameter spec strings attached with
+``@contract(...)``:
+
+    @contract(bins_fm="[F, N] int", payload="[N, 3] f32",
+              max_bin="static:MB", ret="[F, MB, 3] f32")
+    def leaf_histogram(bins_fm, payload, row_mask, max_bin): ...
+
+Spec mini-grammar (one string per parameter, plus ``ret=`` for the
+return value):
+
+  ``"[F, N] int"``    array of rank 2; symbolic dims bind consistently
+                      across all specs of one call (F and N must match
+                      wherever they reappear); dtype must be an int kind
+  ``"[N, 3] f32"``    literal dims pin an axis to an exact size
+  ``"[N, _] any"``    ``_`` is a per-axis wildcard
+  ``"[] float"``      scalar (0-d array or Python number)
+  ``"[F] bool?"``     trailing ``?`` also accepts None (optional arg)
+  ``"array"``         any array value, no shape/dtype constraint
+  ``"tree"``          pytree / opaque structure, unchecked
+  ``"key"``           PRNG key (presence-checked only; ``key?`` optional)
+  ``"static"``        non-array parameter, unchecked
+  ``"static int"``    non-array parameter, documented kind (unchecked)
+  ``"static:MB"``     non-array int whose VALUE binds dim symbol MB
+
+Dtype kinds: ``f32 f64 bf16 i8 i16 i32 i64 u8 u32 int uint float bool
+any`` (``int`` matches any signed/unsigned integer dtype, ``float`` any
+float incl. bfloat16).
+
+Design constraints:
+
+  * stdlib-only — no jax import.  Checks read ``.shape``/``.dtype``
+    duck-typed, so they work on numpy arrays, jax arrays AND tracers
+    (under ``jax.jit`` the wrapper runs at trace time, i.e. once per
+    compilation, never per step).
+  * zero cost when disabled: the wrapper is a single global-flag check.
+    Enable via :func:`enable_runtime_checks` (the ``debug_contracts``
+    param routes here).
+  * decoration-time validation: naming a parameter the function does
+    not have raises immediately (and graft-lint R004 re-checks the same
+    property statically, without importing).
+
+The static half of R004 (annotation/call-site consistency) lives in
+``lightgbm_tpu/analysis/rules.py``; this module is the runtime half.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import re
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "contract", "ContractError", "enable_runtime_checks",
+    "runtime_checks_enabled", "parse_spec", "Spec",
+]
+
+
+class ContractError(TypeError):
+    """A value (or a spec string) violates a @contract annotation."""
+
+
+# -------------------------------------------------------------- state
+_STATE = {"enabled": False}
+
+
+def enable_runtime_checks(on: bool = True) -> None:
+    """Globally enable (or disable) runtime contract checking.
+
+    Wired to the ``debug_contracts`` booster param; note the param only
+    ever ENABLES checking for the process — a second booster with
+    ``debug_contracts=False`` does not switch it back off under an
+    already-debugging sibling.
+    """
+    _STATE["enabled"] = bool(on)
+
+
+def runtime_checks_enabled() -> bool:
+    return _STATE["enabled"]
+
+
+# -------------------------------------------------------------- specs
+_ARRAY_RE = re.compile(r"^\[([^\]]*)\]\s*([A-Za-z_][A-Za-z0-9_]*)(\?)?$")
+_STATIC_RE = re.compile(
+    r"^static(?::([A-Za-z_][A-Za-z0-9_]*)|\s+[A-Za-z_][A-Za-z0-9_]*)?$")
+
+_KINDS = {
+    "any": lambda n: True,
+    "f32": lambda n: n == "float32",
+    "f64": lambda n: n == "float64",
+    "bf16": lambda n: n == "bfloat16",
+    "i8": lambda n: n == "int8",
+    "i16": lambda n: n == "int16",
+    "i32": lambda n: n == "int32",
+    "i64": lambda n: n == "int64",
+    "u8": lambda n: n == "uint8",
+    "u32": lambda n: n == "uint32",
+    "int": lambda n: n.startswith("int") or n.startswith("uint"),
+    "uint": lambda n: n.startswith("uint"),
+    "float": lambda n: n.startswith("float") or n == "bfloat16",
+    "bool": lambda n: n == "bool",
+}
+
+
+class Spec:
+    """Parsed form of one contract spec string."""
+
+    __slots__ = ("text", "kind", "dims", "optional", "binds_value")
+
+    def __init__(self, text: str, kind: str,
+                 dims: Optional[Tuple[object, ...]], optional: bool,
+                 binds_value: Optional[str]):
+        self.text = text          # original string (for messages/docs)
+        self.kind = kind          # dtype kind, or array/tree/key/static
+        self.dims = dims          # tuple of str symbol | int | "_" | None
+        self.optional = optional
+        self.binds_value = binds_value  # symbol bound from a static int
+
+    def __repr__(self):
+        return f"Spec({self.text!r})"
+
+
+def parse_spec(text: str) -> Spec:
+    """Parse one spec string; raises ContractError on bad grammar."""
+    if not isinstance(text, str) or not text.strip():
+        raise ContractError(f"contract spec must be a non-empty string, "
+                            f"got {text!r}")
+    s = text.strip()
+    m = _STATIC_RE.match(s)
+    if m:
+        return Spec(s, "static", None, False, m.group(1))
+    if s == "tree":
+        return Spec(s, s, None, False, None)
+    if s in ("key", "key?"):
+        return Spec(s, "key", None, s.endswith("?"), None)
+    if s in ("array", "array?"):
+        return Spec(s, "array", None, s.endswith("?"), None)
+    m = _ARRAY_RE.match(s)
+    if m is None:
+        raise ContractError(
+            f"unparseable contract spec {text!r} (expected e.g. "
+            f"'[F, N] int', '[] f32', 'static', 'tree')")
+    dims_txt, kind, opt = m.group(1), m.group(2), bool(m.group(3))
+    if kind not in _KINDS:
+        raise ContractError(
+            f"unknown dtype kind {kind!r} in spec {text!r} "
+            f"(known: {', '.join(sorted(_KINDS))})")
+    dims = []
+    for tok in (t.strip() for t in dims_txt.split(",") if t.strip()):
+        if tok == "_":
+            dims.append("_")
+        elif tok.lstrip("-").isdigit():
+            dims.append(int(tok))
+        elif re.match(r"^[A-Za-z_][A-Za-z0-9_]*$", tok):
+            dims.append(tok)
+        else:
+            raise ContractError(f"bad dim token {tok!r} in spec {text!r}")
+    return Spec(s, kind, tuple(dims), opt, None)
+
+
+# ------------------------------------------------------------- checks
+def _shape_dtype(value):
+    shape = getattr(value, "shape", None)
+    dtype = getattr(value, "dtype", None)
+    if shape is None:
+        # python scalars participate as rank-0 values
+        if isinstance(value, bool):
+            return (), "bool"
+        if isinstance(value, int):
+            return (), "int64"
+        if isinstance(value, float):
+            return (), "float64"
+        return None, None
+    return tuple(shape), (str(getattr(dtype, "name", dtype))
+                          if dtype is not None else "any")
+
+
+def _check_value(fname: str, pname: str, spec: Spec, value: Any,
+                 binds: Dict[str, int]) -> None:
+    if spec.kind == "static":
+        if spec.binds_value is not None:
+            if not isinstance(value, (int,)) or isinstance(value, bool):
+                raise ContractError(
+                    f"{fname}: static param '{pname}' must be a python "
+                    f"int to bind dim {spec.binds_value!r}, got "
+                    f"{type(value).__name__}")
+            _bind(fname, pname, spec, spec.binds_value, int(value), binds)
+        return
+    if spec.kind == "tree":
+        return
+    if value is None:
+        if spec.optional:
+            return
+        raise ContractError(f"{fname}: param '{pname}' ({spec.text}) "
+                            f"is None but not marked optional ('?')")
+    if spec.kind == "key":
+        return
+    shape, dtype_name = _shape_dtype(value)
+    if shape is None:
+        raise ContractError(
+            f"{fname}: param '{pname}' expected an array-like for spec "
+            f"'{spec.text}', got {type(value).__name__}")
+    if spec.kind == "array":
+        return
+    if len(shape) != len(spec.dims):
+        raise ContractError(
+            f"{fname}: param '{pname}' rank mismatch — spec "
+            f"'{spec.text}' wants rank {len(spec.dims)}, value has "
+            f"shape {shape}")
+    for axis, (d, actual) in enumerate(zip(spec.dims, shape)):
+        if d == "_":
+            continue
+        if isinstance(d, int):
+            if int(actual) != d:
+                raise ContractError(
+                    f"{fname}: param '{pname}' axis {axis} must be {d} "
+                    f"(spec '{spec.text}'), value has shape {shape}")
+        else:
+            _bind(fname, pname, spec, d, int(actual), binds)
+    # dtype kind (python scalars are weakly typed: int passes float)
+    if not _KINDS[spec.kind](dtype_name):
+        if dtype_name == "int64" and _KINDS[spec.kind]("float32") \
+                and not hasattr(value, "dtype"):
+            return  # python int into a float slot: weak promotion
+        raise ContractError(
+            f"{fname}: param '{pname}' dtype {dtype_name} does not "
+            f"satisfy kind '{spec.kind}' (spec '{spec.text}')")
+
+
+def _bind(fname, pname, spec, symbol, size, binds):
+    prev = binds.setdefault(symbol, size)
+    if prev != size:
+        raise ContractError(
+            f"{fname}: dim '{symbol}' bound inconsistently — "
+            f"{prev} earlier, but param '{pname}' (spec '{spec.text}') "
+            f"gives {size}")
+
+
+# ---------------------------------------------------------- decorator
+def contract(**specs: str):
+    """Attach shape/dtype contracts to a function (see module doc)."""
+    ret_text = specs.pop("ret", None)
+    parsed = {name: parse_spec(s) for name, s in specs.items()}
+    ret_spec = parse_spec(ret_text) if ret_text is not None else None
+
+    def deco(fn):
+        sig = inspect.signature(fn)
+        unknown = set(parsed) - set(sig.parameters)
+        if unknown:
+            raise ContractError(
+                f"@contract on {fn.__qualname__} names unknown "
+                f"parameter(s): {', '.join(sorted(unknown))}")
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _STATE["enabled"]:
+                return fn(*args, **kwargs)
+            try:
+                bound = sig.bind(*args, **kwargs)
+            except TypeError as e:
+                raise ContractError(
+                    f"{fn.__qualname__}: call does not match "
+                    f"signature: {e}") from None
+            bound.apply_defaults()
+            binds: Dict[str, int] = {}
+            for name, spec in parsed.items():
+                _check_value(fn.__qualname__, name, spec,
+                             bound.arguments.get(name), binds)
+            out = fn(*args, **kwargs)
+            if ret_spec is not None:
+                _check_value(fn.__qualname__, "return", ret_spec, out,
+                             binds)
+            return out
+
+        wrapper.__contract__ = {"params": parsed, "ret": ret_spec}
+        return wrapper
+
+    return deco

@@ -1,0 +1,767 @@
+"""graft-lint rule set: JAX hot-path hazard detectors (R001-R005).
+
+Each rule is a small object with an ``id``, a ``title``, and a
+``check(ctx) -> Iterable[Finding]``; rules that need cross-module state
+(R004 call-site consistency) also expose ``collect(ctx)``, which the
+engine runs over every module before any ``check``.
+
+The rules are deliberately high-precision: every heuristic that could
+misfire on legitimate idioms in this codebase (static config flags,
+cached jit factories, explicit ``jax.device_get`` syncs) carries an
+exemption, and anything that still slips through is suppressed via the
+checked-in baseline rather than by weakening the rule.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .contracts import ContractError, parse_spec
+from .engine import Finding, ModuleContext, dotted_name
+
+__all__ = ["default_rules", "RULES",
+           "R001HostSync", "R002RecompileTrap", "R003NumpyInOps",
+           "R004ContractChecks", "R005TelemetryPurity"]
+
+_OPS = "lightgbm_tpu/ops/"
+_PARALLEL = "lightgbm_tpu/parallel/"
+_BOOSTER = "lightgbm_tpu/booster.py"
+
+
+def _mk(ctx: ModuleContext, rule: str, node: ast.AST, msg: str
+        ) -> Finding:
+    line = getattr(node, "lineno", 0)
+    return Finding(rule, ctx.relpath, line,
+                   getattr(node, "col_offset", 0),
+                   ctx.symbol_at(line), msg, ctx.snippet(line))
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    """Leftmost Name id of an attr/call/subscript chain
+    (``REGISTRY.counter("x").inc()`` -> ``REGISTRY``)."""
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            node = node.func
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        else:
+            break
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _walk_in_function(fn_node: ast.AST, ctx: ModuleContext
+                      ) -> Iterable[ast.AST]:
+    """Walk fn_node's body WITHOUT descending into nested defs."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+# =============================================================== R001
+class R001HostSync:
+    """Implicit device->host sync on the hot path.
+
+    Patterns (scoped to ops/, parallel/, booster.py):
+      a. ``.item()`` / ``float()`` / ``bool()`` inside device code;
+      b. ``float()/bool()/int()/np.asarray()/np.array()/.item()``
+         applied to a device-rooted expression (a ``jnp.*`` /
+         ``jax.device_put`` call chain) ANYWHERE in the file — host
+         probe code that silently blocks on the device;
+      c. ``np.asarray()/np.array()/float()/bool()`` on an attribute
+         that the same module assigns from ``jnp.*`` /
+         ``jax.device_put`` (a device-resident member pulled back to
+         host).
+    Explicit syncs through ``jax.device_get(...)`` are exempt — the
+    point is to make syncs VISIBLE, not to forbid them.
+    """
+    id = "R001"
+    title = "implicit host sync in jit-reachable code"
+
+    def _scoped(self, ctx) -> bool:
+        return (ctx.relpath.startswith(_OPS)
+                or ctx.relpath.startswith(_PARALLEL)
+                or ctx.relpath == _BOOSTER)
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not self._scoped(ctx):
+            return
+        np_names = ctx.np_names
+        jnp_names = ctx.jnp_names
+        jax_names = ctx.jax_names
+        device_attrs = self._device_attrs(ctx, jnp_names, jax_names)
+        seen: Set[Tuple[int, int]] = set()
+
+        def rooted_on_device(expr) -> bool:
+            """Expression derives from a jnp/device_put call chain."""
+            if isinstance(expr, ast.Call):
+                dn = dotted_name(expr.func) or ""
+                base = dn.split(".")[0]
+                term = dn.split(".")[-1]
+                if base in jax_names and term == "device_get":
+                    return False          # explicit sync: exempt
+                if base in jnp_names:
+                    return True
+                if base in jax_names and term == "device_put":
+                    return True
+                return any(rooted_on_device(a) for a in expr.args)
+            if isinstance(expr, (ast.Attribute, ast.Subscript)):
+                return rooted_on_device(expr.value)
+            if isinstance(expr, ast.BinOp):
+                return (rooted_on_device(expr.left)
+                        or rooted_on_device(expr.right))
+            if isinstance(expr, ast.UnaryOp):
+                return rooted_on_device(expr.operand)
+            return False
+
+        def device_attr_arg(expr) -> Optional[str]:
+            if isinstance(expr, ast.Attribute) and \
+                    expr.attr in device_attrs:
+                return expr.attr
+            return None
+
+        def emit(node, msg):
+            key = (node.lineno, node.col_offset)
+            if key not in seen:
+                seen.add(key)
+                yield _mk(ctx, self.id, node, msg)
+
+        # --- pattern (a): device-code host syncs -----------------------
+        for iv in ctx.device_roots():
+            for node in _iter_all(iv.node):
+                if not isinstance(node, ast.Call) or \
+                        ctx.in_host_callback(node.lineno):
+                    continue
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "item" and not node.args:
+                    yield from emit(node, "`.item()` forces a device->"
+                                    "host sync inside device code")
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in ("float", "bool") and \
+                        len(node.args) == 1 and \
+                        not isinstance(node.args[0], ast.Constant):
+                    yield from emit(
+                        node, f"`{node.func.id}()` on a traced value "
+                        "inside device code is an implicit host sync")
+
+        # --- patterns (b)+(c): module-wide ----------------------------
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            dn = dotted_name(fn) or ""
+            base = dn.split(".")[0]
+            is_np_mat = (base in np_names
+                         and dn.split(".")[-1] in ("asarray", "array"))
+            is_cast = isinstance(fn, ast.Name) and \
+                fn.id in ("float", "bool", "int")
+            is_item = isinstance(fn, ast.Attribute) and \
+                fn.attr == "item" and not node.args
+            if is_item and rooted_on_device(fn.value):
+                yield from emit(node, "`.item()` on a device value is "
+                                "an implicit host sync (use "
+                                "jax.device_get to make it explicit)")
+                continue
+            if not (is_np_mat or is_cast) or not node.args:
+                continue
+            arg = node.args[0]
+            what = dn if is_np_mat else fn.id
+            if rooted_on_device(arg):
+                yield from emit(
+                    node, f"`{what}()` on a device-computed value "
+                    "blocks on the accelerator (implicit host sync; "
+                    "use jax.device_get to make it explicit)")
+            else:
+                attr = device_attr_arg(arg)
+                if attr is not None:
+                    yield from emit(
+                        node, f"`{what}()` pulls device-resident "
+                        f"member `.{attr}` back to host (implicit "
+                        "sync; keep a host copy instead)")
+
+    @staticmethod
+    def _device_attrs(ctx, jnp_names, jax_names) -> Set[str]:
+        """Attr names assigned `self.X = jnp.*(...)/jax.device_put(..)`
+        anywhere in the module."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            dn = dotted_name(node.value.func) or ""
+            base = dn.split(".")[0]
+            dev = (base in jnp_names
+                   or (base in jax_names
+                       and dn.split(".")[-1] == "device_put"))
+            if not dev:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.add(t.attr)
+        return out
+
+
+def _iter_all(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """ast.walk over a device root INCLUDING nested defs (they are
+    device code too)."""
+    return ast.walk(fn_node)
+
+
+# =============================================================== R002
+class R002RecompileTrap:
+    """Recompilation traps.
+
+    a. ``jax.jit``/``jax.pmap`` constructed inside a loop — a fresh
+       callable every iteration, so the compile cache never hits.
+    b. ``jax.jit`` constructed inside a plain function with no caching
+       idiom in sight — exempt when an enclosing function carries
+       ``lru_cache``/``cache``, when the jitted callable is memoized
+       onto an attribute (``self.x = jax.jit(..)``) or into a mapping
+       (``cache[k] = jax.jit(..)``), or at module level.
+    c. unhashable ``static_argnums``/``static_argnames`` values (dict/
+       set/list-of-nonliteral) — TypeError or silent retrace.
+    d. Python ``if`` on a traced parameter (TracerBoolConversionError
+       at best, value-specialized recompile via concretization at
+       worst) or on a traced parameter's ``.shape`` inside device code.
+       Params with defaults and ``is None`` tests are exempt (static
+       config flags).
+    """
+    id = "R002"
+    title = "recompile trap"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        yield from self._jit_construction(ctx)
+        yield from self._static_args(ctx)
+        yield from self._traced_branching(ctx)
+
+    # -------------------------------------------------- a + b
+    def _jit_construction(self, ctx) -> Iterable[Finding]:
+        findings: List[Finding] = []
+
+        def is_jit_call(node) -> bool:
+            if not isinstance(node, ast.Call):
+                return False
+            name = ctx.is_jaxish_callee(node.func)
+            if name in ("jit", "pmap"):
+                return True
+            # functools.partial(jax.jit, ...)
+            dn = dotted_name(node.func) or ""
+            if dn.endswith("partial") and node.args and \
+                    ctx.is_jaxish_callee(node.args[0]) in ("jit",
+                                                           "pmap"):
+                return True
+            return False
+
+        def has_cache_deco(fn_node) -> bool:
+            for dec in fn_node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                dn = dotted_name(target) or ""
+                if dn.split(".")[-1] in ("lru_cache", "cache"):
+                    return True
+            return False
+
+        def has_jit_deco(fn_node) -> bool:
+            # bare `@jax.jit` only: call-form decorators
+            # (`@partial(jax.jit, ...)`) are Call nodes and flagged by
+            # the is_jit_call path when they sit in a bad scope
+            return any(not isinstance(dec, ast.Call)
+                       and ctx.is_jaxish_callee(dec) in ("jit", "pmap")
+                       for dec in fn_node.decorator_list)
+
+        def visit(node, fn_stack, loop_depth, stmt):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                # a @jax.jit-decorated def inside an (uncached)
+                # function is the factory-per-call trap too
+                if not isinstance(node, ast.Lambda) and fn_stack and \
+                        has_jit_deco(node):
+                    memo = any(has_cache_deco(f) for f in fn_stack
+                               if not isinstance(f, ast.Lambda))
+                    if loop_depth > 0:
+                        findings.append(_mk(
+                            ctx, self.id, node,
+                            "@jax.jit-decorated def inside a loop — "
+                            "re-jitted every iteration"))
+                    elif not memo:
+                        findings.append(_mk(
+                            ctx, self.id, node,
+                            "@jax.jit-decorated def inside an "
+                            "uncached function — re-jitted on every "
+                            "factory call (lru_cache the factory or "
+                            "memoize the result)"))
+                # decorators evaluate in the ENCLOSING scope — visit
+                # them with the outer stack, only the body is inside
+                for dec in node.decorator_list \
+                        if not isinstance(node, ast.Lambda) else ():
+                    visit(dec, fn_stack, loop_depth, stmt)
+                inner = fn_stack + [node]
+                for child in ast.iter_child_nodes(node):
+                    if not isinstance(node, ast.Lambda) and \
+                            child in node.decorator_list:
+                        continue
+                    visit(child, inner, 0, stmt)
+                return
+            elif isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                loop_depth += 1
+            elif isinstance(node, ast.stmt):
+                stmt = node
+            if is_jit_call(node):
+                if loop_depth > 0:
+                    findings.append(_mk(
+                        ctx, self.id, node,
+                        "jax.jit constructed inside a loop — a fresh "
+                        "callable every iteration defeats the compile "
+                        "cache"))
+                elif fn_stack:
+                    memoized = any(has_cache_deco(f) for f in fn_stack
+                                   if not isinstance(f, ast.Lambda))
+                    if not memoized and isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, (ast.Attribute,
+                                              ast.Subscript)):
+                                memoized = True
+                    if not memoized:
+                        findings.append(_mk(
+                            ctx, self.id, node,
+                            "jax.jit constructed per call — hoist to "
+                            "module level, memoize on an attribute, "
+                            "or lru_cache the factory"))
+            for child in ast.iter_child_nodes(node):
+                visit(child, fn_stack, loop_depth, stmt)
+
+        visit(ctx.tree, [], 0, None)
+        yield from findings
+
+    # -------------------------------------------------- c
+    def _static_args(self, ctx) -> Iterable[Finding]:
+        def hashable_literal(v) -> bool:
+            if isinstance(v, ast.Constant):
+                return True
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return all(hashable_literal(e) for e in v.elts)
+            if isinstance(v, ast.Name):
+                return True      # can't see through names; stay quiet
+            return False
+
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = ctx.is_jaxish_callee(node.func)
+            if name not in ("jit", "pmap"):
+                dn = dotted_name(node.func) or ""
+                if not (dn.endswith("partial") and node.args and
+                        ctx.is_jaxish_callee(node.args[0]) in (
+                            "jit", "pmap")):
+                    continue
+            for kw in node.keywords:
+                if kw.arg in ("static_argnums", "static_argnames") \
+                        and not hashable_literal(kw.value):
+                    yield _mk(
+                        ctx, self.id, kw.value,
+                        f"`{kw.arg}` value is not a hashable literal "
+                        "(dict/set/computed static specs retrace or "
+                        "TypeError)")
+
+    # -------------------------------------------------- d
+    def _traced_branching(self, ctx) -> Iterable[Finding]:
+        static_declared = self._declared_static_names(ctx)
+        for iv in ctx.device:
+            node = iv.node
+            if isinstance(node, ast.Lambda):
+                continue
+            args = node.args
+            defaulted = {a.arg for a in
+                         args.args[len(args.args) - len(args.defaults):]}
+            defaulted |= {a.arg for a, d in
+                          zip(args.kwonlyargs, args.kw_defaults) if d}
+            params = {a.arg for a in (args.args + args.kwonlyargs
+                                      + args.posonlyargs)} - {"self"}
+            # exempt: defaulted params (static config flags) and names
+            # declared in a static_argnames spec anywhere in the module
+            traced = params - defaulted - static_declared
+            for sub in _walk_in_function(node, ctx):
+                if not isinstance(sub, ast.If):
+                    continue
+                if self._is_guard_raise(sub):
+                    continue      # trace-time validation is intentional
+                test = sub.test
+                if self._is_none_test(test):
+                    continue
+                # Name nodes that are NOT value-branching: attribute
+                # bases (`spec.flag` — static config objects) and args
+                # of trace-static builtins (len/isinstance/...)
+                static_ids: Set[int] = set()
+                for t in ast.walk(test):
+                    if isinstance(t, ast.Attribute) and \
+                            isinstance(t.value, ast.Name):
+                        static_ids.add(id(t.value))
+                    elif isinstance(t, ast.Call) and \
+                            isinstance(t.func, ast.Name) and \
+                            t.func.id in ("len", "isinstance", "type",
+                                          "getattr", "hasattr",
+                                          "callable"):
+                        for d in ast.walk(t):
+                            if isinstance(d, ast.Name):
+                                static_ids.add(id(d))
+                shape_hit, value_hit = None, None
+                for t in ast.walk(test):
+                    if isinstance(t, ast.Attribute) and \
+                            t.attr in ("shape", "ndim", "size") and \
+                            isinstance(t.value, ast.Name) and \
+                            t.value.id in traced:
+                        shape_hit = t.value.id
+                for t in ast.walk(test):
+                    if isinstance(t, ast.Name) and t.id in traced \
+                            and id(t) not in static_ids \
+                            and not shape_hit:
+                        value_hit = t.id
+                        break
+                if shape_hit:
+                    yield _mk(
+                        ctx, self.id, sub,
+                        f"Python branch on `{shape_hit}.shape` inside "
+                        "device code — every distinct shape recompiles"
+                        " (hoist to a static arg if intended)")
+                elif value_hit:
+                    yield _mk(
+                        ctx, self.id, sub,
+                        f"Python `if` on traced value `{value_hit}` "
+                        "inside device code (use jnp.where / "
+                        "lax.cond)")
+
+    @staticmethod
+    def _is_guard_raise(if_node: ast.If) -> bool:
+        """True when every terminal statement of the if-body raises —
+        a trace-time validation guard, not value branching."""
+        body = if_node.body
+        return bool(body) and all(
+            isinstance(s, ast.Raise) for s in body) and \
+            not if_node.orelse
+
+    @staticmethod
+    def _declared_static_names(ctx) -> Set[str]:
+        """Names listed in any static_argnames literal in the module
+        (those params are static at every jit boundary here)."""
+        out: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg != "static_argnames":
+                    continue
+                v = kw.value
+                elts = v.elts if isinstance(v, (ast.Tuple, ast.List)) \
+                    else [v]
+                for e in elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, str):
+                        out.add(e.value)
+        return out
+
+    @staticmethod
+    def _is_none_test(test) -> bool:
+        for t in ast.walk(test):
+            if isinstance(t, ast.Compare) and any(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in t.ops):
+                return True
+        return False
+
+
+# =============================================================== R003
+_NP_DTYPE_CTORS = {"float32", "float64", "int32", "int64", "uint8",
+                   "uint32", "int8", "int16", "bool_"}
+
+
+class R003NumpyInOps:
+    """Stray ``numpy`` call inside device code in ``ops/`` — breaks
+    tracing (ConcretizationTypeError) or silently computes on host.
+    Use ``jnp``.  Host-side factory/prep code is fine and not flagged;
+    dtype constructors on literals are exempt."""
+    id = "R003"
+    title = "numpy call in ops/ device code"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not ctx.relpath.startswith(_OPS):
+            return
+        np_names = ctx.np_names
+        if not np_names:
+            return
+        seen: Set[Tuple[int, int]] = set()
+        for iv in ctx.device_roots():
+            for node in ast.walk(iv.node):
+                if not isinstance(node, ast.Call) or \
+                        ctx.in_host_callback(node.lineno):
+                    continue
+                dn = dotted_name(node.func) or ""
+                parts = dn.split(".")
+                if parts[0] not in np_names or len(parts) < 2:
+                    continue
+                if parts[-1] in _NP_DTYPE_CTORS and all(
+                        isinstance(a, ast.Constant)
+                        for a in node.args):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield _mk(
+                    ctx, self.id, node,
+                    f"`{dn}` inside device code — numpy breaks under "
+                    "tracing or silently syncs; use jnp")
+
+
+# =============================================================== R004
+#: ops/ public entry points that MUST carry @contract (dotted
+#: qualnames; nested names like "make_grower.grow" are the inner
+#: device functions of cached factories).
+REQUIRED_CONTRACTS: Dict[str, Tuple[str, ...]] = {
+    "lightgbm_tpu/ops/histogram.py": (
+        "leaf_histogram", "root_histogram", "leaf_histogram_multi",
+        "leaf_histogram_packed", "leaf_histogram_packed_multi"),
+    "lightgbm_tpu/ops/split.py": ("find_best_split",),
+    "lightgbm_tpu/ops/fused.py": (
+        "bagging_weights", "goss_weights", "quantize_gradients",
+        "feature_mask"),
+    "lightgbm_tpu/ops/predict.py": (
+        "traverse_bins", "add_tree_score", "replay_leaf_ids",
+        "traverse_raw", "predict_raw_ensemble"),
+    "lightgbm_tpu/ops/grow.py": ("make_grower.grow",),
+    "lightgbm_tpu/ops/grow_wave.py": ("make_wave_grower.grow",),
+}
+
+
+class _ContractInfo:
+    __slots__ = ("params", "required", "n_positional", "has_vararg",
+                 "has_kwarg", "_pos")
+
+    def __init__(self, fn_node):
+        a = fn_node.args
+        pos = [p.arg for p in (a.posonlyargs + a.args)]
+        kwonly = [p.arg for p in a.kwonlyargs]
+        self.params = set(pos) | set(kwonly)
+        self.n_positional = len(pos)
+        defaulted = set(pos[len(pos) - len(a.defaults):] if a.defaults
+                        else [])
+        defaulted |= {p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults)
+                      if d is not None}
+        self.required = self.params - defaulted
+        self.has_vararg = a.vararg is not None
+        self.has_kwarg = a.kwarg is not None
+        self._pos = pos
+
+    def positional_names(self):
+        return self._pos
+
+
+def _contract_decorator(fn_node) -> Optional[ast.Call]:
+    for dec in fn_node.decorator_list:
+        if isinstance(dec, ast.Call):
+            dn = dotted_name(dec.func) or ""
+            if dn.split(".")[-1] == "contract":
+                return dec
+    return None
+
+
+class R004ContractChecks:
+    """Shape/dtype contract coverage + static consistency.
+
+    a. every REQUIRED_CONTRACTS entry point carries ``@contract``;
+    b. each ``@contract`` decorator is well-formed: spec strings parse,
+       spec names exist in the signature;
+    c. call sites of contracted top-level functions match the
+       signature: no unknown keywords, no positional overflow, all
+       required params supplied (skipped when the call uses ``*``/
+       ``**`` splats).
+    """
+    id = "R004"
+    title = "shape/dtype contract check"
+
+    def __init__(self):
+        # (abs module, top-level fn name) -> _ContractInfo
+        self.registry: Dict[Tuple[str, str], _ContractInfo] = {}
+        self._contracted: Dict[str, Set[str]] = {}  # relpath -> quals
+
+    # ----------------------------------------------------- collect
+    def collect(self, ctx: ModuleContext) -> None:
+        quals: Set[str] = set()
+        for iv in ctx.functions:
+            node = iv.node
+            if isinstance(node, ast.Lambda):
+                continue
+            dec = _contract_decorator(node)
+            if dec is None:
+                continue
+            quals.add(iv.qualname)
+            if "." not in iv.qualname:
+                self.registry[(ctx.module, node.name)] = \
+                    _ContractInfo(node)
+        self._contracted[ctx.relpath] = quals
+
+    # ------------------------------------------------------- check
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        yield from self._coverage(ctx)
+        yield from self._decorators(ctx)
+        yield from self._call_sites(ctx)
+
+    def _coverage(self, ctx) -> Iterable[Finding]:
+        required = REQUIRED_CONTRACTS.get(ctx.relpath)
+        if not required:
+            return
+        have = self._contracted.get(ctx.relpath, set())
+        for q in required:
+            if q not in have:
+                yield Finding(
+                    self.id, ctx.relpath, 1, 0, q,
+                    f"ops/ entry point `{q}` has no @contract "
+                    "annotation (required for all public ops/ "
+                    "surfaces)", "")
+
+    def _decorators(self, ctx) -> Iterable[Finding]:
+        for iv in ctx.functions:
+            node = iv.node
+            if isinstance(node, ast.Lambda):
+                continue
+            dec = _contract_decorator(node)
+            if dec is None:
+                continue
+            info = _ContractInfo(node)
+            for kw in dec.keywords:
+                if kw.arg is None:      # **splat into the decorator
+                    continue
+                if not (isinstance(kw.value, ast.Constant)
+                        and isinstance(kw.value.value, str)):
+                    yield _mk(ctx, self.id, kw.value,
+                              f"@contract spec for '{kw.arg}' must be "
+                              "a string literal")
+                    continue
+                try:
+                    parse_spec(kw.value.value)
+                except ContractError as e:
+                    yield _mk(ctx, self.id, kw.value,
+                              f"@contract on `{iv.qualname}`: {e}")
+                    continue
+                if kw.arg != "ret" and kw.arg not in info.params:
+                    yield _mk(
+                        ctx, self.id, kw.value,
+                        f"@contract on `{iv.qualname}` names unknown "
+                        f"parameter '{kw.arg}'")
+
+    def _call_sites(self, ctx) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self._resolve(ctx, node.func)
+            if target is None:
+                continue
+            info = self.registry.get(target)
+            if info is None:
+                continue
+            fname = target[1]
+            if any(isinstance(a, ast.Starred) for a in node.args) or \
+                    any(kw.arg is None for kw in node.keywords):
+                continue
+            if len(node.args) > info.n_positional and \
+                    not info.has_vararg:
+                yield _mk(
+                    ctx, self.id, node,
+                    f"call to contracted `{fname}` passes "
+                    f"{len(node.args)} positional args "
+                    f"(max {info.n_positional})")
+            provided = set(info.positional_names()[:len(node.args)])
+            for kw in node.keywords:
+                if kw.arg not in info.params and not info.has_kwarg:
+                    yield _mk(
+                        ctx, self.id, kw.value,
+                        f"call to contracted `{fname}` passes unknown "
+                        f"keyword '{kw.arg}'")
+                else:
+                    provided.add(kw.arg)
+            missing = info.required - provided
+            if missing:
+                yield _mk(
+                    ctx, self.id, node,
+                    f"call to contracted `{fname}` omits required "
+                    f"param(s): {', '.join(sorted(missing))}")
+
+    def _resolve(self, ctx, func) -> Optional[Tuple[str, str]]:
+        if isinstance(func, ast.Name):
+            fi = ctx.from_imports.get(func.id)
+            if fi:
+                return (fi[0], fi[1])
+            if (ctx.module, func.id) in self.registry:
+                return (ctx.module, func.id)
+            return None
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            base = func.value.id
+            fi = ctx.from_imports.get(base)
+            if fi:                       # from . import histogram
+                return (f"{fi[0]}.{fi[1]}", func.attr)
+            mod = ctx.module_aliases.get(base)
+            if mod:
+                return (mod, func.attr)
+        return None
+
+
+# =============================================================== R005
+class R005TelemetryPurity:
+    """Mutation of the process-global MetricsRegistry / telemetry sinks
+    (or opening a span) inside device code: under ``jit`` the side
+    effect runs at TRACE time only — metrics silently stop counting
+    after the first compile, and spans measure tracing, not execution.
+    Instrument outside the jitted region (or use
+    ``jax.named_scope``, which is trace-safe and flagged nowhere).
+    """
+    id = "R005"
+    title = "telemetry side effect in device code"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        tel_names: Set[str] = set()
+        for local, (mod, orig) in ctx.from_imports.items():
+            if ".telemetry" in mod or mod.endswith("telemetry"):
+                tel_names.add(local)
+        for local, mod in ctx.module_aliases.items():
+            if ".telemetry" in mod:
+                tel_names.add(local)
+        if not tel_names:
+            return
+        seen: Set[Tuple[int, int]] = set()
+        for iv in ctx.device_roots():
+            for node in ast.walk(iv.node):
+                if not isinstance(node, ast.Call) or \
+                        ctx.in_host_callback(node.lineno):
+                    continue
+                root = _root_name(node.func)
+                if root not in tel_names:
+                    continue
+                rnode = node.func
+                while not isinstance(rnode, ast.Name):
+                    rnode = (rnode.value
+                             if isinstance(rnode, (ast.Attribute,
+                                                   ast.Subscript))
+                             else rnode.func)
+                key = (rnode.lineno, rnode.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield _mk(
+                    ctx, self.id, node,
+                    f"telemetry side effect via `{root}` inside device"
+                    " code runs at trace time only (move it outside "
+                    "the jitted region or use jax.named_scope)")
+
+
+RULES = (R001HostSync, R002RecompileTrap, R003NumpyInOps,
+         R004ContractChecks, R005TelemetryPurity)
+
+
+def default_rules():
+    return [cls() for cls in RULES]

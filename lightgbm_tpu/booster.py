@@ -85,9 +85,17 @@ class _DeviceData:
             np.array([m.default_bin for m in mappers], dtype=np.int32))
         self.base_allowed = np.array(
             [not m.is_trivial for m in mappers], dtype=bool)
-        self.is_cat = jnp.asarray(np.array(
+        # one device copy up front: per-iteration/per-chunk consumers
+        # (`_feature_mask`, `_run_chunk`) must not pay a fresh H2D
+        # transfer each call (graft-lint R001 churn)
+        self.base_allowed_dev = jnp.asarray(self.base_allowed)
+        # host + device copies: host-side predicates (`has_cat`) read
+        # the np copy instead of syncing the device array back
+        # (graft-lint R001)
+        self.is_cat_np = np.array(
             [m.bin_type == BIN_TYPE_CATEGORICAL for m in mappers],
-            dtype=bool))
+            dtype=bool)
+        self.is_cat = jnp.asarray(self.is_cat_np)
         self.max_bin = max(int(m.num_bin) for m in mappers)
         label = ds.get_label()
         self.label = jnp.asarray(label.astype(np.float32)) \
@@ -248,6 +256,16 @@ class Booster:
             # boosters in the same process.
             log.warning("tpu_debug_nans=true: NaN checks enabled — "
                         "training is slower; use for debugging only")
+        if self.config.debug_contracts:
+            # runtime half of graft-lint R004: validate the @contract
+            # shape/dtype specs on the ops/ entry points.  Trace-time
+            # cost only, but the switch is process-global (a sibling
+            # booster created with debug_contracts=false does not turn
+            # it back off — see analysis.enable_runtime_checks)
+            from .analysis import enable_runtime_checks
+            enable_runtime_checks(True)
+            log.warning("debug_contracts=true: runtime shape/dtype "
+                        "contract checks enabled for this process")
         train_set.params = {**(train_set.params or {}), **{
             k: v for k, v in self.params.items()
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
@@ -364,7 +382,7 @@ class Booster:
             wave_gain_ratio=self._wave_gain_ratio(),
             wave_overgrow=self._wave_overgrow(),
             wave_strict_tail=self._wave_strict_tail(),
-            has_cat=bool(np.asarray(self._dd.is_cat).any()),
+            has_cat=bool(self._dd.is_cat_np.any()),
             debug_checks=bool(self.config.tpu_debug_nans),
         )
         self._grow_policy = self._resolve_grow_policy()
@@ -982,7 +1000,7 @@ class Booster:
 
     def _feature_mask(self, iteration: int, k: int) -> jax.Array:
         from .ops.fused import feature_mask
-        base = jnp.asarray(self._dd.base_allowed)
+        base = self._dd.base_allowed_dev
         return feature_mask(iteration, k, self._ff_key0, base,
                             feature_fraction=self.config.feature_fraction)
 
@@ -1515,7 +1533,7 @@ class Booster:
                     tuple(self._valid_scores[:spec.n_valid]),
                     jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
                     self._grad_key0, self._train_bins, self._feat,
-                    jnp.asarray(dd.base_allowed), valid_bins)
+                    dd.base_allowed_dev, valid_bins)
             self._bulk_warm_key = self._bulk_key
             self._train_score = score
             if spec.n_valid:

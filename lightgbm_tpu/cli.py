@@ -221,13 +221,19 @@ def run(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
               "tasks: train | predict | refit | convert_model\n"
-              "       python -m lightgbm_tpu telemetry-report <events.jsonl>",
+              "       python -m lightgbm_tpu telemetry-report <events.jsonl>\n"
+              "       python -m lightgbm_tpu lint [--format json|text]"
+              " [--update-baseline]",
               file=sys.stderr)
         return 0
     if argv[0] == "telemetry-report":
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main
         return report_main(argv[1:])
+    if argv[0] == "lint":
+        # graft-lint static analysis (stdlib-only, no jax backend use)
+        from .analysis.cli import main as lint_main
+        return lint_main(argv[1:])
     params = parse_args(argv)
     config = Config(params)
     task = config.task

@@ -26,11 +26,15 @@ import jax
 import jax.numpy as jnp
 
 from .grow import GrowerSpec, make_grower
+from ..analysis.contracts import contract
 
 Array = jax.Array
 
 
 # --------------------------------------------------------- sampling (shared)
+@contract(it="[] int", key0="key", n="static:N",
+          bagging_fraction="static", bagging_freq="static int",
+          ret="[N] f32")
 def bagging_weights(it, key0: Array, n: int, *, bagging_fraction: float,
                     bagging_freq: int) -> Array:
     """Bagging mask for iteration `it` (ref: GBDT::Bagging / bagging.hpp).
@@ -41,6 +45,9 @@ def bagging_weights(it, key0: Array, n: int, *, bagging_fraction: float,
             bagging_fraction).astype(jnp.float32)
 
 
+@contract(it="[] int", key0="key", grad="array", hess="array",
+          n="static:N", top_rate="static", other_rate="static",
+          goss_start_iter="static int", ret="[N] f32")
 def goss_weights(it, key0: Array, grad: Array, hess: Array, n: int, *,
                  top_rate: float, other_rate: float,
                  goss_start_iter: int) -> Array:
@@ -65,6 +72,9 @@ def goss_weights(it, key0: Array, grad: Array, hess: Array, n: int, *,
     return jnp.where(it >= goss_start_iter, w, jnp.ones((n,), jnp.float32))
 
 
+@contract(grad="array", hess="array", n_bins="static int", key="key?",
+          return_scales="static", const_hess_level="static int",
+          ret="tree")
 def quantize_gradients(grad: Array, hess: Array, n_bins: int,
                        key: Array = None, return_scales: bool = False,
                        const_hess_level: int = 0):
@@ -111,6 +121,9 @@ def quantize_gradients(grad: Array, hess: Array, n_bins: int,
     return gq * s_g, hq_s
 
 
+@contract(it="[] int", k="static int", key0="key",
+          base_allowed="[F] bool", feature_fraction="static",
+          ret="[F] bool")
 def feature_mask(it, k: int, key0: Array, base_allowed: Array, *,
                  feature_fraction: float) -> Array:
     """Per-tree column mask (ref: col_sampler.hpp `ColSampler::ResetByTree`)."""

@@ -38,6 +38,7 @@ from typing import Dict, NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts import contract
 from .histogram import leaf_histogram
 from .split import NEG_INF, SplitResult, find_best_split, leaf_output, \
     smooth_output
@@ -506,6 +507,11 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
     # feature's bin count
     HB = spec.bundle_max_bin if spec.bundled else spec.max_bin
 
+    # bin axis is `_` (not F): under EFB bundling bins_fm is [G, N]
+    # bundle-major while `allowed` stays [F] over real features
+    @contract(bins_fm="[_, N] int", grad="[N] f32", hess="[N] f32",
+              sample_weight="[N] f32", feat="tree", allowed="[F] bool",
+              ret="tree")
     def grow(bins_fm: Array,       # [F, N] (or [G, N] bundled) feature-major
              grad: Array,          # [N] f32
              hess: Array,          # [N] f32

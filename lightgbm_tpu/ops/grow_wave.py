@@ -57,6 +57,7 @@ from .grow import (DeviceTree, GrowerSpec, _split_to_arrays,
                    make_bundled_expander, make_cegb_penalty,
                    make_feature_blocks, make_node_samplers,
                    rebase_and_merge_block_split, split_go_left)
+from ..analysis.contracts import contract
 from .histogram import leaf_histogram_multi, leaf_histogram_packed_multi
 from .split import NEG_INF, find_best_split, leaf_output, smooth_output
 
@@ -138,6 +139,11 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                          "align with per-feature blocks)")
     HB = spec.bundle_max_bin if spec.bundled else spec.max_bin
 
+    # bin axis is `_` (not F): under EFB bundling bins_fm is [G, N]
+    # bundle-major while `allowed` stays [F] over real features
+    @contract(bins_fm="[_, N] int", grad="[N] f32", hess="[N] f32",
+              sample_weight="[N] f32", feat="tree", allowed="[F] bool",
+              ret="tree")
     def grow(bins_fm: Array,       # [F, N] (or [G, N] bundled) feature-major
              grad: Array,          # [N] f32
              hess: Array,          # [N] f32
@@ -391,10 +397,11 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                                 (remaining - tail).astype(jnp.int32)))
             else:
                 istate["wcap"] = jnp.int32(W)
-            if n_forced:
-                # forced prefix = width-1 waves (strict BFS order)
-                istate["wcap"] = jnp.where(st["step"] < st["forced_n"],
-                                           jnp.int32(1), istate["wcap"])
+            # (forced prefix: no wcap pinning here — a pending forced
+            # split is gated INSIDE icond to the wave's first pick, so
+            # the wave that commits the LAST forced split continues into
+            # free picks at full width instead of burning a whole
+            # histogram pass on width 1)
             # per-wave pair records; pad slot LB drops out of every scatter
             istate["p_small"] = jnp.full((W,), LB, jnp.int32)
             istate["p_left"] = jnp.full((W,), LB, jnp.int32)
@@ -416,8 +423,15 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                 rg = jnp.where(s["ready"], s["leaf_gain"], NEG_INF)
                 go = jnp.max(rg) > jnp.maximum(s["g_floor"], 0.0)
                 if n_forced:
-                    # a forced split proceeds regardless of cached gains
-                    go = go | (s["step"] < s["forced_n"])
+                    # forced splits come strictly first (BFS prefix), and
+                    # a forced pick needs its leaf's WAVE-START histogram
+                    # (the next forced target is a child created by this
+                    # very pick), so: while one is pending, only the
+                    # wave's first pick runs; after the last forced
+                    # commit `pending` flips off and free picks continue
+                    # in the SAME wave under the normal gain gate
+                    pending = s["step"] < s["forced_n"]
+                    go = jnp.where(pending, s["w"] == 0, go)
                 return (s["w"] < s["wcap"]) & (s["step"] < LB - 1) & go
 
             def ibody(s):
@@ -508,6 +522,17 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                 small = jnp.where(left_smaller, best, new)
                 depth = s["leaf_depth"][best] + 1
 
+                floor_w0 = jnp.float32(spec.wave_gain_ratio) * gain_s \
+                    * fullness
+                if n_forced:
+                    # a forced first pick must not seed the capacity-aware
+                    # floor — its gain is whatever the designated split
+                    # scores, not the wave's best free gain; leave the
+                    # floor open (wave-start 0) so the free picks that
+                    # follow in this wave aren't throttled by it
+                    floor_w0 = jnp.where(forced_ok, s["g_floor"],
+                                         floor_w0)
+
                 out = dict(s)
                 if track_used:
                     # both children share the path's used set ∪ {f}
@@ -519,11 +544,8 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
                 out.update(
                     step=step + 1, nl=new + 1, leaf_id=leaf_id,
                     nodes=nodes, w=s["w"] + 1,
-                    g_floor=jnp.where(
-                        s["w"] == 0,
-                        jnp.float32(spec.wave_gain_ratio) * gain_s
-                        * fullness,
-                        s["g_floor"]),
+                    g_floor=jnp.where(s["w"] == 0, floor_w0,
+                                      s["g_floor"]),
                     ready=s["ready"].at[best].set(False)
                     .at[new].set(False),
                     p_small=s["p_small"].at[s["w"]].set(small),

@@ -24,9 +24,14 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts import contract
+
 Array = jax.Array
 
 
+@contract(bins_fm="[F, N] int", payload="[N, 3] f32",
+          row_mask="[N] bool", max_bin="static:MB",
+          ret="[F, MB, 3] f32")
 def leaf_histogram(bins_fm: Array, payload: Array, row_mask: Array,
                    max_bin: int) -> Array:
     """Accumulate (Σgrad, Σhess, Σcount) per (feature, bin) over masked rows.
@@ -54,6 +59,8 @@ def leaf_histogram(bins_fm: Array, payload: Array, row_mask: Array,
     return jnp.stack([per_channel(d[:, c]) for c in range(3)], axis=-1)
 
 
+@contract(bins_fm="[F, N] int", payload="[N, 3] f32",
+          max_bin="static:MB", ret="[F, MB, 3] f32")
 def root_histogram(bins_fm: Array, payload: Array, max_bin: int) -> Array:
     """Histogram over all (bagging-weighted) rows — the root pass."""
     n = bins_fm.shape[1]
@@ -70,6 +77,9 @@ def slot_positions(leaf_id: Array, slots: Array) -> Array:
                      slots.shape[0])
 
 
+@contract(bins_fm="[F, N] int", payload="[N, 3] f32",
+          leaf_id="[N] int", slots="[S] int", max_bin="static:MB",
+          ret="[S, F, MB, 3] f32")
 def leaf_histogram_multi(bins_fm: Array, payload: Array, leaf_id: Array,
                          slots: Array, max_bin: int) -> Array:
     """Histograms of SEVERAL leaves in one sweep over the bin matrix.
@@ -115,6 +125,10 @@ PACKED_TILE = 2048  # rows per int16-field accumulation tile
 PACKED_MAX_QUANT_BINS = (2 ** 15 - 1) // PACKED_TILE
 
 
+@contract(bins_fm="[F, N] int", payload="[N, 3] f32",
+          row_mask="[N] bool", max_bin="static:MB", s_g="[] float",
+          s_h="[] float", const_hess_level="static int",
+          ret="[F, MB, 3] f32")
 def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
                           max_bin: int, s_g: Array, s_h: Array,
                           const_hess_level: int = 0) -> Array:
@@ -186,6 +200,10 @@ def leaf_histogram_packed(bins_fm: Array, payload: Array, row_mask: Array,
     return jax.vmap(per_feature)(cols.reshape(F, T, PACKED_TILE))
 
 
+@contract(bins_fm="[F, N] int", payload="[N, 3] f32",
+          leaf_id="[N] int", slots="[S] int", max_bin="static:MB",
+          s_g="[] float", s_h="[] float", const_hess_level="static int",
+          ret="[S, F, MB, 3] f32")
 def leaf_histogram_packed_multi(bins_fm: Array, payload: Array,
                                 leaf_id: Array, slots: Array, max_bin: int,
                                 s_g: Array, s_h: Array,

@@ -526,15 +526,21 @@ def probe(interpret: bool = False, max_bin: int = 256,
                 want = jnp.stack([leaf_histogram(bins, ref_payload,
                                                  lid == sl, max_bin)
                                   for sl in range(k)])
-                if not bool(jnp.allclose(got[:k], want, rtol=1e-4,
-                                         atol=1e-4)):
+                # explicit sync (device_get) — the probe compares on
+                # host by design; bool(jnp.allclose(...)) would hide
+                # the same transfer as an implicit block (graft-lint
+                # R001)
+                if not np.allclose(jax.device_get(got[:k]),
+                                   jax.device_get(want),
+                                   rtol=1e-4, atol=1e-4):
                     return False
             return True
         got = pallas_histogram(bins, payload, mask, max_bin,
                                row_tile=min(n, ROW_TILE),
                                interpret=interpret)
         want = leaf_histogram(bins, payload, mask, max_bin)
-        if not bool(jnp.allclose(got, want, rtol=1e-4, atol=1e-4)):
+        if not np.allclose(jax.device_get(got), jax.device_get(want),
+                           rtol=1e-4, atol=1e-4):
             return False
         # the quantized kernel runs DIFFERENT block shapes (3-row payload)
         # — probe it too, or a Mosaic regression there would crash the
@@ -543,6 +549,8 @@ def probe(interpret: bool = False, max_bin: int = 256,
                                           row_tile=min(n, ROW_TILE),
                                           interpret=interpret)
         wantq = leaf_histogram(bins, pq, mask, max_bin)
-        return bool(jnp.allclose(gotq, wantq, rtol=1e-4, atol=1e-4))
+        return bool(np.allclose(jax.device_get(gotq),
+                                jax.device_get(wantq),
+                                rtol=1e-4, atol=1e-4))
     except Exception:  # pragma: no cover - backend-specific failures
         return False

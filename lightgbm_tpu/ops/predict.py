@@ -17,9 +17,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts import contract
+
 Array = jax.Array
 
 
+@contract(node_feat="[NI] int", node_thr_bin="[NI] int",
+          node_dl="[NI] bool", node_left="[NI] int",
+          node_right="[NI] int", node_iscat="[NI] bool",
+          node_catmask="[NI, MB] bool", feat_nb="[F] int",
+          feat_missing="[F] int", bins_fm="[F, N] int", ret="[N] i32")
 def traverse_bins(node_feat: Array, node_thr_bin: Array, node_dl: Array,
                   node_left: Array, node_right: Array,
                   node_iscat: Array, node_catmask: Array,
@@ -56,11 +63,15 @@ def traverse_bins(node_feat: Array, node_thr_bin: Array, node_dl: Array,
 
 
 @jax.jit
+@contract(score="[N] float", leaf_idx="[N] int", leaf_values="[L] float",
+          ret="[N] float")
 def add_tree_score(score: Array, leaf_idx: Array, leaf_values: Array) -> Array:
     """score += leaf_values[leaf_idx] (ref: ScoreUpdater::AddScore)."""
     return score + leaf_values[leaf_idx]
 
 
+@contract(tree="tree", bins_fm="[F, N] int", feat_nb="[F] int",
+          feat_missing="[F] int", ret="[N] i32")
 def replay_leaf_ids(tree, bins_fm: Array, feat_nb: Array,
                     feat_missing: Array) -> Array:
     """Route rows of a binned dataset through a DeviceTree by replaying its
@@ -100,6 +111,11 @@ def replay_leaf_ids(tree, bins_fm: Array, feat_nb: Array,
     return lid
 
 
+@contract(node_feat="[NI] int", node_thr="[NI] float",
+          node_dtype="[NI] int", node_left="[NI] int",
+          node_right="[NI] int", leaf_value="[NL] float",
+          X="[N, F] float", cat_words="[NI, MW] uint?",
+          cat_nwords="[NI] int?", ret="[N] float")
 def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
                  node_left: Array, node_right: Array, leaf_value: Array,
                  X: Array, cat_words: Array = None,
@@ -149,6 +165,7 @@ def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
     return jax.vmap(row_fn)(X)
 
 
+@contract(stacked="tree", X="[N, F] float", ret="[N] f32")
 def predict_raw_ensemble(stacked, X: Array) -> Array:
     """Sum of all trees via lax.scan over padded stacked tree arrays.
 

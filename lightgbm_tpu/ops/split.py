@@ -31,6 +31,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..analysis.contracts import contract
+
 Array = jax.Array
 
 NEG_INF = -jnp.inf
@@ -92,6 +94,23 @@ def smooth_output(out: Array, cnt: Array, parent_out: Array,
     return out * frac + parent_out * (1.0 - frac)
 
 
+@contract(hist="[F, MB, 3] f32",
+          parent_g="[] float", parent_h="[] float", parent_c="[] float",
+          feat_nb="[F] int", feat_missing="[F] int", feat_default="[F] int",
+          allowed="[F] bool", is_cat="[F] bool",
+          l1="static", l2="static",
+          min_data_in_leaf="static", min_sum_hessian="static",
+          min_gain_to_split="static",
+          cat_smooth="static", cat_l2="static",
+          max_cat_threshold="static int", max_cat_to_onehot="static int",
+          max_delta_step="static",
+          mono="[F] int?", out_lb="[] float?", out_ub="[] float?",
+          path_smooth="static",
+          parent_output="[] float?",
+          cand_mask="[F, MB] bool?",
+          gain_penalty="[F] float?",
+          want_feature_gains="static", has_cat="static",
+          ret="tree")
 def find_best_split(hist: Array,
                     parent_g: Array, parent_h: Array, parent_c: Array,
                     feat_nb: Array, feat_missing: Array, feat_default: Array,

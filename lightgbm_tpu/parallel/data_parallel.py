@@ -64,10 +64,16 @@ def shard_dataset(bins_nf: np.ndarray, label: np.ndarray, mesh: Mesh,
         return dev_bins, dev_label, dev_w, n_pad
 
 
+@functools.lru_cache(maxsize=32)
 def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
                             grad_fn: Callable, learning_rate: float,
                             axis: str = "data"):
     """One full boosting iteration as a single SPMD program.
+
+    Memoized on (spec, mesh, grad_fn, lr, axis): the factory returns a
+    fresh `jax.jit` wrapper, so an uncached call site would silently
+    retrace/recompile the whole SPMD step every invocation
+    (graft-lint R002).
 
     grad_fn(score, label) -> (grad, hess), elementwise and UNWEIGHTED —
     the grower applies `weight` exactly once (payload = [g·w, h·w, w]),

@@ -31,6 +31,8 @@ never-allowed columns to a multiple of the shard count.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -72,6 +74,7 @@ def resolve_tree_learner(name: str, bundled: bool = False,
     return kind
 
 
+@functools.lru_cache(maxsize=32)
 def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
                             num_feature: int, num_data: int,
                             wave: bool = False):
@@ -81,6 +84,11 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
     ([f_pad, n_pad] — the one-time cost); pads the per-iteration [N]
     vectors itself.  Returns `grow(bins_fm, grad [N], hess [N], sw [N],
     feat, allowed) -> DeviceTree` with `leaf_id` of length N.
+
+    Memoized (lru_cache): the factory ends in a fresh `jax.jit`, so
+    every uncached call would recompile the whole sharded grower
+    (graft-lint R002); the booster's learner-rebuild path hits the
+    cache for a repeated (spec, mesh, kind, shape) tuple.
 
     `wave=True` plugs in the wave-batched grower (ops/grow_wave.py) —
     data-parallel only (rows sharded; the booster downgrades other kinds

@@ -160,6 +160,13 @@ class LambdarankNDCG(ObjectiveFunction):
         for b in self._buckets:
             b["inv_max"] = jnp.asarray(inv_max[b["qidx"]])
             b["pos"] = None
+        # re-binding a dataset invalidates any previously bound positions
+        # (the buckets above were just rebuilt with pos=None): without
+        # this reset a stale has_state/num_positions pair from an earlier
+        # set_positions would make get_gradients reach for b["pos"] and
+        # crash — set_positions must be called again for the new data
+        self.has_state = False
+        self.num_positions = 0
 
     # ------------------------------------------------- position debiasing
     def set_positions(self, position: np.ndarray) -> None:

@@ -221,6 +221,11 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # ref: cmake/Sanitizer.cmake — TPU/XLA is functional so memory races
     # can't happen; numeric poison is the failure class that remains)
     "tpu_debug_nans": (False, "bool", ()),
+    # debug mode: enable runtime @contract shape/dtype checking on the
+    # ops/ entry points (lightgbm_tpu/analysis/contracts.py).  Checks run
+    # at trace time (once per compilation, not per step) but the flag is
+    # process-global and sticky — see analysis.enable_runtime_checks
+    "debug_contracts": (False, "bool", ()),
     # telemetry (lightgbm_tpu/telemetry/): JSONL event sink path — spans
     # (dataset bin, compile/warmup, train chunks, eval, predict), point
     # events (probe attempts, fallbacks) and a final metrics snapshot are

@@ -1,0 +1,13 @@
+#!/bin/bash
+# graft-lint gate — static analysis against the checked-in baseline
+# (docs/STATIC_ANALYSIS.md).  Mirrors scripts/t1.sh: run from anywhere,
+# exit code is the tool's own (0 clean/baselined, 1 new findings).
+#
+# The linter is stdlib-only and never initializes a jax backend, but the
+# environment may pre-register a remote TPU PJRT plugin via
+# sitecustomize (gated on PALLAS_AXON_POOL_IPS) whose registration hangs
+# even unrelated python processes at interpreter start — so run with the
+# same cleaned env the test suite uses (utils/env.py cleaned_cpu_env).
+cd "$(dirname "$0")/.." || exit 1
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m lightgbm_tpu lint "$@"

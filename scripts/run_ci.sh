@@ -7,6 +7,11 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 tier="${1:-quick}"
+
+# graft-lint gate first (seconds, no jax backend): new findings beyond
+# lint_baseline.json fail CI before any test burns minutes
+./scripts/lint.sh
+
 case "$tier" in
   quick) exec python -m pytest tests/ -m quick -q ;;
   full)  exec python -m pytest tests/ -q ;;

@@ -144,3 +144,36 @@ def test_positions_on_nonranking_objective_warn_inert():
     bst = lgb.train({"objective": "binary", "num_leaves": 4,
                      "verbosity": -1}, ds, num_boost_round=2)
     assert bst.current_iteration() == 2
+
+
+@pytest.mark.quick
+def test_init_meta_resets_position_state():
+    """Re-binding data (init_meta) rebuilds the query buckets with
+    pos=None, so it must also reset has_state/num_positions — a stale
+    pair from an earlier set_positions would send grad_hess after the
+    now-missing per-bucket position grids."""
+    import jax.numpy as jnp
+
+    from lightgbm_tpu.rank_objective import LambdarankNDCG
+    from lightgbm_tpu.utils.config import Config
+
+    X, clicks, rel, position, group = _simulate(seed=5, n_query=20)
+    qb = np.concatenate([[0], np.cumsum(group)])
+    obj = LambdarankNDCG(Config({"objective": "lambdarank"}))
+    obj.init_meta(clicks.astype(np.float64), None, qb)
+    obj.set_positions(position)
+    assert obj.has_state and obj.num_positions > 0
+
+    # same objective re-bound to (nominally new) data: positions are
+    # invalid until set_positions is called again
+    obj.init_meta(clicks.astype(np.float64), None, qb)
+    assert not obj.has_state
+    assert obj.num_positions == 0
+    g, h = obj.grad_hess(jnp.zeros(len(clicks), jnp.float32),
+                         jnp.asarray(clicks, jnp.float32), None)
+    assert np.all(np.isfinite(np.asarray(g)))
+    assert np.all(np.isfinite(np.asarray(h)))
+
+    # and re-binding positions afterwards restores the debiasing path
+    obj.set_positions(position)
+    assert obj.has_state and obj.num_positions == len(np.unique(position))

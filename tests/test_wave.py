@@ -482,6 +482,42 @@ class TestWavePolicy:
                                    if not ln.startswith(strip))
         assert dumps["leafwise"] == dumps["wave"]
 
+    def test_forced_prefix_does_not_pin_wave_width(self, tmp_path):
+        """Regression (r6): the forced prefix used to pin wcap to 1 for
+        every wave it STARTED in, so the wave committing the last
+        forced split ended immediately instead of continuing into free
+        picks — and with a forced first pick seeding the capacity-aware
+        gain floor, later free picks could be throttled by the forced
+        split's arbitrary gain.  After the fix, forced ordering is still
+        strict (gated in icond to the wave's first pick) but trees must
+        reach full capacity with the floor intact."""
+        import json as _json
+        X, y = make_binary(2500)
+        forced = {"feature": 4, "threshold": 0.0,
+                  "left": {"feature": 5, "threshold": 0.5}}
+        fn = str(tmp_path / "forced.json")
+        with open(fn, "w") as f:
+            _json.dump(forced, f)
+        # wide waves + a nonzero gain ratio (exercises the g_floor
+        # guard: a forced pick must leave the floor open for the free
+        # picks that now share its wave)
+        bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                         "verbosity": -1, "tree_grow_policy": "wave",
+                         "tpu_wave_width": 8,
+                         "tpu_wave_gain_ratio": 0.5,
+                         "min_data_in_leaf": 5,
+                         "forcedsplits_filename": fn},
+                        lgb.Dataset(X, label=y), num_boost_round=4)
+        assert bst._grow_policy == "wave"
+        for t in bst.trees:
+            assert t.split_feature[0] == 4
+            assert t.split_feature[1] == 5
+            # free growth resumed at full width: capacity is actually
+            # consumed, not stalled behind the forced prefix
+            assert t.num_leaves >= 20, t.num_leaves
+        p = bst.predict(X)
+        assert np.isfinite(p).all()
+
     def test_forced_splits_survive_overgrow_prune(self, tmp_path):
         """Grow-then-prune must never prune the forced prefix — the
         forced-split contract outranks gain-based pruning (code-review

@@ -141,7 +141,7 @@ TPU_RECORD = {"value": 2.956, "auc": 0.8978, "n": 2_000_000,
 
 def _emit(rounds_per_sec: float, n_rows: int, backend: str,
           partial: bool, auc=None, pred=None, probe=None,
-          telemetry=None) -> None:
+          telemetry=None, flight=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -173,6 +173,14 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         # worker-side metrics snapshot (@telemetry line): rounds trained,
         # span timings, fallback counters
         line["telemetry"] = telemetry
+    if flight is not None:
+        # flight-recorder summary (@flight line): tree-shape/gain
+        # quantiles, per-phase wall-clock, compile accounting; the
+        # device-memory watermarks are ALSO lifted to a top-level key so
+        # they sit next to the probe history for grep/jq consumers
+        line["flight"] = flight
+        if isinstance(flight, dict) and flight.get("watermarks"):
+            line["memory"] = flight["watermarks"]
     if backend.startswith("cpu-fallback"):
         line["tpu_record"] = TPU_RECORD
     print(json.dumps(line), flush=True)
@@ -181,6 +189,22 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
 # --------------------------------------------------------------------------
 # orchestrator
 # --------------------------------------------------------------------------
+
+def _parse_stages(stdout) -> dict:
+    """`@stage <name> <secs>` probe-child lines -> {name: secs}.
+    Accepts bytes or str (TimeoutExpired.stdout type varies)."""
+    if isinstance(stdout, bytes):
+        stdout = stdout.decode("utf-8", "replace")
+    stages = {}
+    for line in (stdout or "").splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[0] == "@stage":
+            try:
+                stages[parts[1]] = float(parts[2])
+            except ValueError:
+                pass
+    return stages
+
 
 def _probe_backend():
     """(ok, attempts): whether the default JAX backend initialises and
@@ -193,10 +217,28 @@ def _probe_backend():
     so up to 3 tries fit the budget (VERDICT r3 #2; round 2 burned ~11
     minutes on 4x150 s probes, round 3's single 90 s attempt gave a
     flaky tunnel no second chance).  Each attempt emits one structured
-    `probe.attempt` event (attempt/outcome/rc/duration/timeout)."""
-    code = ("import jax; d = jax.devices(); import jax.numpy as jnp; "
-            "x = jnp.ones((64,64)); (x@x).block_until_ready(); "
-            "print(d[0].platform, len(d))")
+    `probe.attempt` event (attempt/outcome/rc/duration/timeout) and
+    carries per-stage durations (`stages`: import_jax / client_init /
+    device_enumerate / compile_and_run) — on a hang, the stages that DID
+    complete pin the wedge to one phase of backend bring-up."""
+    code = (
+        "import time\n"
+        "t0 = time.time(); last = [t0]\n"
+        "def stage(n):\n"
+        "    now = time.time()\n"
+        "    print(f'@stage {n} {now - last[0]:.3f}', flush=True)\n"
+        "    last[0] = now\n"
+        "import jax\n"
+        "stage('import_jax')\n"
+        "from jax.extend import backend as xb\n"
+        "xb.get_backend()\n"           # PJRT client claim/grant
+        "stage('client_init')\n"
+        "d = jax.devices()\n"
+        "stage('device_enumerate')\n"
+        "import jax.numpy as jnp\n"
+        "x = jnp.ones((64,64)); (x@x).block_until_ready()\n"
+        "stage('compile_and_run')\n"
+        "print(d[0].platform, len(d))\n")
     deadline = time.time() + min(PROBE_BUDGET, max(_remaining() - 60, 10))
     attempt = 0
     attempts = []
@@ -208,11 +250,13 @@ def _probe_backend():
             r = subprocess.run([sys.executable, "-c", code],
                                capture_output=True, timeout=timeout,
                                env=dict(os.environ), text=True)
-        except subprocess.TimeoutExpired:
-            # the flaky-tunnel case the retry exists for
+        except subprocess.TimeoutExpired as e:
+            # the flaky-tunnel case the retry exists for; the completed
+            # stages say how far bring-up got before the wedge
             attempts.append({"attempt": attempt, "outcome": "hang",
                              "rc": None, "timeout_s": round(timeout, 1),
-                             "duration_s": round(time.time() - t0, 2)})
+                             "duration_s": round(time.time() - t0, 2),
+                             "stages": _parse_stages(e.stdout)})
             _event("probe.attempt", **attempts[-1])
             continue
         except OSError as e:
@@ -222,9 +266,13 @@ def _probe_backend():
             _event("probe.attempt", **attempts[-1])
             return False, attempts
         if r.returncode == 0:
+            backend_line = next(
+                (l.strip() for l in r.stdout.splitlines()
+                 if l.strip() and not l.startswith("@stage ")), "")
             attempts.append({"attempt": attempt, "outcome": "ok", "rc": 0,
                              "duration_s": round(time.time() - t0, 2),
-                             "backend": r.stdout.strip()})
+                             "backend": backend_line,
+                             "stages": _parse_stages(r.stdout)})
             _event("probe.attempt", **attempts[-1])
             return True, attempts
         # a nonzero exit is DETERMINISTIC (broken jax/backend, not a
@@ -282,6 +330,7 @@ def _run_orchestrator() -> None:
     auc = None
     pred = None
     worker_telemetry = None
+    worker_flight = None
     platform = backend_tag
     deadline = time.time() + worker_timeout
     try:
@@ -335,6 +384,14 @@ def _run_orchestrator() -> None:
                             line.split(None, 1)[1])
                     except (ValueError, IndexError):
                         pass
+                elif line.startswith("@flight "):
+                    # flight-recorder summary (emitted after @final and
+                    # again after the predict bench — last one wins, it
+                    # has the predict-phase memory watermark too)
+                    try:
+                        worker_flight = json.loads(line.split(None, 1)[1])
+                    except (ValueError, IndexError):
+                        pass
     finally:
         try:
             proc.kill()
@@ -345,18 +402,21 @@ def _run_orchestrator() -> None:
         platform = "cpu-fallback"
     if final is not None:
         _emit(final, n, platform, partial=False, auc=auc, pred=pred,
-              probe=probe_info, telemetry=worker_telemetry)
+              probe=probe_info, telemetry=worker_telemetry,
+              flight=worker_flight)
     elif chunks:
         tot_r = sum(c[0] for c in chunks)
         tot_s = sum(c[1] for c in chunks)
         _emit(tot_r / tot_s, n, platform, partial=True, auc=auc, pred=pred,
-              probe=probe_info, telemetry=worker_telemetry)
+              probe=probe_info, telemetry=worker_telemetry,
+              flight=worker_flight)
     else:
         # nothing measured — still emit a parseable line (value 0) so the
         # round records an explicit failure instead of rc=124/None
         _event("worker.no_chunks", backend=platform)
         _emit(0.0, n, platform + "-failed", partial=True,
-              probe=probe_info, telemetry=worker_telemetry)
+              probe=probe_info, telemetry=worker_telemetry,
+              flight=worker_flight)
 
 
 # --------------------------------------------------------------------------
@@ -407,6 +467,17 @@ def _run_worker() -> None:
         except Exception:
             pass
 
+    def _stream_flight(bst):
+        # flight-recorder summary (tree shape/gain quantiles, per-phase
+        # wall-clock, compile + memory watermarks) — same stream-early,
+        # stream-again-at-exit discipline as the registry snapshot
+        try:
+            fs = bst.flight_summary()
+            print("@flight " + json.dumps(fs, separators=(",", ":")),
+                  flush=True)
+        except Exception:
+            pass
+
     if os.environ.get("BENCH_TELEMETRY_JSONL"):
         # full span stream (dataset.bin / train.chunk / compile_warmup /
         # predict.*) to the same file the orchestrator events go to
@@ -415,7 +486,11 @@ def _run_worker() -> None:
     # TPU-first growth: wave-batched multi-leaf histograms fill the MXU's
     # 128-row LHS (PROFILE.md round 3c); BENCH_CONFIG picks the AUC-parity
     # point of the sweep and rides along in the emitted JSON line
-    params = {"objective": "binary", "verbosity": -1, **BENCH_CONFIG}
+    # flight_recorder rides along: per-round stats are derived from host
+    # arrays the fused path already materializes, so the timed chunks pay
+    # only the python bookkeeping (and the summary lands in BENCH JSON)
+    params = {"objective": "binary", "verbosity": -1,
+              "flight_recorder": True, **BENCH_CONFIG}
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     bst = Booster(params=params, train_set=ds)
@@ -463,6 +538,7 @@ def _run_worker() -> None:
     # to a partial chunk-reconstructed result
     print(f"@final {rounds_per_sec:.4f}", flush=True)
     _stream_telemetry()
+    _stream_flight(bst)
 
     # batch-predict throughput (VERDICT r3 #6: prediction was never
     # measured): device jitted stacked-ensemble path vs the host walk
@@ -485,6 +561,7 @@ def _run_worker() -> None:
     except Exception as e:  # pragma: no cover
         _log(f"predict bench failed: {e}")
     _stream_telemetry()
+    _stream_flight(bst)
     telemetry.TRACER.flush()
 
 

@@ -184,6 +184,10 @@ class Booster:
         self.name_valid_sets: List[str] = []
         self._network_initialized = False
         self.cur_iter = 0
+        # training flight recorder (telemetry/recorder.py); stays None
+        # unless flight_recorder=true — the hot paths carry one `is None`
+        # check and model-loaded boosters never construct one
+        self._flight = None
 
         if train_set is not None:
             if not isinstance(train_set, Dataset):
@@ -393,6 +397,29 @@ class Booster:
         self._grower = self._make_serial_grower()
         self._build_feat()
         self._setup_tree_learner()
+        self._flight = None
+        if self.config.flight_recorder:
+            # opt-in per-round diagnostics: stats come from the host tree
+            # arrays both training paths already materialize, so recording
+            # adds no device syncs (and the grown model bytes are
+            # identical either way — tests/test_flight_recorder.py)
+            from .telemetry.recorder import (FlightRecorder,
+                                             install_compile_listener,
+                                             sample_memory)
+            wave = None
+            if self._grow_policy == "wave":
+                wave = {"policy": "wave",
+                        "width": int(self._grower_spec.wave_width),
+                        "num_leaves": int(self.config.num_leaves)}
+            self._flight = FlightRecorder(
+                depth=self.config.flight_recorder_depth, wave=wave)
+            # phase wall-clock (train.grow / train.decode / eval ...) is
+            # read back from span timings, which NOOP spans never record:
+            # force span recording into the registry for this opted-in
+            # process even when no event sink is attached
+            telemetry.TRACER.enable(True)
+            install_compile_listener()
+            sample_memory("init")
         self._ones = jnp.ones((self._dd.num_data,), dtype=jnp.float32)
 
         K = self.num_tree_per_iteration
@@ -1019,6 +1046,9 @@ class Booster:
                 self._nan_check_ctx():
             out = self._update_impl(train_set, fobj)
         telemetry.REGISTRY.counter("train.rounds").inc()
+        if self._flight is not None:
+            from .telemetry.recorder import sample_memory
+            sample_memory("train")
         return out
 
     def _update_impl(self, train_set: Optional[Dataset] = None,
@@ -1122,6 +1152,7 @@ class Booster:
         lr = 1.0 if self._boost_mode == "rf" else cfg.learning_rate
         all_const = True
         self._last_contribs = []  # for rollback_one_iter
+        round_trees = [] if self._flight is not None else None
         for k in range(K):
             gk = grad if K == 1 else grad[:, k]
             hk = hess if K == 1 else hess[:, k]
@@ -1140,11 +1171,16 @@ class Booster:
             warm = getattr(self, "_grower_warmed", None) is self._grower
             with telemetry.span("compile_warmup", kind="grower") \
                     if not warm else telemetry.NOOP:
-                dev = self._grower(self._train_bins, gk.astype(jnp.float32),
-                                   hk.astype(jnp.float32), sw,
-                                   feat, allowed)
+                with telemetry.span("train.grow", k=k):
+                    dev = self._grower(self._train_bins,
+                                       gk.astype(jnp.float32),
+                                       hk.astype(jnp.float32), sw,
+                                       feat, allowed)
             self._grower_warmed = self._grower
-            tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
+            # the device_get inside from_device is where the dispatch is
+            # actually waited on — train.decode carries that wall-clock
+            with telemetry.span("train.decode"):
+                tree = Tree.from_device(dev, self.train_set.bin_mappers, lr)
             if "cegb_used" in self._feat and tree.num_leaves > 1:
                 # coupled penalties charge a feature once per MODEL
                 used = np.array(jax.device_get(self._feat["cegb_used"]))
@@ -1188,6 +1224,10 @@ class Booster:
             if it == 0 and abs(self._init_scores[k]) > 1e-35:
                 tree.add_bias(self._init_scores[k])
             self.trees.append(tree)
+            if round_trees is not None:
+                round_trees.append(telemetry.tree_stats(tree))
+        if round_trees is not None:
+            self._flight.record_round(it, round_trees)
         self.cur_iter += 1
         if all_const:
             log.warning("Stopped training because there are no more leaves "
@@ -1540,12 +1580,16 @@ class Booster:
                 self._valid_scores[:spec.n_valid] = list(vfinal)
             # _decode_stacked device_gets the finished trees, so the chunk
             # span ends on real results, not on async dispatch
-            finished = self._decode_stacked(stacked)
+            with telemetry.span("train.decode", rounds=spec.chunk):
+                finished = self._decode_stacked(stacked)
             t_np = np.asarray(jax.device_get(t_iter)) \
                 if spec.emit_train_scores else None
             v_np = [np.asarray(jax.device_get(v)) for v in v_iter]
         telemetry.REGISTRY.counter("train.rounds").inc(spec.chunk)
         telemetry.REGISTRY.counter("train.chunks").inc()
+        if self._flight is not None:
+            from .telemetry.recorder import sample_memory
+            sample_memory("train")
         return finished, t_np, v_np
 
     def update_many(self, n_rounds: int) -> bool:
@@ -1595,6 +1639,7 @@ class Booster:
         chunk = host.n_splits.shape[0]
         all_const = True
         for c in range(chunk):
+            round_trees = [] if self._flight is not None else None
             for k in range(K):
                 if K == 1:
                     dev = DeviceTree(*[np.asarray(f[c]) for f in host])
@@ -1606,6 +1651,10 @@ class Booster:
                 if self.cur_iter == 0 and abs(self._init_scores[k]) > 1e-35:
                     tree.add_bias(self._init_scores[k])
                 self.trees.append(tree)
+                if round_trees is not None:
+                    round_trees.append(telemetry.tree_stats(tree))
+            if round_trees is not None:
+                self._flight.record_round(self.cur_iter, round_trees)
             self.cur_iter += 1
         self._last_contribs = []
         return all_const
@@ -1737,7 +1786,15 @@ class Booster:
     def _eval_one(self, score: np.ndarray, ds: Dataset, data_name: str,
                   feval) -> List[Tuple[str, str, float, bool]]:
         with telemetry.span("eval", dataset=data_name):
-            return self._eval_one_impl(score, ds, data_name, feval)
+            res = self._eval_one_impl(score, ds, data_name, feval)
+        if self._flight is not None:
+            # eval runs AFTER its round on both training paths; the
+            # recorder folds the values into its eval series and amends
+            # the latest ring record in place
+            self._flight.note_eval(data_name, res)
+            from .telemetry.recorder import sample_memory
+            sample_memory("eval")
+        return res
 
     def _eval_one_impl(self, score: np.ndarray, ds: Dataset, data_name: str,
                        feval) -> List[Tuple[str, str, float, bool]]:
@@ -1888,6 +1945,9 @@ class Booster:
                 with telemetry.span("predict.device", rows=n,
                                     trees=len(trees)):
                     raw = self._predict_raw_device(stacked, X)
+                if self._flight is not None:
+                    from .telemetry.recorder import sample_memory
+                    sample_memory("predict")
                 if getattr(self, "_average_output", False) and len(trees):
                     raw = raw / max(len(trees), 1)
                 if raw_score or self.objective_ is None:
@@ -2298,6 +2358,38 @@ class Booster:
         }
 
     # ------------------------------------------------------------- metadata
+    def flight_summary(self) -> Dict[str, Any]:
+        """Flight-recorder summary of this booster's training run:
+        per-round tree-shape/gain quantiles, top split features, eval
+        first→last deltas, per-phase wall-clock, compile accounting and
+        device-memory watermarks (telemetry/recorder.py), plus the
+        analytic throughput block that used to live in
+        `utils.profile.training_report`.  `{"enabled": False}` when the
+        booster was built without `flight_recorder=true`."""
+        if self._flight is None:
+            return {"enabled": False}
+        from .telemetry.recorder import poll_jit_caches, sample_memory
+        # final compile-cache poll (the degraded accounting when
+        # jax.monitoring is unavailable — and the cache-growth signal
+        # either way) + one last memory sample
+        poll_jit_caches([getattr(self, a, None)
+                         for a in ("_grower", "_bulk_trainer_cache",
+                                   "_grad_fn", "_grad_rng_fn",
+                                   "_grad_state_fn", "_renew_jit")])
+        sample_memory("summary")
+        out = self._flight.summary()
+        dd = getattr(self, "_dd", None)
+        if dd is not None:
+            efb = dd.efb
+            cols = efb.n_cols if efb is not None else dd.num_feature
+            tp = self._flight.throughput(dd.num_data, cols,
+                                         self.config.num_leaves,
+                                         self._grower_spec.hist_impl,
+                                         efb is not None)
+            if tp is not None:
+                out["throughput"] = tp
+        return out
+
     def current_iteration(self) -> int:
         return self.cur_iter
 

@@ -222,6 +222,8 @@ def run(argv: List[str]) -> int:
         print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
               "tasks: train | predict | refit | convert_model\n"
               "       python -m lightgbm_tpu telemetry-report <events.jsonl>\n"
+              "       python -m lightgbm_tpu telemetry diff <A.json> <B.json>"
+              " [--warn-timings]\n"
               "       python -m lightgbm_tpu lint [--format json|text]"
               " [--update-baseline]",
               file=sys.stderr)
@@ -230,6 +232,19 @@ def run(argv: List[str]) -> int:
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main
         return report_main(argv[1:])
+    if argv[0] == "telemetry":
+        # `telemetry diff A B` (regression sentinel) / `telemetry report F`
+        action = argv[1] if len(argv) > 1 else ""
+        if action == "diff":
+            from .telemetry.diff import main as diff_main
+            return diff_main(argv[2:])
+        if action == "report":
+            from .telemetry.report import main as report_main
+            return report_main(argv[2:])
+        print("usage: python -m lightgbm_tpu telemetry "
+              "{diff <A.json> <B.json> | report <events.jsonl>}",
+              file=sys.stderr)
+        return 2
     if argv[0] == "lint":
         # graft-lint static analysis (stdlib-only, no jax backend use)
         from .analysis.cli import main as lint_main

@@ -53,6 +53,10 @@ def _finish_telemetry(booster: Booster) -> None:
     """End-of-train telemetry flush: embed a registry snapshot in any
     attached JSONL (so `telemetry-report` sees final counters) and write
     the Prometheus textfile if `telemetry_prometheus` is set."""
+    if getattr(booster, "_flight", None) is not None:
+        # polls jit caches + takes the final memory watermark sample, so
+        # the snapshot below carries end-of-train compile/memory gauges
+        booster.flight_summary()
     if telemetry.TRACER.active:
         telemetry.TRACER.emit_metrics_snapshot()
         telemetry.TRACER.flush()

@@ -111,6 +111,13 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
     MB = spec.max_bin
     # grow-then-prune: grow to LB leaves, prune back to L (off: LB == L)
     LB, W = wave_sizes(spec)
+    # resolved wave geometry, recorded ONCE per built program (this body
+    # runs host-side at build time, never under jit — R005-safe); the
+    # flight recorder reads these back for its wave-utilization block
+    from ..telemetry import REGISTRY
+    REGISTRY.gauge("wave.width").set(W)
+    REGISTRY.gauge("wave.grow_leaves").set(LB)
+    REGISTRY.gauge("wave.shards").set(n_shards)
     n_forced = len(spec.forced_splits)
     find = functools.partial(
         find_best_split,

@@ -194,7 +194,7 @@ def place_training_data(bins_fm, mesh: Mesh, kind: str,
     `pad_features` only for the block strategies (data_rs/feature) —
     voting and bundled-data keep the original column count."""
     import numpy as np
-    from ..telemetry import TRACER, span
+    from ..telemetry import REGISTRY, TRACER, span
     axes = tuple(mesh.axis_names)
     S_last = int(mesh.shape[axes[-1]])
     S_total = 1
@@ -213,4 +213,12 @@ def place_training_data(bins_fm, mesh: Mesh, kind: str,
         placed = jax.device_put(bins_fm, NamedSharding(mesh, sp))
         if TRACER.active:
             placed.block_until_ready()  # span measures the real transfer
+            # per-device attribution of the one big resident array: the
+            # flight recorder's memory watermarks read these back when
+            # device memory_stats() is unavailable (CPU fallback)
+            for shard in placed.addressable_shards:
+                dev = shard.device
+                REGISTRY.gauge(
+                    f"parallel.dev{dev.id}.placed_bytes").set(
+                        shard.data.nbytes)
         return placed

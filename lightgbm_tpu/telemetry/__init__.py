@@ -21,6 +21,10 @@ from .metrics import (Counter, Gauge, MetricsRegistry, REGISTRY, Timing,
 from .sinks import JsonlSink, MemorySink, Sink, iso_ts, make_event, read_jsonl
 from .spans import NOOP, Span, TRACER, Tracer, event, span
 from .report import render, summarize
+from .recorder import (FlightRecorder, install_compile_listener,
+                       memory_watermarks, poll_jit_caches, sample_memory,
+                       throughput_report, tree_stats)
+from .diff import diff_snapshots, flatten, load_snapshot
 
 __all__ = [
     "Counter", "Gauge", "MetricsRegistry", "REGISTRY", "Timing",
@@ -28,4 +32,7 @@ __all__ = [
     "JsonlSink", "MemorySink", "Sink", "iso_ts", "make_event", "read_jsonl",
     "NOOP", "Span", "TRACER", "Tracer", "event", "span",
     "render", "summarize",
+    "FlightRecorder", "install_compile_listener", "memory_watermarks",
+    "poll_jit_caches", "sample_memory", "throughput_report", "tree_stats",
+    "diff_snapshots", "flatten", "load_snapshot",
 ]

@@ -1,0 +1,279 @@
+"""Perf-regression sentinel: compare two telemetry snapshots.
+
+`python -m lightgbm_tpu telemetry diff <baseline.json> <current.json>`
+compares two metrics/flight snapshots (the JSON written by
+`scripts/telemetry_snapshot.py`, a BENCH JSON line, or a bare
+`REGISTRY.snapshot()` dump) under per-metric **direction + tolerance**
+rules and prints a machine-readable verdict:
+
+ - every metric is flattened to a dotted path (`counters.train.rounds`,
+   `flight.depth_max`, `timings.span.train.chunk.total_s`, ...);
+ - a rule table maps path patterns to a direction (`up_is_bad`,
+   `down_is_bad`, `ignore`) and a relative tolerance;
+ - a delta beyond tolerance in the bad direction is a **violation**
+   (exit 1); beyond tolerance in the good direction is reported as
+   *improved* (exit 0); `--warn-timings` downgrades timing-class
+   violations to warnings (CI runs on the CPU fallback, where absolute
+   wall-clock is noise but counter/shape regressions are still real).
+
+STDLIB-ONLY and self-contained (no imports from the sibling telemetry
+modules): `scripts/run_ci.sh` and the bench orchestrator load this file
+by path in processes that must never import jax.
+"""
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+#: Default relative tolerances by rule class.
+DEFAULT_REL_TOL = 0.25       # counters / structural stats
+DEFAULT_TIMING_REL_TOL = 1.5  # wall-clock: CI boxes are noisy
+ABS_FLOOR = 1e-9             # deltas below this are never violations
+
+#: (path glob, direction, class) — first match wins.  direction:
+#:   up_is_bad   — growth beyond tolerance is a regression (timings,
+#:                 memory watermarks, recompiles, fallbacks)
+#:   down_is_bad — shrinkage beyond tolerance is a regression
+#:                 (throughput, eval quality)
+#:   ignore      — bookkeeping that moves freely between runs
+#: class: "timing" rules use the timing tolerance and are downgradable
+#: via --warn-timings; "counter" rules always fail hard.
+RULES: List[Tuple[str, str, str]] = [
+    # bookkeeping / identity — never a regression by itself
+    ("*.ts", "ignore", "counter"),
+    ("ts", "ignore", "counter"),
+    ("sentinel.*", "ignore", "counter"),
+    ("*backend*", "ignore", "counter"),
+    ("*monitoring_hooked", "ignore", "counter"),
+    ("*samples", "ignore", "counter"),
+    ("*ring_depth", "ignore", "counter"),
+    ("*last_round", "ignore", "counter"),
+    ("*top_features*", "ignore", "counter"),
+    ("counters.event.probe.*", "ignore", "counter"),
+    # quality / throughput — lower is worse
+    ("*rounds_per_sec", "down_is_bad", "timing"),
+    ("*est_hbm_gb_per_sec", "down_is_bad", "timing"),
+    ("*est_scatter_adds_per_sec", "down_is_bad", "timing"),
+    ("*predict_*_rows_per_sec", "down_is_bad", "timing"),
+    ("value", "down_is_bad", "timing"),         # BENCH line: rounds/s
+    ("vs_baseline", "down_is_bad", "timing"),
+    ("*auc*", "down_is_bad", "counter"),
+    ("*eval.*.last", "ignore", "counter"),   # direction depends on metric
+    ("*eval.*.delta", "ignore", "counter"),
+    ("*eval.*.first", "ignore", "counter"),
+    ("*eval.*.n", "ignore", "counter"),
+    # compile & memory watermarks — higher is worse
+    ("*jit.recompiles", "up_is_bad", "counter"),
+    ("*compile.recompiles", "up_is_bad", "counter"),
+    ("*cache_entries", "up_is_bad", "counter"),
+    ("*compile_total_s", "up_is_bad", "timing"),
+    ("*peak_bytes", "up_is_bad", "counter"),
+    ("*mem.*", "up_is_bad", "counter"),
+    # fallback / forced events — higher is worse
+    ("*fallback*", "up_is_bad", "counter"),
+    ("*events.*", "up_is_bad", "counter"),
+    # wall-clock spans — higher is worse, timing class
+    ("*total_s", "up_is_bad", "timing"),
+    ("*mean_s", "up_is_bad", "timing"),
+    ("*max_s", "up_is_bad", "timing"),
+    ("*min_s", "ignore", "timing"),
+    ("*dur_s", "up_is_bad", "timing"),
+    ("*warmup_compile_sec", "up_is_bad", "timing"),
+    # everything else (tree shape stats, counters): a move in EITHER
+    # direction beyond tolerance is flagged — shape drift is the
+    # "unmeasured mechanism changed" signal even when the sign is
+    # ambiguous
+    ("*", "any_is_bad", "counter"),
+]
+
+
+def match_rule(path: str) -> Tuple[str, str]:
+    """(direction, class) for a flattened metric path."""
+    for pat, direction, klass in RULES:
+        if fnmatch.fnmatch(path, pat):
+            return direction, klass
+    return "any_is_bad", "counter"
+
+
+def flatten(obj: Any, prefix: str = "") -> Dict[str, float]:
+    """Dotted-path → numeric value map; non-numeric leaves are dropped
+    (strings/lists carry identity, not magnitude)."""
+    out: Dict[str, float] = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            key = f"{prefix}.{k}" if prefix else str(k)
+            out.update(flatten(v, key))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix] = float(obj)
+    return out
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Load a snapshot file: a JSON object, or a JSONL/BENCH file whose
+    LAST parseable JSON-object line wins (so `bench.py ... > out.txt`
+    artifacts diff directly)."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return obj
+    except ValueError:
+        pass
+    last = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(obj, dict):
+            last = obj
+    if last is None:
+        raise ValueError(f"{path}: no JSON object found")
+    return last
+
+
+def diff_snapshots(base: Dict[str, Any], cur: Dict[str, Any],
+                   rel_tol: float = DEFAULT_REL_TOL,
+                   timing_rel_tol: float = DEFAULT_TIMING_REL_TOL,
+                   warn_timings: bool = False) -> Dict[str, Any]:
+    """Compare two snapshots → verdict dict (machine-readable).
+
+    verdict: "ok" | "regression"; `violations` carry path/base/current/
+    ratio/rule; `warnings` are timing violations under --warn-timings;
+    `improved` are beyond-tolerance moves in the good direction;
+    `missing`/`new` are metrics present on only one side (reported,
+    never failing — instrumentation growth must not trip the sentinel).
+    """
+    a = flatten(base)
+    b = flatten(cur)
+    violations: List[Dict[str, Any]] = []
+    warnings: List[Dict[str, Any]] = []
+    improved: List[Dict[str, Any]] = []
+    checked = 0
+    for path in sorted(set(a) & set(b)):
+        direction, klass = match_rule(path)
+        if direction == "ignore":
+            continue
+        va, vb = a[path], b[path]
+        checked += 1
+        delta = vb - va
+        if abs(delta) <= ABS_FLOOR:
+            continue
+        tol = timing_rel_tol if klass == "timing" else rel_tol
+        # relative to the BASELINE value (not max(a,b), which caps |rel|
+        # at 1.0 and makes any tolerance above 1 unreachable); the floor
+        # keeps a 0 -> x move finite-but-huge, which is the right signal
+        scale = max(abs(va), ABS_FLOOR)
+        rel = delta / scale
+        entry = {"metric": path, "base": va, "current": vb,
+                 "rel_change": round(rel, 4),
+                 "rule": f"{direction}/{klass}"}
+        bad = (direction == "up_is_bad" and rel > tol) or \
+              (direction == "down_is_bad" and -rel > tol) or \
+              (direction == "any_is_bad" and abs(rel) > tol)
+        good = (direction == "up_is_bad" and -rel > tol) or \
+               (direction == "down_is_bad" and rel > tol)
+        if bad:
+            if klass == "timing" and warn_timings:
+                warnings.append(entry)
+            else:
+                violations.append(entry)
+        elif good:
+            improved.append(entry)
+    out = {
+        "verdict": "regression" if violations else "ok",
+        "checked": checked,
+        "violations": violations,
+        "warnings": warnings,
+        "improved": improved,
+        "missing": sorted(set(a) - set(b))[:50],
+        "new": sorted(set(b) - set(a))[:50],
+        "rel_tol": rel_tol,
+        "timing_rel_tol": timing_rel_tol,
+    }
+    return out
+
+
+def render(verdict: Dict[str, Any]) -> str:
+    lines = [f"telemetry diff: {verdict['verdict'].upper()} "
+             f"({verdict['checked']} metrics checked, "
+             f"tol {verdict['rel_tol']:g}/"
+             f"{verdict['timing_rel_tol']:g} timing)"]
+    for label, key in (("VIOLATION", "violations"), ("warn", "warnings"),
+                       ("improved", "improved")):
+        for e in verdict[key]:
+            lines.append(
+                f"  {label:>9}  {e['metric']}: {e['base']:g} -> "
+                f"{e['current']:g} ({e['rel_change']:+.1%}, "
+                f"{e['rule']})")
+    if verdict["missing"]:
+        lines.append(f"  missing in current: "
+                     f"{', '.join(verdict['missing'][:8])}"
+                     + (" ..." if len(verdict["missing"]) > 8 else ""))
+    if verdict["new"]:
+        lines.append(f"  new in current: {len(verdict['new'])} metrics")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu telemetry diff",
+        description="Compare two telemetry/flight snapshots; exit 1 on "
+                    "direction-violating deltas beyond tolerance.")
+    p.add_argument("baseline")
+    p.add_argument("current")
+    # default=None so an EXPLICIT flag is distinguishable from "unset"
+    # even when its value equals the built-in default — explicit flags
+    # must beat the baseline's embedded sentinel contract
+    p.add_argument("--rel-tol", type=float, default=None,
+                   help="relative tolerance for counter-class metrics "
+                        f"(default {DEFAULT_REL_TOL:g})")
+    p.add_argument("--timing-rel-tol", type=float, default=None,
+                   help="relative tolerance for wall-clock metrics "
+                        f"(default {DEFAULT_TIMING_REL_TOL:g})")
+    p.add_argument("--warn-timings", action="store_true",
+                   help="downgrade timing-class violations to warnings "
+                        "(CI on the CPU fallback)")
+    p.add_argument("--json", action="store_true",
+                   help="print the verdict as one JSON object")
+    args = p.parse_args(list(argv) if argv is not None else None)
+    try:
+        base = load_snapshot(args.baseline)
+        cur = load_snapshot(args.current)
+    except (OSError, ValueError) as e:
+        print(f"telemetry diff: {e}", file=sys.stderr)
+        return 2
+    # tolerance resolution: explicit CLI flag > the baseline's embedded
+    # comparison contract (the telemetry_diff_*_tol params, written by
+    # telemetry_snapshot.py as a `sentinel` block) > built-in default
+    sentinel = base.get("sentinel") if isinstance(base, dict) else None
+    if not isinstance(sentinel, dict):
+        sentinel = {}
+    rel_tol = args.rel_tol
+    if rel_tol is None:
+        rel_tol = float(sentinel.get("rel_tol", DEFAULT_REL_TOL))
+    timing_tol = args.timing_rel_tol
+    if timing_tol is None:
+        timing_tol = float(sentinel.get("timing_rel_tol",
+                                        DEFAULT_TIMING_REL_TOL))
+    verdict = diff_snapshots(base, cur, rel_tol=rel_tol,
+                             timing_rel_tol=timing_tol,
+                             warn_timings=args.warn_timings)
+    if args.json:
+        print(json.dumps(verdict, separators=(",", ":")))
+    else:
+        print(render(verdict))
+    return 1 if verdict["verdict"] == "regression" else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

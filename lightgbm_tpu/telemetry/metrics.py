@@ -131,10 +131,25 @@ class MetricsRegistry:
         Dotted metric names become underscore-separated (`train.rounds`
         -> `lgbm_tpu_train_rounds`); timings expand into the conventional
         `_seconds_count` / `_seconds_sum` pair plus min/max gauges.
+
+        Normalization can COLLIDE (`train.rounds` and `train_rounds`
+        both map to `lgbm_tpu_train_rounds`, and a counter can shadow a
+        gauge): colliding names get a deterministic `_dupN` suffix in
+        sorted-iteration order instead of two series silently sharing
+        one Prometheus name.
         """
-        def norm(name: str) -> str:
+        used: set = set()
+
+        def norm(name: str, suffix: str = "") -> str:
             out = "".join(c if c.isalnum() else "_" for c in name)
-            return f"{prefix}_{out}"
+            base = f"{prefix}_{out}{suffix}"
+            m = base
+            dup = 1
+            while m in used:
+                dup += 1
+                m = f"{base}_dup{dup}"
+            used.add(m)
+            return m
 
         lines = []
         with self._lock:
@@ -147,7 +162,7 @@ class MetricsRegistry:
                 lines.append(f"# TYPE {m} gauge")
                 lines.append(f"{m} {g.value:g}")
             for n, t in sorted(self._timings.items()):
-                m = norm(n) + "_seconds"
+                m = norm(n, "_seconds")
                 lines.append(f"# TYPE {m} summary")
                 lines.append(f"{m}_count {t.count}")
                 lines.append(f"{m}_sum {t.total:.6f}")

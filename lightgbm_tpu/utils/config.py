@@ -235,6 +235,23 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # Prometheus text-exposition dump of the metrics registry, written at
     # the end of engine.train() (node-exporter textfile collector format)
     "telemetry_prometheus": ("", "str", ()),
+    # training flight recorder (telemetry/recorder.py): opt-in ring-
+    # buffered per-round diagnostics — tree depth/leaf counts, split-gain
+    # quantiles, top split features, grad/hess aggregates, fallback
+    # events, per-phase wall-clock and compile/memory watermarks —
+    # emitted as `train.round` events and summarized by
+    # `booster.flight_summary()`.  Off (default): zero per-round work,
+    # byte-identical models either way (tests/test_flight_recorder.py)
+    "flight_recorder": (False, "bool", ()),
+    # ring size: how many most-recent rounds flight_summary() aggregates
+    "flight_recorder_depth": (128, "int", ()),
+    # perf-regression sentinel tolerances (`telemetry diff`, run by
+    # scripts/run_ci.sh against telemetry_baseline.json): relative
+    # tolerance for counter/shape metrics and for wall-clock metrics.
+    # Embedded in snapshots written by scripts/telemetry_snapshot.py so a
+    # baseline carries its own comparison contract
+    "telemetry_diff_rel_tol": (0.25, "float", ()),
+    "telemetry_diff_timing_rel_tol": (1.5, "float", ()),
     "saved_feature_importance_type": (0, "int", ()),
     "snapshot_freq": (-1, "int", ("save_period",)),
     "output_model": ("LightGBM_model.txt", "str", ("model_output", "model_out")),

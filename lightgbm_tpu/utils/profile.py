@@ -25,8 +25,6 @@ import math
 import time
 from typing import Any, Dict
 
-import numpy as np
-
 
 @contextlib.contextmanager
 def trace(logdir: str, create_perfetto_link: bool = False):
@@ -53,25 +51,22 @@ def analytic_bytes_per_round(n_rows: int, n_cols: int, num_leaves: int,
 
 
 def training_report(booster: Any, rounds: int, seconds: float) -> Dict:
-    """Derive throughput metrics from a timed training run."""
+    """Derive throughput metrics from a timed training run.
+
+    DEPRECATED shim: the analytic model now lives in
+    `telemetry.recorder.throughput_report` (single source of truth — a
+    `flight_recorder=true` booster embeds the same block in
+    `flight_summary()["throughput"]` with no caller-side timing).  Kept
+    because PROFILE.md tooling calls it; returns the exact same dict
+    keys it always had."""
+    from ..telemetry.recorder import throughput_report
     dd = booster._dd
     efb = dd.efb
     cols = efb.n_cols if efb is not None else dd.num_feature
-    bpr = analytic_bytes_per_round(dd.num_data, cols,
-                                   booster.config.num_leaves)
-    rps = rounds / max(seconds, 1e-9)
-    # scatter-adds: every row contributes 3 accumulates per column visited
-    scatter_rate = dd.num_data * cols * 3 * rps * \
-        (math.log2(max(booster.config.num_leaves, 2)) / 2.0 + 1.0)
-    return {
-        "rounds_per_sec": round(rps, 3),
-        "rows": int(dd.num_data),
-        "hist_columns": int(cols),
-        "est_hbm_gb_per_sec": round(bpr * rps / 1e9, 1),
-        "est_scatter_adds_per_sec": float(f"{scatter_rate:.3g}"),
-        "hist_impl": booster._grower_spec.hist_impl,
-        "bundled": efb is not None,
-    }
+    return throughput_report(rounds, seconds, dd.num_data, cols,
+                             booster.config.num_leaves,
+                             booster._grower_spec.hist_impl,
+                             efb is not None)
 
 
 def timeit_rounds(booster: Any, rounds: int) -> Dict:

@@ -15,6 +15,9 @@ Record fields:
   stdout_tail / stderr_tail   last 2000 chars each (backend init logs ride
                 in stderr because TF_CPP_MIN_LOG_LEVEL=0 + JAX verbose
                 logging are forced in the child env)
+  stages        per-stage durations {import_jax, client_init (PJRT
+                claim/grant), device_enumerate, compile_and_run} — present
+                for hangs too, truncated at the stage that wedged
   env           the axon-relevant env vars the child saw
 
 Usage:
@@ -55,14 +58,29 @@ AXON_KEYS = ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS", "PALLAS_AXON_TPU_GEN",
 CHILD_CODE = r"""
 import os, sys, time
 t0 = time.time()
+_last = [t0]
 def mark(msg):
     print(f"[probe-child +{time.time()-t0:6.2f}s] {msg}", file=sys.stderr,
           flush=True)
+def stage(name):
+    # @stage lines ride stdout and are flushed per-stage so a hang still
+    # leaves every COMPLETED stage's duration in TimeoutExpired.stdout —
+    # the record then says WHICH stage the tunnel wedged in, not just
+    # "it hung somewhere in backend init"
+    now = time.time()
+    print(f"@stage {name} {now - _last[0]:.3f}", flush=True)
+    _last[0] = now
 mark("importing jax")
 import jax
+stage("import_jax")
 mark(f"jax {jax.__version__} imported")
-mark("calling jax.devices() (backend init)")
+mark("initialising PJRT client (claim/grant)")
+from jax.extend import backend as _xb
+_xb.get_backend()
+stage("client_init")
+mark("calling jax.devices() (device enumerate)")
 d = jax.devices()
+stage("device_enumerate")
 mark(f"devices up: {[str(x) for x in d]}")
 import jax.numpy as jnp
 x = jnp.ones((256, 256), jnp.bfloat16)
@@ -70,9 +88,25 @@ mark("compiling+running matmul")
 y = (x @ x)
 import numpy as np
 s = float(np.asarray(y[:2, :2]).sum())   # np.asarray forces real transfer
+stage("compile_and_run")
 mark(f"matmul done, checksum {s}")
 print(f"@ok {d[0].platform} {len(d)} {time.time()-t0:.2f}")
 """
+
+
+def parse_stages(stdout: str) -> dict:
+    """`@stage <name> <secs>` lines -> {name: secs}, in child order."""
+    stages = {}
+    for line in (stdout or "").splitlines():
+        if not line.startswith("@stage "):
+            continue
+        parts = line.split()
+        if len(parts) == 3:
+            try:
+                stages[parts[1]] = float(parts[2])
+            except ValueError:
+                continue
+    return stages
 
 
 def probe(timeout: float, label: str) -> bool:
@@ -94,6 +128,7 @@ def probe(timeout: float, label: str) -> bool:
         rec["elapsed_sec"] = round(time.time() - t0, 2)
         rec["stdout_tail"] = r.stdout[-2000:]
         rec["stderr_tail"] = r.stderr[-2000:]
+        rec["stages"] = parse_stages(r.stdout)
         ok_line = next((l for l in r.stdout.splitlines()
                         if l.startswith("@ok ")), None)
         if r.returncode == 0 and ok_line:
@@ -107,12 +142,16 @@ def probe(timeout: float, label: str) -> bool:
         rec["outcome"] = "hung"
         # TimeoutExpired carries whatever the child wrote before the kill —
         # this is the diagnostic payload: how far did backend init get?
-        rec["stdout_tail"] = (e.stdout or b"")[-2000:].decode(
-            "utf-8", "replace") if isinstance(e.stdout, bytes) else (
-            e.stdout or "")[-2000:]
+        out_full = (e.stdout or b"").decode("utf-8", "replace") \
+            if isinstance(e.stdout, bytes) else (e.stdout or "")
+        rec["stdout_tail"] = out_full[-2000:]
         rec["stderr_tail"] = (e.stderr or b"")[-2000:].decode(
             "utf-8", "replace") if isinstance(e.stderr, bytes) else (
             e.stderr or "")[-2000:]
+        # completed stages narrow the hang to one phase: e.g. stages
+        # showing client_init but not device_enumerate pins the wedge on
+        # PJRT device enumeration, not the claim/grant handshake
+        rec["stages"] = parse_stages(out_full)
     except OSError as e:
         rec["elapsed_sec"] = round(time.time() - t0, 2)
         rec.update(outcome="spawn-failed", error=str(e))
@@ -125,6 +164,9 @@ def probe(timeout: float, label: str) -> bool:
           + (f" — {rec.get('platform')}x{rec.get('n_devices')}" if ok else "")
           + f" (logged to {os.path.basename(LOG_PATH)})",
           file=sys.stderr, flush=True)
+    if rec.get("stages"):
+        done = ", ".join(f"{k}={v:.2f}s" for k, v in rec["stages"].items())
+        print(f"[probe]   stages: {done}", file=sys.stderr, flush=True)
     if not ok:
         tail = (rec.get("stderr_tail") or "").strip().splitlines()[-6:]
         for l in tail:

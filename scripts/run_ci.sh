@@ -13,7 +13,24 @@ tier="${1:-quick}"
 ./scripts/lint.sh
 
 case "$tier" in
-  quick) exec python -m pytest tests/ -m quick -q ;;
-  full)  exec python -m pytest tests/ -q ;;
+  quick) python -m pytest tests/ -m quick -q ;;
+  full)  python -m pytest tests/ -q ;;
   *) echo "usage: $0 [quick|full]" >&2; exit 2 ;;
 esac
+
+# perf-regression sentinel: fresh deterministic snapshot diffed against
+# the checked-in baseline.  Counter-class drift (tree shape, recompiles,
+# fallback events, memory watermarks) FAILS; wall-clock drift only warns
+# (--warn-timings: this gate runs on the shared-core CPU fallback where
+# absolute timings are noise).  Regenerate the baseline with
+# scripts/telemetry_baseline.sh when the mechanism change is intended.
+baseline="scripts/telemetry_baseline.json"
+if [[ -f "$baseline" ]]; then
+  snap="$(mktemp /tmp/telemetry_snapshot.XXXXXX.json)"
+  trap 'rm -f "$snap"' EXIT
+  JAX_PLATFORMS=cpu python scripts/telemetry_snapshot.py --out "$snap"
+  JAX_PLATFORMS=cpu python -m lightgbm_tpu telemetry diff \
+    "$baseline" "$snap" --warn-timings
+else
+  echo "[run_ci] no $baseline — sentinel skipped" >&2
+fi

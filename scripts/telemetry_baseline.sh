@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Regenerate the checked-in perf-regression-sentinel baseline
+# (scripts/telemetry_baseline.json) after an INTENDED change to the
+# training mechanism — new counters, a different tree-growth policy,
+# an extra compile.  scripts/run_ci.sh diffs every run against this
+# file; commit the regenerated baseline together with the change that
+# moved it, with the move called out in the commit message.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="scripts/telemetry_baseline.json"
+JAX_PLATFORMS=cpu python scripts/telemetry_snapshot.py --out "$out" "$@"
+
+# self-diff must be clean — a baseline that flags against itself would
+# wedge CI on the very next run
+python -m lightgbm_tpu telemetry diff "$out" "$out"
+echo "[telemetry-baseline] $out regenerated and self-diff clean"

@@ -1,0 +1,229 @@
+"""Flight recorder (ISSUE 3 tentpole): per-round records, summary shape,
+and the two hard invariants —
+
+ - OFF is free: no FlightRecorder is constructed, no `train.round`
+   events reach an attached sink, no per-round python allocations ride
+   the boost loop;
+ - ON changes nothing the model can see: grown model bytes are
+   identical recorder-on vs recorder-off for every growth mode
+   (leafwise, wave, dart, multiclass).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.telemetry import recorder as rec_mod
+from lightgbm_tpu.telemetry.recorder import (FlightRecorder, quantiles,
+                                             tree_depth, tree_stats)
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(autouse=True)
+def _restore_tracer_state():
+    """flight_recorder force-enables span recording process-wide;
+    restore the tracer so tests stay order-independent (test_telemetry
+    asserts the default-inactive tracer)."""
+    forced = telemetry.TRACER._forced
+    yield
+    telemetry.TRACER.enable(forced)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _reset_watermarks():
+    """Watermark peaks are process-global; isolate this module from
+    whatever ran before/after (NOT per-test: the class-scoped trained
+    booster's samples must survive across its test methods)."""
+    rec_mod.reset_watermarks()
+    yield
+    rec_mod.reset_watermarks()
+
+
+def _data(n=1200, f=8, seed=5, classes=None):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    score = X[:, 0] - 0.7 * X[:, 1] + X[:, 2] * X[:, 3]
+    if classes:
+        edges = np.quantile(score, np.linspace(0, 1, classes + 1)[1:-1])
+        y = np.digitize(score + 0.3 * rng.randn(n), edges).astype(float)
+    else:
+        y = (score + 0.5 * rng.randn(n) > 0).astype(float)
+    return X, y
+
+
+# ---------------------------------------------------------------- units
+
+class TestUnits:
+    def test_quantiles_interpolation(self):
+        assert quantiles([1, 2, 3, 4], [0.0, 0.5, 1.0]) == [1.0, 2.5, 4.0]
+        assert quantiles([5], [0.25, 0.75]) == [5.0, 5.0]
+        assert quantiles([], [0.5]) == [0.0]
+
+    def test_tree_depth_hand_built(self):
+        # node0 -> (~0, node1); node1 -> (~1, ~2): depths 1, 2, 2
+        assert tree_depth([~0, ~1], [1, ~2], num_leaves=3) == 2
+        assert tree_depth([], [], num_leaves=1) == 0
+
+    def test_tree_stats_on_trained_tree(self):
+        X, y = _data()
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7}, lgb.Dataset(X, label=y), 2)
+        st = tree_stats(bst.trees[0])
+        assert st["num_leaves"] == len(bst.trees[0].leaf_value)
+        assert st["depth"] >= 1
+        assert len(st["gains"]) == st["num_leaves"] - 1
+        assert all(g >= 0 for g in st["gains"])
+        assert st["hess_sum"] > 0
+
+    def test_ring_depth_bounds_memory(self):
+        fr = FlightRecorder(depth=4)
+        for i in range(10):
+            fr.record_round(i, [{"num_leaves": 3, "depth": 2, "gains": [],
+                                 "features": [], "grad_sum": 0.0,
+                                 "grad_l1": 0.0, "hess_sum": 1.0}])
+        assert len(fr.ring) == 4
+        assert fr.ring[0]["round"] == 6
+        s = fr.summary()
+        assert s["rounds"] == 10 and s["rounds_recorded"] == 4
+
+
+# ------------------------------------------------- off-is-free invariant
+
+class TestRecorderOff:
+    def test_no_recorder_constructed(self, monkeypatch):
+        def boom(*a, **k):
+            raise AssertionError("FlightRecorder constructed with "
+                                 "flight_recorder=false")
+        monkeypatch.setattr(rec_mod, "FlightRecorder", boom)
+        X, y = _data()
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "num_leaves": 7}, lgb.Dataset(X, label=y), 3)
+
+    def test_no_train_round_events(self):
+        sink = telemetry.TRACER.add_sink(telemetry.MemorySink())
+        try:
+            X, y = _data()
+            lgb.train({"objective": "binary", "verbosity": -1,
+                       "num_leaves": 7}, lgb.Dataset(X, label=y), 3)
+            kinds = {e.get("name") for e in sink.events}
+            assert "train.round" not in kinds
+        finally:
+            telemetry.TRACER.clear_sinks()
+
+    def test_flight_summary_reports_disabled(self):
+        X, y = _data()
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7}, lgb.Dataset(X, label=y), 2)
+        assert bst.flight_summary() == {"enabled": False}
+
+
+# -------------------------------------------- on-changes-nothing invariant
+
+def _strip_recorder_params(model_str: str) -> str:
+    """The params dump in the model echoes every param, including the
+    recorder switch itself — the only legitimate on/off difference."""
+    return "\n".join(ln for ln in model_str.splitlines()
+                     if not ln.startswith("[flight_recorder"))
+
+
+MODES = {
+    "leafwise": {"objective": "binary", "num_leaves": 15},
+    "wave": {"objective": "binary", "num_leaves": 15,
+             "tree_grow_policy": "wave"},
+    "dart": {"objective": "binary", "num_leaves": 15, "boosting": "dart",
+             "drop_rate": 0.3, "drop_seed": 9},
+    "multiclass": {"objective": "multiclass", "num_class": 3,
+                   "num_leaves": 7},
+}
+
+
+class TestByteIdenticalModels:
+    @pytest.mark.parametrize("mode", sorted(MODES))
+    def test_model_identical_on_vs_off(self, mode):
+        cfg = dict(MODES[mode], verbosity=-1, learning_rate=0.2)
+        classes = cfg.get("num_class")
+        X, y = _data(classes=classes)
+
+        def run(flight):
+            params = dict(cfg, flight_recorder=flight)
+            bst = lgb.train(params, lgb.Dataset(X, label=y), 6)
+            return _strip_recorder_params(bst.model_to_string())
+
+        assert run(True) == run(False), f"{mode}: model bytes diverged"
+
+
+# ----------------------------------------------------- recording + summary
+
+class TestRecorderOn:
+    @pytest.fixture(scope="class")
+    def trained(self):
+        X, y = _data()
+        Xe, ye = X[:300], y[:300]
+        sink = telemetry.TRACER.add_sink(telemetry.MemorySink())
+        try:
+            bst = lgb.train({"objective": "binary", "verbosity": -1,
+                             "num_leaves": 15, "flight_recorder": True,
+                             "flight_recorder_depth": 64},
+                            lgb.Dataset(X, label=y), 8,
+                            valid_sets=[lgb.Dataset(Xe, label=ye)],
+                            valid_names=["v"])
+            events = list(sink.events)
+        finally:
+            telemetry.TRACER.clear_sinks()
+            telemetry.TRACER.enable(False)
+        return bst, events
+
+    def test_train_round_events_emitted(self, trained):
+        _, events = trained
+        rounds = [e for e in events if e.get("name") == "train.round"]
+        assert len(rounds) == 8
+        r = rounds[0]
+        for key in ("round", "trees", "num_leaves", "max_depth", "splits",
+                    "gain_p50", "gain_p90", "gain_max", "top_features",
+                    "grad_l1", "hess_sum"):
+            assert key in r, key
+        assert rounds[-1]["round"] == 7
+
+    def test_summary_shape(self, trained):
+        bst, _ = trained
+        s = bst.flight_summary()
+        for key in ("enabled", "rounds", "rounds_recorded", "trees",
+                    "depth_p50", "depth_max", "leaves_p50", "leaves_max",
+                    "gain_p50_med", "top_features", "eval", "phase_s",
+                    "compile", "watermarks"):
+            assert key in s, key
+        assert s["enabled"] is True
+        assert s["rounds"] == 8 and s["trees"] == 8
+        assert s["leaves_max"] <= 15
+        assert json.loads(json.dumps(s)) == s  # JSON-ready
+
+    def test_eval_series_folded(self, trained):
+        bst, _ = trained
+        ev = bst.flight_summary()["eval"]
+        assert "v.binary_logloss" in ev
+        series = ev["v.binary_logloss"]
+        assert series["n"] == 8
+        # training on this separable toy must improve logloss
+        assert series["last"] < series["first"]
+
+    def test_phase_timings_recorded(self, trained):
+        bst, _ = trained
+        phases = bst.flight_summary()["phase_s"]
+        assert phases, "no phase timings recorded"
+        assert any(k.startswith("train.") for k in phases)
+
+    def test_watermarks_present(self, trained):
+        bst, _ = trained
+        wm = bst.flight_summary()["watermarks"]
+        assert "train" in wm
+        assert wm["train"]["peak_bytes"] > 0
+        assert wm["train"]["source"] in ("memory_stats", "live_arrays")
+
+    def test_compile_accounting(self, trained):
+        bst, _ = trained
+        comp = bst.flight_summary()["compile"]
+        assert comp["cache_entries"] >= 0
+        assert comp["recompiles"] >= 0

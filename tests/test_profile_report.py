@@ -78,3 +78,58 @@ class TestTrainingReport:
         slow = training_report(booster, rounds=2, seconds=1.0)
         assert fast["rounds_per_sec"] == pytest.approx(
             10 * slow["rounds_per_sec"])
+
+
+class TestShimDelegation:
+    """training_report is now a shim over
+    telemetry.recorder.throughput_report (single source of truth); the
+    public dict shape must never drift from what it always returned."""
+
+    SHIM_KEYS = {"rounds_per_sec", "rows", "hist_columns",
+                 "est_hbm_gb_per_sec", "est_scatter_adds_per_sec",
+                 "hist_impl", "bundled"}
+
+    @pytest.fixture(scope="class")
+    def booster(self):
+        rng = np.random.RandomState(3)
+        X = rng.randn(500, 5)
+        y = X[:, 0] + 0.2 * rng.randn(500)
+        return lgb.train({"objective": "regression", "verbosity": -1,
+                          "num_leaves": 7}, lgb.Dataset(X, label=y), 2)
+
+    def test_same_keys_as_always(self, booster):
+        rep = training_report(booster, rounds=2, seconds=0.5)
+        assert set(rep) == self.SHIM_KEYS
+
+    def test_matches_recorder_model_exactly(self, booster):
+        from lightgbm_tpu.telemetry.recorder import throughput_report
+        rep = training_report(booster, rounds=4, seconds=1.5)
+        dd = booster._dd
+        cols = dd.efb.n_cols if dd.efb is not None else dd.num_feature
+        direct = throughput_report(4, 1.5, dd.num_data, cols, 7,
+                                   booster._grower_spec.hist_impl,
+                                   dd.efb is not None)
+        assert rep == direct
+
+    def test_flight_summary_embeds_same_block(self):
+        from lightgbm_tpu import telemetry
+        forced = telemetry.TRACER._forced
+        try:
+            self._flight_summary_case()
+        finally:
+            # flight_recorder force-enables span recording process-wide;
+            # restore so later tests see the default-inactive tracer
+            telemetry.TRACER.enable(forced)
+
+    def _flight_summary_case(self):
+        rng = np.random.RandomState(4)
+        X = rng.randn(500, 5)
+        y = X[:, 0] + 0.2 * rng.randn(500)
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "num_leaves": 7, "flight_recorder": True},
+                        lgb.Dataset(X, label=y), 4)
+        tp = bst.flight_summary().get("throughput")
+        if tp is None:
+            pytest.skip("no train.chunk timing recorded on this path")
+        assert set(tp) == self.SHIM_KEYS
+        assert tp["rows"] == 500

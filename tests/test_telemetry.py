@@ -296,20 +296,58 @@ class TestMetricsRegistry:
         write_prometheus(str(p), registry=reg)
         assert p.read_text() == text
 
+    def test_prometheus_name_collision_disambiguated(self):
+        """Normalization maps `train.rounds` and `train_rounds` to the
+        same Prometheus name; colliding series must get a `_dupN` suffix
+        instead of silently sharing one name (regression: the second
+        series used to shadow the first in scrapes)."""
+        reg = MetricsRegistry()
+        reg.counter("train.rounds").inc(1)
+        reg.counter("train_rounds").inc(2)
+        reg.gauge("train:rounds").set(3)   # collides across metric kinds
+        text = reg.to_prometheus()
+        assert text.count("# TYPE lgbm_tpu_train_rounds counter") == 1
+        assert "lgbm_tpu_train_rounds 1" in text
+        assert "# TYPE lgbm_tpu_train_rounds_dup2 counter" in text
+        assert "lgbm_tpu_train_rounds_dup2 2" in text
+        assert "# TYPE lgbm_tpu_train_rounds_dup3 gauge" in text
+        assert "lgbm_tpu_train_rounds_dup3 3" in text
+        # every exposed series name is unique
+        names = [ln.split()[0] for ln in text.splitlines()
+                 if ln and not ln.startswith("#")]
+        assert len(names) == len(set(names))
+
+    def test_prometheus_timing_collision_disambiguated(self):
+        reg = MetricsRegistry()
+        reg.timing("span.eval").observe(0.1)
+        reg.timing("span:eval").observe(0.2)
+        text = reg.to_prometheus()
+        assert "lgbm_tpu_span_eval_seconds_count 1" in text
+        assert "lgbm_tpu_span_eval_seconds_dup2_count 1" in text
+
     def test_jax_free_import(self):
         """bench.py / probe_tpu.py load these modules by file path in
         processes that must never import jax — prove the modules don't."""
         import subprocess
         import sys
         code = (
-            "import importlib.util, sys\n"
-            "for mod in ('metrics', 'sinks', 'report'):\n"
+            "import importlib.util, sys, types\n"
+            # recorder.py does relative sibling imports; a synthetic
+            # parent package rooted at the telemetry dir resolves them
+            # without executing lightgbm_tpu/__init__.py (which pulls jax)
+            "pkg = types.ModuleType('tel')\n"
+            "pkg.__path__ = ['lightgbm_tpu/telemetry']\n"
+            "sys.modules['tel'] = pkg\n"
+            "for mod in ('metrics', 'sinks', 'spans', 'report', "
+            "'recorder', 'diff'):\n"
             "    spec = importlib.util.spec_from_file_location(\n"
-            "        'tel_' + mod, 'lightgbm_tpu/telemetry/' + mod + '.py')\n"
+            "        'tel.' + mod, 'lightgbm_tpu/telemetry/' + mod + '.py')\n"
             "    m = importlib.util.module_from_spec(spec)\n"
-            "    sys.modules['tel_' + mod] = m\n"
+            "    sys.modules['tel.' + mod] = m\n"
             "    spec.loader.exec_module(m)\n"
             "assert 'jax' not in sys.modules, 'jax leaked'\n"
+            "rec = sys.modules['tel.recorder']\n"
+            "assert rec.sample_memory('t') in (None,)  # no-jax fallback\n"
             "print('CLEAN')\n")
         r = subprocess.run([sys.executable, "-c", code], cwd="/root/repo",
                            capture_output=True, text=True, timeout=60)

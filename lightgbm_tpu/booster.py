@@ -20,7 +20,9 @@ from __future__ import annotations
 import copy
 import io
 import json
-from typing import Any, Dict, List, Optional, Tuple, Union
+import time
+from collections import deque
+from typing import Any, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,6 +41,22 @@ from .utils.config import Config
 from .utils.log import LightGBMError
 
 __all__ = ["Booster"]
+
+
+class _PendingChunk(NamedTuple):
+    """A dispatched-but-not-harvested fused chunk (pipelined training).
+
+    Holds the DEVICE-side futures JAX async dispatch returned: the
+    stacked trees and the per-iteration score snapshots.  The score
+    carries themselves are NOT here — `_dispatch_chunk` rebinds
+    `_train_score`/`_valid_scores` to the chunk's outputs immediately, so
+    the next chunk can be enqueued before this one is harvested."""
+    spec: Any            # BulkSpec the chunk was dispatched with
+    stacked: Any         # stacked DeviceTree pytree (device)
+    t_iter: Any          # [C, ...] per-iter train scores (device; [C, 0] off)
+    v_iter: Tuple        # per-valid [C, ...] per-iter scores (device)
+    it0: int             # first iteration index of the chunk
+    dispatch_t: float    # perf_counter right after dispatch returned
 
 
 class _DeviceData:
@@ -428,6 +446,14 @@ class Booster:
         self._train_score = self._zero_score(self._dd)
         self._valid_dd: List[_DeviceData] = []
         self._valid_scores: List[jax.Array] = []
+        # pipelined chunk training state: FIFO of dispatched-but-not-yet-
+        # harvested chunks and the iteration count they will add once
+        # decoded (cur_iter only advances at harvest, but the NEXT
+        # dispatch must derive its RNG streams from the post-chunk
+        # iteration index)
+        self._inflight: "deque[_PendingChunk]" = deque()
+        self._pending_iters = 0
+        self._pipe_prev_ready_t: Optional[float] = None
 
         self._grad_key0 = jax.random.PRNGKey(
             self.config.objective_seed % (2 ** 31))
@@ -726,7 +752,15 @@ class Booster:
         if spec.monotone_intermediate:
             reasons.append("monotone_constraints_method=intermediate")
         if spec.hist_pool_slots:
-            reasons.append("histogram_pool_size (bounded histogram pool)")
+            # decision note COVERAGE.md r6: the wave frontier needs every
+            # parent histogram resident at once for sibling-by-subtraction,
+            # so a bounded pool cannot be threaded through make_wave_grower
+            reasons.append(
+                "histogram_pool_size (the bounded pool caps resident "
+                f"histograms at {spec.hist_pool_slots} of "
+                f"{spec.num_leaves}; dropping the cap restores the wave "
+                "policy at the cost of the pool's memory bound — "
+                "COVERAGE.md r6 decision note)")
         kind, shards, _, _, _, s_last = self._learner_topology()
         if shards <= 1:
             kind = "serial"      # the one-device fallback (wave-eligible)
@@ -1556,35 +1590,76 @@ class Booster:
             self._bulk_key = key
         return self._bulk_trainer_cache
 
-    def _run_chunk(self, spec):
-        """Run ONE compiled chunk; returns (finished, per-iter train scores
-        or None, per-valid list of per-iter scores)."""
+    def _pipeline_depth(self) -> int:
+        """Max fused chunks in flight (`tpu_pipeline_chunks`, floor 1)."""
+        return max(1, int(self.config.tpu_pipeline_chunks or 1))
+
+    def _dispatch_chunk(self, spec) -> _PendingChunk:
+        """Enqueue ONE compiled chunk and return without waiting for it.
+
+        JAX async dispatch makes the jitted call return device-side
+        futures; the score carries are rebound to those futures at once,
+        so chunk k+1 can be dispatched (its inputs are chunk k's
+        device-side outputs) while chunk k still runs — the host decode/
+        eval of chunk k then overlaps chunk k+1's device compute."""
         trainer = self._bulk_trainer(spec)
         # first dispatch of a (re)built trainer traces + compiles the whole
         # chunk program synchronously — span it as compile_warmup
         warm = getattr(self, "_bulk_warm_key", None) == self._bulk_key
         dd = self._dd
         valid_bins = tuple(v.bins_fm for v in self._valid_dd[:spec.n_valid])
+        # cur_iter only advances when a chunk is harvested (decoded), so
+        # in-flight rounds must be added back for the RNG stream index
+        it0 = self.cur_iter + self._pending_iters
+        telemetry.REGISTRY.gauge("train.pipeline.depth").set(
+            self._pipeline_depth())
         with telemetry.span("train.chunk", rounds=spec.chunk, fused=True):
             with telemetry.span("compile_warmup", kind="bulk_trainer") \
                     if not warm else telemetry.NOOP, self._nan_check_ctx():
                 score, vfinal, stacked, v_iter, t_iter = trainer(
                     self._train_score,
                     tuple(self._valid_scores[:spec.n_valid]),
-                    jnp.int32(self.cur_iter), self._rng_key0, self._ff_key0,
+                    jnp.int32(it0), self._rng_key0, self._ff_key0,
                     self._grad_key0, self._train_bins, self._feat,
                     dd.base_allowed_dev, valid_bins)
-            self._bulk_warm_key = self._bulk_key
-            self._train_score = score
-            if spec.n_valid:
-                self._valid_scores[:spec.n_valid] = list(vfinal)
-            # _decode_stacked device_gets the finished trees, so the chunk
-            # span ends on real results, not on async dispatch
+        self._bulk_warm_key = self._bulk_key
+        # rebind the (donated) score carries to the chunk's outputs NOW:
+        # the old buffers are dead the moment the trainer returns, and the
+        # next dispatch reads these futures without any host sync
+        self._train_score = score
+        if spec.n_valid:
+            self._valid_scores[:spec.n_valid] = list(vfinal)
+        self._pending_iters += spec.chunk
+        pend = _PendingChunk(spec, stacked, t_iter, v_iter, it0,
+                             time.perf_counter())
+        self._inflight.append(pend)
+        return pend
+
+    def _harvest_chunk(self, pending: _PendingChunk):
+        """Block on a dispatched chunk's outputs and decode them.
+
+        Returns (finished, per-iter train scores or None, per-valid list
+        of per-iter scores) — `_run_chunk`'s contract.  Must be called in
+        dispatch order: tree decode appends to `self.trees`
+        sequentially."""
+        if not self._inflight or self._inflight[0] is not pending:
+            raise LightGBMError("pipeline harvest out of dispatch order")
+        self._inflight.popleft()
+        spec = pending.spec
+        with telemetry.span("train.harvest", rounds=spec.chunk):
+            # ONE device→host transfer for the trees AND every score
+            # snapshot — each separate device_get pays the tunnel's full
+            # ~70 ms latency (PROFILE.md r3b; same batching Tree.from_device
+            # got in tree.py)
+            host, t_host, v_host = jax.device_get(
+                (pending.stacked, pending.t_iter, pending.v_iter))
+            ready_t = time.perf_counter()
+            self._note_pipeline_gap(pending.dispatch_t, ready_t)
             with telemetry.span("train.decode", rounds=spec.chunk):
-                finished = self._decode_stacked(stacked)
-            t_np = np.asarray(jax.device_get(t_iter)) \
-                if spec.emit_train_scores else None
-            v_np = [np.asarray(jax.device_get(v)) for v in v_iter]
+                finished = self._decode_stacked(host)
+            t_np = np.asarray(t_host) if spec.emit_train_scores else None
+            v_np = [np.asarray(v) for v in v_host]
+        self._pending_iters -= spec.chunk
         telemetry.REGISTRY.counter("train.rounds").inc(spec.chunk)
         telemetry.REGISTRY.counter("train.chunks").inc()
         if self._flight is not None:
@@ -1592,22 +1667,71 @@ class Booster:
             sample_memory("train")
         return finished, t_np, v_np
 
+    def _note_pipeline_gap(self, dispatch_t: float, ready_t: float) -> None:
+        """Record the device-idle-per-chunk ESTIMATE: the gap between the
+        previous chunk's outputs being ready (its device_get returning)
+        and this chunk's dispatch.  Serial schedules pay the whole host
+        decode/eval there; a pipelined schedule dispatched this chunk
+        before the previous harvest, so the gap clamps to ~0.  An
+        estimate — host-side timestamps can't see inside the XLA queue —
+        but its trend is the pipeline's win, and `telemetry diff`
+        sentinels it as a timing-class metric."""
+        prev_ready = self._pipe_prev_ready_t
+        self._pipe_prev_ready_t = ready_t
+        if prev_ready is None:
+            return
+        idle = max(0.0, dispatch_t - prev_ready)
+        telemetry.REGISTRY.gauge(
+            "train.pipeline.device_idle_s").set(round(idle, 6))
+        telemetry.REGISTRY.timing("train.pipeline.idle").observe(idle)
+
+    def _run_chunk(self, spec):
+        """Run ONE compiled chunk synchronously (dispatch + harvest
+        back-to-back); returns (finished, per-iter train scores or None,
+        per-valid list of per-iter scores)."""
+        return self._harvest_chunk(self._dispatch_chunk(spec))
+
     def update_many(self, n_rounds: int) -> bool:
         """Run `n_rounds` boosting iterations, fusing them into compiled
         device-side chunks when nothing needs the host in between.  Falls
         back to per-iteration updates otherwise.  Returns the final
-        `update()`-style is_finished flag."""
+        `update()`-style is_finished flag.
+
+        Chunks are pipelined up to `tpu_pipeline_chunks` in flight: the
+        device computes chunk k+1 while the host decodes chunk k's trees
+        (byte-identical models at any depth — only the SCHEDULE moves)."""
         finished = False
         remaining = n_rounds
         if self._bulk_eligible() and remaining >= self._BULK_CHUNK:
             self._boost_from_average()
             spec = self._make_bulk_spec()
+            depth = self._pipeline_depth()
             while remaining >= self._BULK_CHUNK:
-                finished, _, _ = self._run_chunk(spec)
+                self._dispatch_chunk(spec)
                 remaining -= self._BULK_CHUNK
+                if len(self._inflight) >= depth:
+                    finished, _, _ = self._harvest_chunk(self._inflight[0])
+            while self._inflight:
+                finished, _, _ = self._harvest_chunk(self._inflight[0])
         for _ in range(remaining):
             finished = self.update()
         return finished
+
+    def dispatch_chunk_eval(self, want_train_scores: bool) -> _PendingChunk:
+        """Dispatch one fused chunk WITH per-iteration train/valid score
+        emission and return its pending handle without waiting — the
+        engine's chunked-eval loop uses this to run chunk k+1 on the
+        device speculatively while chunk k's metrics/callbacks run on the
+        host (early stopping rolls the speculated trees back)."""
+        self._boost_from_average()
+        spec = self._make_bulk_spec(n_valid=len(self._valid_dd),
+                                    emit_train=want_train_scores)
+        return self._dispatch_chunk(spec)
+
+    def harvest_chunk_eval(self, pending: _PendingChunk):
+        """Harvest a `dispatch_chunk_eval` chunk.  Returns (finished,
+        train_scores [C, ...] | None, [valid_scores [C, ...]])."""
+        return self._harvest_chunk(pending)
 
     def update_chunk_eval(self, want_train_scores: bool):
         """One fused chunk WITH per-iteration train/valid score emission —
@@ -1615,10 +1739,8 @@ class Booster:
         eval-driven training (early stopping) syncs once per chunk.
         Returns (finished, train_scores [C, ...] | None,
         [valid_scores [C, ...]])."""
-        self._boost_from_average()
-        spec = self._make_bulk_spec(n_valid=len(self._valid_dd),
-                                    emit_train=want_train_scores)
-        return self._run_chunk(spec)
+        return self.harvest_chunk_eval(
+            self.dispatch_chunk_eval(want_train_scores))
 
     def eval_with_scores(self, score_np: np.ndarray, data, name: str,
                          feval, it_count: int):
@@ -1629,10 +1751,10 @@ class Booster:
             s = s / it_count
         return self._eval_one(s, data, name, feval)
 
-    def _decode_stacked(self, stacked) -> bool:
-        """Decode a chunk of stacked device trees into host Tree objects —
-        ONE device→host sync for the whole chunk."""
-        host = jax.device_get(stacked)
+    def _decode_stacked(self, host) -> bool:
+        """Decode a chunk of stacked trees into host Tree objects.  `host`
+        is the already-transferred pytree — `_harvest_chunk` batches the
+        tree readback with the score snapshots into one device_get."""
         K = self.num_tree_per_iteration
         # RF trees carry no shrinkage (must match the in-chunk score math)
         lr = 1.0 if self._boost_mode == "rf" else self.config.learning_rate
@@ -1654,7 +1776,9 @@ class Booster:
                 if round_trees is not None:
                     round_trees.append(telemetry.tree_stats(tree))
             if round_trees is not None:
-                self._flight.record_round(self.cur_iter, round_trees)
+                self._flight.record_round(
+                    self.cur_iter, round_trees,
+                    pipeline_depth=self._pipeline_depth())
             self.cur_iter += 1
         self._last_contribs = []
         return all_const
@@ -2142,8 +2266,13 @@ class Booster:
         if getattr(self, "_pred_dev_jit", None) is None:
             self._pred_dev_jit = jax.jit(predict_raw_ensemble)
         arrays = {k: v for k, v in stacked.items() if k != "min_features"}
-        out = self._pred_dev_jit(arrays,
-                                 jnp.asarray(X, dtype=jnp.float32))
+        # f64 values beyond f32 range overflow to ±inf in this cast — the
+        # routing we WANT (inf exceeds every threshold/span, so such rows
+        # take the same branch as any huge in-range value); cast under
+        # errstate so the intended saturation doesn't warn
+        with np.errstate(over="ignore"):
+            X32 = np.asarray(X, dtype=np.float32)
+        out = self._pred_dev_jit(arrays, jnp.asarray(X32))
         return np.asarray(jax.device_get(out), dtype=np.float64)
 
     def _predict_contrib(self, X: np.ndarray, trees: List[Tree]) -> np.ndarray:

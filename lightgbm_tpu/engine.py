@@ -166,9 +166,24 @@ def _train_impl(params, train_set, num_boost_round, valid_sets, valid_names,
 
     i = begin_iteration
     stopped = False
+    pending = None   # speculatively dispatched next chunk (pipelined eval)
     while i < end_iteration and not stopped:
-        if use_chunked and end_iteration - i >= chunk:
-            _, t_scores, v_scores = booster.update_chunk_eval(want_train_eval)
+        if use_chunked and (pending is not None
+                            or end_iteration - i >= chunk):
+            if pending is None:
+                pending = booster.dispatch_chunk_eval(want_train_eval)
+            # speculative dispatch: enqueue chunk k+1 BEFORE harvesting
+            # chunk k, so the device computes it while metrics/callbacks
+            # run below.  Early stopping makes those rounds overshoot —
+            # the same rollback that already handles within-chunk
+            # overshoot erases them, so best_iteration and the final
+            # model match the serial schedule exactly
+            nxt = None
+            if booster._pipeline_depth() > 1 \
+                    and end_iteration - (i + chunk) >= chunk:
+                nxt = booster.dispatch_chunk_eval(want_train_eval)
+            _, t_scores, v_scores = booster.harvest_chunk_eval(pending)
+            pending = nxt
             try:
                 for j in range(chunk):
                     evaluation_result_list = eval_at(i + j, t_scores,
@@ -182,8 +197,13 @@ def _train_impl(params, train_set, num_boost_round, valid_sets, valid_names,
             except callback_mod.EarlyStopException as es:
                 booster.best_iteration = es.best_iteration + 1
                 evaluation_result_list = es.best_score
-                # the chunk overshot the stopping point — roll back to where
-                # per-iteration training would have stopped
+                # the chunk (and any speculated successor) overshot the
+                # stopping point — decode the in-flight trees first, then
+                # roll back to where per-iteration training would have
+                # stopped
+                if pending is not None:
+                    booster.harvest_chunk_eval(pending)
+                    pending = None
                 while booster.current_iteration() > i + j + 1:
                     booster.rollback_one_iter()
                 stopped = True

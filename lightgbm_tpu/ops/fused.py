@@ -289,7 +289,13 @@ def make_bulk_trainer(spec: BulkSpec, grad_fn: Callable, renew_args=None,
         return (new_score, tuple(new_vscores)), \
             (stacked, tuple(new_vscores), t_emit)
 
-    @jax.jit
+    # score/vscores are pure carries: the caller immediately rebinds its
+    # references to the returned buffers (booster._dispatch_chunk), so XLA
+    # may reuse the input HBM for the output in place of a copy — one
+    # fewer num_data-sized live buffer per chunk, and a prerequisite for
+    # keeping several pipelined chunks in flight without doubling the
+    # score footprint
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def train_chunk(score, vscores, it0, key0, ff_key0, grad_key0,
                     bins_fm, feat, base_allowed, valid_bins):
         step = functools.partial(

@@ -75,6 +75,13 @@ RULES: List[Tuple[str, str, str]] = [
     # fallback / forced events — higher is worse
     ("*fallback*", "up_is_bad", "counter"),
     ("*events.*", "up_is_bad", "counter"),
+    # pipelined dispatch: depth is a config knob (identity, not a
+    # regression axis); the device-idle-gap gauge is wall-clock — a
+    # growing gap means the overlap stopped working (the per-chunk
+    # timing series under timings.train.pipeline.idle.* is covered by
+    # the span rules below)
+    ("*pipeline.depth", "ignore", "counter"),
+    ("gauges.train.pipeline.device_idle_s", "up_is_bad", "timing"),
     # wall-clock spans — higher is worse, timing class
     ("*total_s", "up_is_bad", "timing"),
     ("*mean_s", "up_is_bad", "timing"),

@@ -41,12 +41,15 @@ from .sinks import make_event
 from .spans import TRACER
 
 #: span.<name> timing totals that make up a round's wall-clock split.
-#: train.chunk covers the grow dispatch+sync, train.grow/train.decode are
-#: the finer per-phase splits inside it, eval and compile_warmup ride
-#: beside it (hist/split phases live device-side as jax.named_scopes —
-#: visible in XProf, not in host wall-clock; see docs/OBSERVABILITY.md).
-PHASE_SPANS = ("train.chunk", "train.grow", "train.decode", "eval",
-               "compile_warmup", "predict.device", "predict.host")
+#: train.chunk covers the fused-chunk DISPATCH (async under pipelining),
+#: train.harvest the blocking readback + decode of a dispatched chunk
+#: (train.grow/train.decode are the finer per-phase splits), eval and
+#: compile_warmup ride beside them (hist/split phases live device-side as
+#: jax.named_scopes — visible in XProf, not in host wall-clock; see
+#: docs/OBSERVABILITY.md).
+PHASE_SPANS = ("train.chunk", "train.harvest", "train.grow",
+               "train.decode", "eval", "compile_warmup", "predict.device",
+               "predict.host")
 
 #: registry counters whose per-round deltas ride in each record (forced /
 #: fallback events: wave downgrades, pallas probe failures).
@@ -330,12 +333,17 @@ class FlightRecorder:
                    hist_impl: str, bundled: bool) -> Optional[Dict]:
         """The analytic throughput block folded in from
         utils/profile.py::training_report — rounds/sec is measured from
-        the recorded `span.train.chunk` totals instead of a caller-timed
-        interval, so the flight summary carries it for free."""
+        the recorded `span.train.chunk` + `span.train.harvest` totals
+        (dispatch + blocking readback/decode; the harvest half is zero on
+        the per-iteration path, whose chunk span is synchronous) instead
+        of a caller-timed interval, so the flight summary carries it for
+        free."""
         t = REGISTRY.timing("span.train.chunk")
-        if not t.count or t.total <= 0 or not self.rounds_seen:
+        h = REGISTRY.timing("span.train.harvest")
+        total = t.total + h.total
+        if not t.count or total <= 0 or not self.rounds_seen:
             return None
-        return throughput_report(self.rounds_seen, t.total, num_data,
+        return throughput_report(self.rounds_seen, total, num_data,
                                  hist_columns, num_leaves, hist_impl,
                                  bundled)
 

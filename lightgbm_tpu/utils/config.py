@@ -211,6 +211,15 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # auto (currently off), <= 1 disables
     "tpu_wave_overgrow": (-1.0, "float", ("wave_overgrow",)),
     "tpu_wave_strict_tail": (-1, "int", ("wave_strict_tail",)),
+    # pipelined chunk training (booster.py _dispatch_chunk/_harvest_chunk):
+    # max fused chunks in flight at once.  Chunk k+1's score inputs are
+    # chunk k's DEVICE-side outputs, so JAX async dispatch runs the next
+    # chunk while the host decodes/evaluates the previous one's trees.
+    # 1 = serial (dispatch then harvest, the pre-pipeline behavior);
+    # models are byte-identical at every depth (tests/test_pipeline.py) —
+    # the knob trades transient memory (each in-flight chunk holds its
+    # stacked trees + per-iteration score snapshots) for device-idle time
+    "tpu_pipeline_chunks": (2, "int", ("pipeline_chunks",)),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

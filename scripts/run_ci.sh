@@ -18,6 +18,31 @@ case "$tier" in
   *) echo "usage: $0 [quick|full]" >&2; exit 2 ;;
 esac
 
+# pipelined-dispatch smoke: a deep pipeline must reproduce the serial
+# schedule's model byte-for-byte (tree lines; the params dump records the
+# knob itself).  Fast CPU check of the dispatch/harvest split + donated
+# score carries — the full matrix lives in tests/test_pipeline.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(0)
+X = rng.randn(1500, 8)
+y = (X[:, 0] - X[:, 1] + .3 * rng.randn(1500) > 0).astype(float)
+
+
+def text(depth):
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "verbosity": -1, "tpu_pipeline_chunks": depth},
+                    lgb.Dataset(X, label=y), num_boost_round=32)
+    return "\n".join(l for l in bst.model_to_string().splitlines()
+                     if not l.startswith("[tpu_pipeline_chunks:"))
+
+
+assert text(1) == text(4), "pipelined model differs from serial"
+print("[run_ci] pipeline smoke: depth 4 == depth 1 (byte-identical)")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

@@ -76,7 +76,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default="-",
                     help="output path (default: stdout)")
-    ap.add_argument("--rounds", type=int, default=12)
+    # 32 rounds = 2 fused chunks (_BULK_CHUNK=16): enough for the
+    # chunked-eval path AND the speculative pipeline dispatch to engage,
+    # so the baseline covers train.harvest / train.pipeline.* names
+    ap.add_argument("--rounds", type=int, default=32)
     ap.add_argument("--rel-tol", type=float, default=0.25)
     ap.add_argument("--timing-rel-tol", type=float, default=1.5)
     args = ap.parse_args(argv)

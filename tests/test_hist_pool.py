@@ -66,3 +66,20 @@ class TestHistogramPool:
         assert 0 < bst._grower_spec.hist_pool_slots < 63
         mse = float(np.mean((bst.predict(X) - y) ** 2))
         assert mse < float(np.var(y))
+
+    def test_wave_downgrade_priced(self, caplog):
+        """r6 decision note (COVERAGE.md): a bounded pool keeps the
+        strict grower under tree_grow_policy=wave, and the warning
+        prices BOTH directions — what the fallback costs and what
+        dropping the pool restores."""
+        import logging
+        X, y = make_data(1500)
+        with caplog.at_level(logging.WARNING, logger="lightgbm_tpu"):
+            bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                             "verbosity": 1, "tree_grow_policy": "wave",
+                             "histogram_pool_size": 0.02},
+                            lgb.Dataset(X, label=y), num_boost_round=3)
+        assert bst._grow_policy == "leafwise"
+        assert bst._grower_spec.hist_pool_slots > 0
+        assert "lower training throughput" in caplog.text, caplog.text
+        assert "COVERAGE.md r6" in caplog.text, caplog.text

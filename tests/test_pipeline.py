@@ -1,0 +1,221 @@
+"""Pipelined chunk training must be a pure SCHEDULE change.
+
+`tpu_pipeline_chunks` moves when chunks are dispatched and harvested
+(booster._dispatch_chunk / _harvest_chunk), never what they compute: the
+model text must be byte-identical at every depth, across growth policies
+and boosting modes, and early stopping must pick the same best_iteration
+under speculative dispatch (the overshot in-flight chunk is decoded and
+rolled back by the same machinery that handles within-chunk overshoot).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+
+DEPTHS = (1, 2, 4)
+
+
+def make_data(n=3000, f=8, seed=7, classes=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f)
+    s = X[:, 0] - 0.7 * X[:, 1] + 0.5 * np.sin(2 * X[:, 2]) \
+        + 0.6 * rng.randn(n)
+    if classes:
+        edges = np.quantile(s, np.linspace(0, 1, classes + 1)[1:-1])
+        y = np.digitize(s, edges).astype(np.float64)
+    else:
+        y = (s > 0).astype(np.float64)
+    return X, y
+
+
+def model_text(bst):
+    """Model text with the one line that NECESSARILY differs across
+    depths removed: the parameters dump records tpu_pipeline_chunks
+    itself.  Every tree line stays byte-exact."""
+    return "\n".join(l for l in bst.model_to_string().splitlines()
+                     if not l.startswith("[tpu_pipeline_chunks:"))
+
+
+def train_at_depths(params, X, y, rounds, valid=None, cbs=None,
+                    depths=DEPTHS):
+    out = []
+    for d in depths:
+        kw = {}
+        if valid is not None:
+            kw["valid_sets"] = [lgb.Dataset(vx, label=vy)
+                                for vx, vy in valid]
+        if cbs is not None:
+            kw["callbacks"] = cbs()
+        out.append(lgb.train({**params, "tpu_pipeline_chunks": d},
+                             lgb.Dataset(X, label=y),
+                             num_boost_round=rounds, **kw))
+    return out
+
+
+class TestByteIdentity:
+    @pytest.mark.parametrize("extra", [
+        {},                                           # strict leafwise
+        {"tree_grow_policy": "wave"},                 # wave-batched
+        {"bagging_fraction": 0.7, "bagging_freq": 1,
+         "feature_fraction": 0.8},                    # RNG-stream heavy
+    ])
+    def test_no_eval_policies(self, extra):
+        X, y = make_data(2000)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "learning_rate": 0.1, "verbosity": -1, **extra}
+        # serial vs the deepest window — the intermediate depth rides in
+        # test_update_many_direct / test_multi_valid_eval_path
+        texts = [model_text(b)
+                 for b in train_at_depths(params, X, y, 32,
+                                          depths=(1, 4))]
+        assert texts[0] == texts[1]
+
+    def test_dart_unaffected(self):
+        # DART is not bulk-eligible (host-side drop/renormalize), so the
+        # pipeline knob must be inert — same per-iteration path, same
+        # model, at every depth
+        X, y = make_data(1500)
+        params = {"objective": "binary", "boosting": "dart",
+                  "num_leaves": 7, "drop_rate": 0.2, "seed": 3,
+                  "verbosity": -1}
+        texts = [model_text(b)
+                 for b in train_at_depths(params, X, y, 20)]
+        assert texts[0] == texts[1] == texts[2]
+
+    def test_multiclass(self):
+        X, y = make_data(1500, classes=3)
+        params = {"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 10, "verbosity": -1}
+        # 32 rounds = 2 chunks: a deeper window cannot schedule
+        # differently from depth 2, so two depths cover it
+        texts = [model_text(b)
+                 for b in train_at_depths(params, X, y, 32,
+                                          depths=(1, 2))]
+        assert texts[0] == texts[1]
+
+    def test_multi_valid_eval_path(self):
+        X, y = make_data(2000)
+        v1 = make_data(900, seed=11)
+        v2 = make_data(700, seed=12)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "metric": "auc", "verbosity": -1}
+        recs = []
+
+        def cbs():
+            recs.append({})
+            return [lgb.record_evaluation(recs[-1])]
+
+        boosters = train_at_depths(params, X, y, 32,
+                                   valid=[v1, v2], cbs=cbs,
+                                   depths=(1, 2))
+        texts = [model_text(b) for b in boosters]
+        assert texts[0] == texts[1]
+        # metric curves (computed from the emitted per-iter snapshots,
+        # chunk k+1 in flight) must match the serial schedule exactly
+        for rec in recs[1:]:
+            for name in ("valid_0", "valid_1"):
+                np.testing.assert_array_equal(rec[name]["auc"],
+                                              recs[0][name]["auc"])
+
+    def test_update_many_direct(self):
+        # the no-eval Booster.update_many loop is the bench's hot path —
+        # pipeline it without the engine in the way
+        X, y = make_data(1500)
+        texts = []
+        for d in DEPTHS:
+            bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                                  "verbosity": -1,
+                                  "tpu_pipeline_chunks": d},
+                          train_set=lgb.Dataset(X, label=y))
+            bst.update_many(48)
+            assert bst.current_iteration() == 48
+            assert not bst._inflight and bst._pending_iters == 0
+            texts.append(model_text(bst))
+        assert texts[0] == texts[1] == texts[2]
+
+
+class TestEarlyStopping:
+    def test_speculative_dispatch_rollback(self):
+        # num_boost_round is long enough that a speculative chunk is in
+        # flight when early stopping fires: the overshoot (rest of chunk
+        # k + all of chunk k+1) must be decoded and rolled back to the
+        # exact per-iteration stopping point
+        X, y = make_data(2500)
+        Xv, yv = make_data(900, seed=13)
+        params = {"objective": "binary", "num_leaves": 15,
+                  "metric": "auc", "learning_rate": 0.5, "verbosity": -1}
+
+        def cbs():
+            return [lgb.early_stopping(5, verbose=False)]
+
+        boosters = train_at_depths(params, X, y, 80,
+                                   valid=[(Xv, yv)], cbs=cbs)
+        b1 = boosters[0]
+        assert b1.best_iteration < 80          # actually stopped early
+        for b in boosters[1:]:
+            assert b.best_iteration == b1.best_iteration
+            assert b.current_iteration() == b1.current_iteration()
+            assert b.num_trees() == b1.num_trees()
+            assert model_text(b) == model_text(b1)
+
+    def test_no_leftover_inflight_after_stop(self):
+        X, y = make_data(2000)
+        Xv, yv = make_data(800, seed=9)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "metric": "auc", "learning_rate": 0.5,
+                         "verbosity": -1, "tpu_pipeline_chunks": 2},
+                        lgb.Dataset(X, label=y), num_boost_round=80,
+                        valid_sets=[lgb.Dataset(Xv, label=yv)],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert not bst._inflight and bst._pending_iters == 0
+
+
+class TestInstrumentation:
+    def test_harvest_span_and_idle_gauge(self):
+        telemetry.REGISTRY.reset()
+        telemetry.TRACER.enable(True)
+        try:
+            X, y = make_data(2000)
+            bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                                  "verbosity": -1,
+                                  "tpu_pipeline_chunks": 2},
+                          train_set=lgb.Dataset(X, label=y))
+            bst.update_many(48)   # 3 fused chunks
+        finally:
+            telemetry.TRACER.enable(False)
+        reg = telemetry.REGISTRY
+        assert reg.counter("train.chunks").value == 3
+        # one harvest span per chunk, dispatch-only train.chunk spans
+        assert reg.timing("span.train.harvest").count == 3
+        assert reg.timing("span.train.chunk").count == 3
+        assert reg.gauge("train.pipeline.depth").value == 2.0
+        # idle gap recorded between consecutive chunks
+        assert reg.timing("train.pipeline.idle").count == 2
+
+    def test_out_of_order_harvest_raises(self):
+        X, y = make_data(1500)
+        bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                              "verbosity": -1, "tpu_pipeline_chunks": 4},
+                      train_set=lgb.Dataset(X, label=y))
+        bst._boost_from_average()
+        spec = bst._make_bulk_spec()
+        p1 = bst._dispatch_chunk(spec)
+        p2 = bst._dispatch_chunk(spec)
+        with pytest.raises(lgb.LightGBMError):
+            bst._harvest_chunk(p2)
+        # decode order intact: harvesting in dispatch order still works
+        bst._harvest_chunk(p1)
+        bst._harvest_chunk(p2)
+        assert bst.current_iteration() == 2 * bst._BULK_CHUNK
+
+    def test_flight_round_records_depth(self):
+        X, y = make_data(1500)
+        bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                              "verbosity": -1, "flight_recorder": True,
+                              "tpu_pipeline_chunks": 2},
+                      train_set=lgb.Dataset(X, label=y))
+        bst.update_many(32)
+        recs = list(bst._flight.ring)
+        assert recs and all(r.get("pipeline_depth") == 2 for r in recs)

@@ -145,7 +145,8 @@ TPU_RECORD = {"value": 2.956, "auc": 0.8978, "n": 2_000_000,
 
 def _emit(rounds_per_sec: float, n_rows: int, backend: str,
           partial: bool, auc=None, pred=None, probe=None,
-          telemetry=None, flight=None, pipeline=None) -> None:
+          telemetry=None, flight=None, pipeline=None,
+          serving=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -190,6 +191,11 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         # chunks run, device-idle-gap estimate totals — the `telemetry
         # diff` sentinel watches the idle gauge as a timing-class metric
         line["pipeline"] = pipeline
+    if serving is not None:
+        # closed-loop serving bench (@serving line, --serve mode):
+        # per-request p50/p99 latency + rows/s through the micro-batched
+        # runtime — diff.py classes these as timing metrics
+        line["serving"] = serving
     if backend.startswith("cpu-fallback"):
         line["tpu_record"] = TPU_RECORD
     print(json.dumps(line), flush=True)
@@ -326,6 +332,10 @@ def _run_orchestrator() -> None:
              "to the CUDA anchor")
     env["BENCH_N"] = str(n)
     env["BENCH_ROUNDS"] = str(rounds)
+    if "--serve" in sys.argv:
+        # closed-loop serving bench rides after the predict bench; the
+        # flag travels by env because the worker argv is fixed
+        env["BENCH_SERVE"] = "1"
 
     worker_timeout = max(60.0, _remaining() - 20)
     _log(f"starting worker: n={n} rounds={rounds} backend={backend_tag} "
@@ -341,6 +351,7 @@ def _run_orchestrator() -> None:
     worker_telemetry = None
     worker_flight = None
     worker_pipeline = None
+    worker_serving = None
     platform = backend_tag
     deadline = time.time() + worker_timeout
     try:
@@ -410,6 +421,13 @@ def _run_orchestrator() -> None:
                             line.split(None, 1)[1])
                     except (ValueError, IndexError):
                         pass
+                elif line.startswith("@serving "):
+                    # closed-loop serving bench (p50/p99 + rows/s)
+                    try:
+                        worker_serving = json.loads(
+                            line.split(None, 1)[1])
+                    except (ValueError, IndexError):
+                        pass
     finally:
         try:
             proc.kill()
@@ -421,20 +439,23 @@ def _run_orchestrator() -> None:
     if final is not None:
         _emit(final, n, platform, partial=False, auc=auc, pred=pred,
               probe=probe_info, telemetry=worker_telemetry,
-              flight=worker_flight, pipeline=worker_pipeline)
+              flight=worker_flight, pipeline=worker_pipeline,
+              serving=worker_serving)
     elif chunks:
         tot_r = sum(c[0] for c in chunks)
         tot_s = sum(c[1] for c in chunks)
         _emit(tot_r / tot_s, n, platform, partial=True, auc=auc, pred=pred,
               probe=probe_info, telemetry=worker_telemetry,
-              flight=worker_flight, pipeline=worker_pipeline)
+              flight=worker_flight, pipeline=worker_pipeline,
+              serving=worker_serving)
     else:
         # nothing measured — still emit a parseable line (value 0) so the
         # round records an explicit failure instead of rc=124/None
         _event("worker.no_chunks", backend=platform)
         _emit(0.0, n, platform + "-failed", partial=True,
               probe=probe_info, telemetry=worker_telemetry,
-              flight=worker_flight, pipeline=worker_pipeline)
+              flight=worker_flight, pipeline=worker_pipeline,
+              serving=worker_serving)
 
 
 # --------------------------------------------------------------------------
@@ -597,6 +618,41 @@ def _run_worker() -> None:
              f"host {host_rps:,.0f} rows/s ({dev_rps / host_rps:.1f}x)")
     except Exception as e:  # pragma: no cover
         _log(f"predict bench failed: {e}")
+
+    # closed-loop serving bench (--serve): per-request latency through
+    # the full micro-batched stack (client -> batcher -> bucketed device
+    # runtime), one request in flight at a time so p50/p99 measure the
+    # serving path itself, not queueing.  Warm-up compiles every bucket
+    # first, so the percentiles are steady-state numbers
+    if os.environ.get("BENCH_SERVE"):
+        try:
+            from lightgbm_tpu.serving import ServingClient
+            batch = int(os.environ.get("BENCH_SERVE_ROWS", 256))
+            iters = int(os.environ.get("BENCH_SERVE_ITERS", 50))
+            Xs = X_eval[:batch]
+            client = ServingClient(bst, params={"serve_max_wait_ms": 0.0})
+            client.predict(Xs, raw_score=True)  # steady-state check
+            lat = []
+            t_all = time.time()
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                client.predict(Xs, raw_score=True)
+                lat.append(time.perf_counter() - t0)
+            total_s = time.time() - t_all
+            client.close()
+            lat_ms = np.sort(np.asarray(lat)) * 1e3
+            blk = {"rows_per_request": batch, "requests": iters,
+                   "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+                   "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+                   "rows_per_sec": round(batch * iters / total_s, 1)}
+            print("@serving " + json.dumps(blk, separators=(",", ":")),
+                  flush=True)
+            _log(f"serving bench: p50 {blk['p50_ms']} ms, "
+                 f"p99 {blk['p99_ms']} ms, "
+                 f"{blk['rows_per_sec']:,.0f} rows/s "
+                 f"({batch} rows x {iters} requests)")
+        except Exception as e:  # pragma: no cover
+            _log(f"serving bench failed: {e}")
     _stream_telemetry()
     _stream_flight(bst)
     telemetry.TRACER.flush()

@@ -1258,6 +1258,7 @@ class Booster:
             if it == 0 and abs(self._init_scores[k]) > 1e-35:
                 tree.add_bias(self._init_scores[k])
             self.trees.append(tree)
+            self._bump_model_version()
             if round_trees is not None:
                 round_trees.append(telemetry.tree_stats(tree))
         if round_trees is not None:
@@ -1414,6 +1415,11 @@ class Booster:
                         self._valid_scores[vi], tree, vdd, k, bias)
         del self.trees[-K:]
         self.cur_iter -= 1
+        # the freed Tree objects' ids can be handed to the very next
+        # grown tree, so identity-keyed prediction caches (native /
+        # device / serving export) could alias a stale model — the
+        # version bump makes their keys miss (tests/test_serving.py)
+        self._bump_model_version()
         return self
 
     def refit(self, data, label, decay_rate: float = 0.9,
@@ -1498,6 +1504,10 @@ class Booster:
                     score = score + contrib
                 else:
                     score[:, k] += contrib
+        # the loop rewrote leaf_value IN PLACE on new_bst's trees —
+        # their ids never changed, so any prediction/serving cache the
+        # refit walk populated must be dropped (and the version bumped)
+        new_bst._invalidate_pred_caches()
         return new_bst
 
     # ------------------------------------------------- fused bulk training
@@ -1773,6 +1783,7 @@ class Booster:
                 if self.cur_iter == 0 and abs(self._init_scores[k]) > 1e-35:
                     tree.add_bias(self._init_scores[k])
                 self.trees.append(tree)
+                self._bump_model_version()
                 if round_trees is not None:
                     round_trees.append(telemetry.tree_stats(tree))
             if round_trees is not None:
@@ -2196,15 +2207,19 @@ class Booster:
             out["cat_nwords"] = jnp.asarray(cat_nwords)
         return out
 
-    @staticmethod
-    def _tree_slice_key(trees: List[Tree]):
+    def _tree_slice_key(self, trees: List[Tree]):
         """Cache key pinning the RESOLVED tree slice by object identity
         (first id + length determines a contiguous slice; a replaced
         model — model_from_string, refit — allocates new Tree objects,
-        so stale hits are impossible even when counts coincide).
+        so stale hits are impossible even when counts coincide) AND by
+        the model-mutation version: `rollback_one_iter` frees Tree
+        objects whose ids the allocator can hand to the very next grown
+        tree, so identity alone could alias a stale cache after a
+        rollback + regrow of equal length (tests/test_serving.py).
         In-place mutations that keep identities must still call
-        `_invalidate_pred_caches`."""
-        return (len(trees), id(trees[0]), id(trees[-1]))
+        `_invalidate_pred_caches` (which bumps the version)."""
+        return (getattr(self, "_model_version", 0), len(trees),
+                id(trees[0]), id(trees[-1]))
 
     def _flatten_for_native(self, trees: List[Tree]):
         """Per-tree-concatenated contiguous model arrays for the native
@@ -2274,6 +2289,47 @@ class Booster:
             X32 = np.asarray(X, dtype=np.float32)
         out = self._pred_dev_jit(arrays, jnp.asarray(X32))
         return np.asarray(jax.device_get(out), dtype=np.float64)
+
+    def export_predict_arrays(self, start_iteration: int = 0,
+                              num_iteration: Optional[int] = None) -> Dict:
+        """One-shot model export for the serving runtime
+        (serving/runtime.py): the stacked device traversal arrays (leaf-
+        index space, `ops.predict.predict_leaf_ensemble`) plus the exact
+        f64 per-tree leaf-value table for the host-side gather/sum.
+        Cached per resolved tree slice; the key folds in
+        `_model_version`, so `rollback_one_iter` / `refit` / continued
+        training / `set_leaf_output` all invalidate it
+        (tests/test_serving.py pins this).
+
+        Returns a dict:
+          stacked        — device arrays for predict_leaf_ensemble, or
+                           None (linear trees: host-walk only)
+          leaf_values    — [T, NL] f64 leaf outputs, tree-padded
+          trees          — the resolved host Tree slice (fallback walk)
+          num_class      — trees per iteration (K)
+          average_factor — RF averaging divisor (1 = plain sum)
+          version        — `_model_version` at export time
+        """
+        trees = self._slice_trees(start_iteration, num_iteration)
+        ck = self._tree_slice_key(trees) if trees else None
+        cached = getattr(self, "_serving_export_cache", None)
+        if ck and cached and cached[0] == ck:
+            return cached[1]
+        stacked = self._stack_for_device(trees)
+        nl = max((t.num_leaves for t in trees), default=1)
+        leaf_values = np.zeros((len(trees), nl), np.float64)
+        for i, t in enumerate(trees):
+            leaf_values[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        K = self.num_tree_per_iteration
+        avg = max(len(trees) // K, 1) \
+            if getattr(self, "_average_output", False) \
+            and len(trees) >= K else 1
+        export = {"stacked": stacked, "leaf_values": leaf_values,
+                  "trees": trees, "num_class": K, "average_factor": avg,
+                  "version": getattr(self, "_model_version", 0)}
+        if ck:
+            self._serving_export_cache = (ck, export)
+        return export
 
     def _predict_contrib(self, X: np.ndarray, trees: List[Tree]) -> np.ndarray:
         """TreeSHAP feature contributions (ref: PredictContrib → tree.cpp
@@ -2637,6 +2693,14 @@ class Booster:
         self._invalidate_pred_caches()
         return self
 
+    def _bump_model_version(self) -> None:
+        """Advance the monotonic model-mutation counter (tree append /
+        rollback / in-place value edits).  Prediction caches fold it
+        into their keys, and serving exports pin it so a
+        `ServingRuntime` can detect a stale export cheaply
+        (`export_predict_arrays` / serving/runtime.py `refresh`)."""
+        self._model_version = getattr(self, "_model_version", 0) + 1
+
     def _invalidate_pred_caches(self) -> None:
         """Drop the flattened/stacked prediction caches after any
         IN-PLACE model mutation that their keys (tree slice, tree count,
@@ -2644,6 +2708,8 @@ class Booster:
         value rescaling."""
         self._pred_native_cache = None
         self._pred_dev_cache = None
+        self._serving_export_cache = None
+        self._bump_model_version()
 
     def shuffle_models(self, start_iteration: int = 0,
                        end_iteration: int = -1) -> "Booster":

@@ -225,9 +225,16 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu telemetry diff <A.json> <B.json>"
               " [--warn-timings]\n"
               "       python -m lightgbm_tpu lint [--format json|text]"
-              " [--update-baseline]",
+              " [--update-baseline]\n"
+              "       python -m lightgbm_tpu serve model=<model_file>"
+              " [serve_port=...]",
               file=sys.stderr)
         return 0
+    if argv[0] == "serve":
+        # prediction-serving HTTP frontend (serving/http.py): stdlib
+        # server over the micro-batched device runtime
+        from .serving.http import main as serve_main
+        return serve_main(argv[1:])
     if argv[0] == "telemetry-report":
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main

@@ -111,16 +111,10 @@ def replay_leaf_ids(tree, bins_fm: Array, feat_nb: Array,
     return lid
 
 
-@contract(node_feat="[NI] int", node_thr="[NI] float",
-          node_dtype="[NI] int", node_left="[NI] int",
-          node_right="[NI] int", leaf_value="[NL] float",
-          X="[N, F] float", cat_words="[NI, MW] uint?",
-          cat_nwords="[NI] int?", ret="[N] float")
-def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
-                 node_left: Array, node_right: Array, leaf_value: Array,
-                 X: Array, cat_words: Array = None,
-                 cat_nwords: Array = None) -> Array:
-    """Raw-value traversal of ONE tree over a batch (jitted bench path).
+def _leaf_slots(node_feat: Array, node_thr: Array, node_dtype: Array,
+                node_left: Array, node_right: Array, X: Array,
+                cat_words: Array = None, cat_nwords: Array = None) -> Array:
+    """[N] i32 leaf slots of ONE tree — the shared row-routing core.
 
     Decision semantics mirror tree.h `Tree::NumericalDecision` /
     `Tree::CategoricalDecision`: NaN with missing_type!=NaN → 0.0;
@@ -129,6 +123,11 @@ def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
     [NI, MW] (per-node word count `cat_nwords` [NI]), with the same
     double-space range guard as the host walks — NaN / out-of-span /
     v <= -1 route right.  Category indices are exact in f32 (< 2^24).
+
+    Per-row while_loop under vmap, so rows are independent: a padded
+    batch's real-row slots are bitwise identical to the unpadded
+    batch's (the serving runtime's bucket-padding correctness rests on
+    exactly this property — tests/test_serving.py).
     """
     has_cat = cat_words is not None
 
@@ -160,9 +159,29 @@ def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
             return jnp.where(go_left, node_left[nd], node_right[nd])
 
         nd = jax.lax.while_loop(cond, body, jnp.int32(0))
-        return leaf_value[~nd]
+        return ~nd
 
     return jax.vmap(row_fn)(X)
+
+
+@contract(node_feat="[NI] int", node_thr="[NI] float",
+          node_dtype="[NI] int", node_left="[NI] int",
+          node_right="[NI] int", leaf_value="[NL] float",
+          X="[N, F] float", cat_words="[NI, MW] uint?",
+          cat_nwords="[NI] int?", ret="[N] float")
+def traverse_raw(node_feat: Array, node_thr: Array, node_dtype: Array,
+                 node_left: Array, node_right: Array, leaf_value: Array,
+                 X: Array, cat_words: Array = None,
+                 cat_nwords: Array = None) -> Array:
+    """Raw-value traversal of ONE tree over a batch (jitted bench path).
+
+    Routing semantics live in `_leaf_slots` (shared with the serving
+    leaf-index path); this entry point just gathers the leaf values.
+    """
+    return leaf_value[_leaf_slots(node_feat, node_thr, node_dtype,
+                                  node_left, node_right, X,
+                                  cat_words=cat_words,
+                                  cat_nwords=cat_nwords)]
 
 
 @contract(stacked="tree", X="[N, F] float", ret="[N] f32")
@@ -187,3 +206,27 @@ def predict_raw_ensemble(stacked, X: Array) -> Array:
         init = jnp.zeros((X.shape[0],), dtype=jnp.float32)
         total, _ = jax.lax.scan(step, init, stacked)
         return total
+
+
+@contract(stacked="tree", X="[N, F] float", ret="[T, N] i32")
+def predict_leaf_ensemble(stacked, X: Array) -> Array:
+    """Per-tree leaf slots over padded stacked tree arrays (serving path).
+
+    Same lax.scan shape as `predict_raw_ensemble` but the device returns
+    ONLY [T, N] i32 leaf slots — no on-device value accumulation.  The
+    serving runtime (serving/runtime.py) gathers each tree's f64 leaf
+    value on host and sums in tree order, reproducing the host walk's
+    exact f64 summation (byte-identical to `booster.predict`, multiclass
+    included) while the traversal itself runs as one batched device
+    program per padding bucket.
+    """
+    def step(carry, tree):
+        slots = _leaf_slots(tree["feat"], tree["thr"], tree["dtype"],
+                            tree["left"], tree["right"], X,
+                            cat_words=tree.get("cat_words"),
+                            cat_nwords=tree.get("cat_nwords"))
+        return carry, slots
+
+    with jax.named_scope("predict_leaf_ensemble"):
+        out = jax.lax.scan(step, (), stacked)[1]
+        return out

@@ -1,0 +1,29 @@
+"""TPU-resident prediction serving (PR 5 tentpole).
+
+Four layers (docs/SERVING.md):
+
+  runtime.py  — `ServingRuntime`: one-shot booster export into stacked
+                device arrays; requests padded to power-of-two row
+                buckets so compiles are bounded by the bucket count;
+                responses byte-identical to `booster.predict` (device
+                leaf slots + exact host f64 gather/sum).
+  batcher.py  — `MicroBatcher`: bounded queue, max-rows/max-wait flush,
+                deadline-based load shedding, host-walk fallback on
+                device errors.
+  registry.py — `ModelRegistry`: multi-model, warm-up-on-load, atomic
+                hot-swap.
+  client.py / http.py — frontends: in-process `ServingClient` and the
+                stdlib HTTP endpoint (`python -m lightgbm_tpu serve`)
+                with /predict, /healthz, /metrics.
+"""
+from .batcher import (MicroBatcher, ServingClosedError,
+                      ServingOverloadError)
+from .client import ServingClient
+from .registry import ModelRegistry, ServingModel
+from .runtime import DEFAULT_MAX_BATCH_ROWS, ServingRuntime, bucket_rows
+
+__all__ = [
+    "DEFAULT_MAX_BATCH_ROWS", "MicroBatcher", "ModelRegistry",
+    "ServingClient", "ServingClosedError", "ServingModel",
+    "ServingOverloadError", "ServingRuntime", "bucket_rows",
+]

@@ -1,0 +1,203 @@
+"""Dynamic micro-batcher: bounded queue, rows/deadline flush, shedding.
+
+Concurrent callers submit requests into a bounded queue; one worker
+thread coalesces them into batches — flushing when the open batch
+reaches `max_batch_rows` or has waited `max_wait_ms` — and runs each
+batch through the `ServingRuntime` once.  Under overload the batcher
+sheds instead of queueing unboundedly: a full queue rejects at submit
+time, and requests whose deadline passed while queued are dropped at
+flush time (both raise `ServingOverloadError`, both counted under
+`serve.shed`).  Device failures inside the runtime degrade to the host
+walk there (`serve.fallbacks`), so a wedged accelerator slows serving
+rather than erroring it — the probe-wedge lesson from bench.py.
+
+Batches coalesce only compatible requests (same raw/prob flavor, same
+feature width); a flush holding both flavors simply runs the runtime
+once per group.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..utils.log import LightGBMError
+
+
+class ServingOverloadError(LightGBMError):
+    """Request shed: queue full at submit, or deadline passed in queue."""
+
+
+class ServingClosedError(LightGBMError):
+    """The batcher was closed while the request was queued."""
+
+
+class _Request:
+    __slots__ = ("X", "raw", "n", "enqueued", "deadline", "done",
+                 "result", "error")
+
+    def __init__(self, X: np.ndarray, raw: bool,
+                 deadline: Optional[float]):
+        self.X = X
+        self.raw = raw
+        self.n = X.shape[0]
+        self.enqueued = time.monotonic()
+        self.deadline = deadline        # absolute monotonic time, or None
+        self.done = threading.Event()
+        self.result: Optional[np.ndarray] = None
+        self.error: Optional[BaseException] = None
+
+    def wait(self, timeout: Optional[float] = None) -> np.ndarray:
+        if not self.done.wait(timeout):
+            raise ServingOverloadError("serving request timed out waiting "
+                                       "for a batch slot")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class MicroBatcher:
+    """Coalesces concurrent predict calls into bucket-padded batches."""
+
+    def __init__(self, runtime, *, max_batch_rows: Optional[int] = None,
+                 max_wait_ms: float = 2.0, queue_depth: int = 256,
+                 deadline_ms: float = 0.0):
+        self.runtime = runtime
+        self.max_batch_rows = int(max_batch_rows or runtime.max_batch_rows)
+        self.max_wait_s = max(float(max_wait_ms), 0.0) / 1000.0
+        self.deadline_s = max(float(deadline_ms), 0.0) / 1000.0
+        self._q: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=max(int(queue_depth), 1))
+        self._closed = False
+        self._worker = threading.Thread(
+            target=self._loop, name=f"lgbm-serve-{runtime.name}",
+            daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------ submit
+    def submit(self, X, raw_score: bool = False) -> _Request:
+        """Enqueue one request; returns a waitable handle.  A full
+        queue sheds immediately (bounded memory under overload)."""
+        if self._closed:
+            raise ServingClosedError("batcher is closed")
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        deadline = (time.monotonic() + self.deadline_s) \
+            if self.deadline_s > 0 else None
+        req = _Request(X, bool(raw_score), deadline)
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            telemetry.REGISTRY.counter("serve.shed").inc()
+            raise ServingOverloadError(
+                f"serving queue full ({self._q.maxsize} requests)")
+        telemetry.REGISTRY.counter("serve.requests").inc()
+        telemetry.REGISTRY.gauge("serve.queue_depth").set(self._q.qsize())
+        return req
+
+    def predict(self, X, raw_score: bool = False,
+                timeout: Optional[float] = None) -> np.ndarray:
+        """Synchronous submit-and-wait."""
+        return self.submit(X, raw_score=raw_score).wait(timeout)
+
+    # ------------------------------------------------------------- worker
+    def _loop(self) -> None:
+        while True:
+            try:
+                first = self._q.get(timeout=0.05)
+            except queue.Empty:
+                if self._closed:
+                    return
+                continue
+            batch = [first]
+            rows = first.n
+            t0 = time.monotonic()
+            while rows < self.max_batch_rows:
+                remaining = self.max_wait_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                batch.append(nxt)
+                rows += nxt.n
+            telemetry.REGISTRY.gauge("serve.queue_depth").set(
+                self._q.qsize())
+            self._flush(batch)
+
+    def _flush(self, batch: List[_Request]) -> None:
+        telemetry.REGISTRY.gauge("serve.in_flight").set(len(batch))
+        now = time.monotonic()
+        live: List[_Request] = []
+        for req in batch:
+            if self._closed:
+                req.error = ServingClosedError("batcher closed")
+                req.done.set()
+            elif req.deadline is not None and now > req.deadline:
+                # deadline-based load shedding: the caller has given up
+                # (or will) — don't burn device time on a dead request
+                telemetry.REGISTRY.counter("serve.shed").inc()
+                req.error = ServingOverloadError(
+                    "request deadline exceeded while queued")
+                req.done.set()
+            else:
+                live.append(req)
+        if not live:
+            telemetry.REGISTRY.gauge("serve.in_flight").set(0)
+            return
+        groups = {}
+        for req in live:
+            groups.setdefault((req.raw, req.X.shape[1]), []).append(req)
+        with telemetry.span("serve.batch", requests=len(live),
+                            rows=sum(r.n for r in live),
+                            groups=len(groups)):
+            for (raw, _w), reqs in groups.items():
+                self._run_group(reqs, raw)
+        telemetry.REGISTRY.counter("serve.batches").inc()
+        telemetry.REGISTRY.gauge("serve.in_flight").set(0)
+
+    def _run_group(self, reqs: List[_Request], raw: bool) -> None:
+        try:
+            X = reqs[0].X if len(reqs) == 1 \
+                else np.concatenate([r.X for r in reqs], axis=0)
+            out = self.runtime.predict(X, raw_score=raw)
+            lo = 0
+            done_t = time.monotonic()
+            for r in reqs:
+                r.result = out[lo:lo + r.n]
+                lo += r.n
+                telemetry.REGISTRY.timing("serve.latency").observe(
+                    done_t - r.enqueued)
+                r.done.set()
+        except BaseException as e:
+            for r in reqs:
+                if not r.done.is_set():
+                    r.error = e
+                    r.done.set()
+
+    # -------------------------------------------------------------- close
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the worker and fail any still-queued request."""
+        if self._closed:
+            return
+        self._closed = True
+        self._worker.join(timeout)
+        while True:
+            try:
+                req = self._q.get_nowait()
+            except queue.Empty:
+                break
+            req.error = ServingClosedError("batcher closed")
+            req.done.set()
+
+    def __enter__(self) -> "MicroBatcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

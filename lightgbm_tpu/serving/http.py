@@ -1,0 +1,163 @@
+"""stdlib HTTP frontend: `python -m lightgbm_tpu serve model=...`.
+
+Endpoints (JSON in/out, no dependencies beyond http.server):
+
+  POST /predict   {"rows": [[...], ...], "model": "default",
+                   "raw_score": false}
+                  -> {"model", "rows", "predictions"}
+                  Predictions ride as JSON numbers; Python float repr
+                  is shortest-roundtrip, so the f64 values parse back
+                  bit-exact — byte-identity with `booster.predict`
+                  survives the wire (scripts/run_ci.sh smoke asserts
+                  this end to end).
+  GET  /healthz   -> {"status": "ok", "models": [...]} (503 when no
+                  model is loaded)
+  GET  /metrics   -> Prometheus text exposition of the process
+                  MetricsRegistry (serve.* counters/gauges/timings
+                  next to the training metrics)
+
+Overload maps to HTTP 503 (`ServingOverloadError` — shed or queue
+full), malformed bodies to 400, unknown models to 404.
+"""
+from __future__ import annotations
+
+import json
+import sys
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List
+
+import numpy as np
+
+from .. import telemetry
+from ..utils import log
+from ..utils.config import Config
+from ..utils.log import LightGBMError
+from .batcher import ServingOverloadError
+from .client import ServingClient
+
+
+class ServingHTTPHandler(BaseHTTPRequestHandler):
+    """One handler class per server (see `make_server`): the bound
+    `client` rides as a class attribute so the stdlib's
+    handler-per-request instantiation needs no closure plumbing."""
+
+    client: ServingClient = None  # bound by make_server
+    server_version = "lightgbm-tpu-serve/1.0"
+    protocol_version = "HTTP/1.1"
+
+    # stdlib default logs every request to stderr unconditionally —
+    # route through the library logger (verbosity-gated) instead
+    def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
+        log.debug(f"[serve] {self.address_string()} {fmt % args}")
+
+    def _send_json(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, code: int, text: str,
+                   ctype: str = "text/plain; version=0.0.4") -> None:
+        body = text.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # --------------------------------------------------------------- GET
+    def do_GET(self) -> None:  # noqa: N802 (stdlib name)
+        telemetry.REGISTRY.counter("serve.http.requests").inc()
+        if self.path == "/healthz":
+            models = self.client.models()
+            self._send_json(200 if models else 503,
+                            {"status": "ok" if models else "no_models",
+                             "models": models})
+        elif self.path == "/metrics":
+            self._send_text(200, telemetry.REGISTRY.to_prometheus())
+        else:
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+
+    # -------------------------------------------------------------- POST
+    def do_POST(self) -> None:  # noqa: N802 (stdlib name)
+        telemetry.REGISTRY.counter("serve.http.requests").inc()
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"unknown path {self.path}"})
+            return
+        with telemetry.span("serve.http.predict"):
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                rows = body["rows"]
+                X = np.asarray(rows, dtype=np.float64)
+                if X.ndim == 1:
+                    X = X.reshape(1, -1)
+                if X.ndim != 2 or X.shape[0] == 0:
+                    raise ValueError("rows must be a non-empty 2-D "
+                                     "number array")
+            except (KeyError, ValueError, TypeError) as e:
+                telemetry.REGISTRY.counter("serve.http.bad_requests").inc()
+                self._send_json(400, {"error": f"bad request: {e}"})
+                return
+            model = str(body.get("model", "default"))
+            raw = bool(body.get("raw_score", False))
+            try:
+                preds = self.client.predict(X, model=model, raw_score=raw)
+            except ServingOverloadError as e:
+                self._send_json(503, {"error": str(e)})
+                return
+            except LightGBMError as e:
+                # unknown model name (or model-shape errors): caller bug
+                self._send_json(404, {"error": str(e)})
+                return
+            except Exception as e:
+                telemetry.REGISTRY.counter("serve.http.errors").inc()
+                self._send_json(500, {"error": str(e)[:500]})
+                return
+            self._send_json(200, {"model": model,
+                                  "rows": int(X.shape[0]),
+                                  "predictions": np.asarray(preds).tolist()})
+
+
+def make_server(client: ServingClient, host: str = "127.0.0.1",
+                port: int = 8080) -> ThreadingHTTPServer:
+    """Threaded HTTP server bound to `client` (port 0 = ephemeral —
+    read the real one from `server.server_address`; tests and the CI
+    smoke drive it from a background thread and call `shutdown()`)."""
+    handler = type("BoundServingHTTPHandler", (ServingHTTPHandler,),
+                   {"client": client})
+    return ThreadingHTTPServer((host, port), handler)
+
+
+def main(argv: List[str]) -> int:
+    """`python -m lightgbm_tpu serve model=<file> [name=default]
+    [serve_host=...] [serve_port=...] [serving params ...]`"""
+    from ..cli import parse_args
+    params = parse_args(argv)
+    model_path = params.pop("model", "") or params.get("input_model", "")
+    name = params.pop("name", "default")
+    if not model_path:
+        print("usage: python -m lightgbm_tpu serve model=<model_file> "
+              "[name=default] [serve_host=...] [serve_port=...] "
+              "[serve_max_batch_rows=...] [serve_max_wait_ms=...] "
+              "[serve_queue_depth=...]", file=sys.stderr)
+        return 2
+    config = Config(params)
+    client = ServingClient(model_path, params=params, name=name)
+    # loading the model restored its embedded params — training-time
+    # verbosity=-1 must not mute the serve CLI's own announce line
+    log.set_verbosity(config.verbosity)
+    server = make_server(client, config.serve_host, config.serve_port)
+    host, port = server.server_address[:2]
+    log.info(f"serving {name!r} from {model_path} on "
+             f"http://{host}:{port} (/predict /healthz /metrics)")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        log.info("shutting down")
+    finally:
+        server.server_close()
+        client.close()
+    return 0

@@ -1,0 +1,118 @@
+"""Multi-model registry: warm-up-on-load, atomic hot-swap.
+
+`load()` builds the full serving stack for a model — export, optional
+all-bucket warm-up, micro-batcher — **before** the name becomes
+visible, then swaps it in under the registry lock.  A hot-swap
+therefore never serves a cold model: readers resolve either the whole
+old entry or the whole new one, and the old entry's batcher is closed
+only after the swap (in-flight requests on it complete).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Union
+
+from .. import telemetry
+from ..utils.config import Config
+from ..utils.log import LightGBMError
+from .batcher import MicroBatcher
+from .runtime import ServingRuntime
+
+
+class ServingModel:
+    """One registered model: its runtime + micro-batcher."""
+
+    def __init__(self, name: str, runtime: ServingRuntime,
+                 batcher: MicroBatcher):
+        self.name = name
+        self.runtime = runtime
+        self.batcher = batcher
+
+    def predict(self, X, raw_score: bool = False,
+                timeout: Optional[float] = None):
+        return self.batcher.predict(X, raw_score=raw_score,
+                                    timeout=timeout)
+
+    def close(self) -> None:
+        self.batcher.close()
+
+
+class ModelRegistry:
+    """Thread-safe name -> ServingModel map (serving/ tentpole layer 3).
+
+    `params` takes the serving knobs (`serve_max_batch_rows`,
+    `serve_max_wait_ms`, `serve_queue_depth`, `serve_deadline_ms`,
+    `serve_warmup` — aliases resolve through utils/config.py like every
+    other param).
+    """
+
+    def __init__(self, params: Optional[dict] = None):
+        self._config = Config(dict(params or {}))
+        self._lock = threading.Lock()
+        self._models: Dict[str, ServingModel] = {}
+
+    # -------------------------------------------------------------- load
+    def load(self, name: str, model: Union[str, object], *,
+             warmup: Optional[bool] = None) -> ServingModel:
+        """Register `model` (a Booster or a model-file path) under
+        `name`, warmed up, replacing any previous holder atomically."""
+        from ..booster import Booster
+        booster = model if isinstance(model, Booster) \
+            else Booster(model_file=str(model))
+        cfg = self._config
+        with telemetry.span("serve.load", model=name):
+            runtime = ServingRuntime(
+                booster, max_batch_rows=cfg.serve_max_batch_rows,
+                name=name)
+            if cfg.serve_warmup if warmup is None else warmup:
+                runtime.warmup()
+            batcher = MicroBatcher(
+                runtime, max_batch_rows=cfg.serve_max_batch_rows,
+                max_wait_ms=cfg.serve_max_wait_ms,
+                queue_depth=cfg.serve_queue_depth,
+                deadline_ms=cfg.serve_deadline_ms)
+            entry = ServingModel(name, runtime, batcher)
+        with self._lock:
+            old = self._models.get(name)
+            self._models[name] = entry
+            telemetry.REGISTRY.gauge("serve.models").set(
+                len(self._models))
+        telemetry.REGISTRY.counter("serve.model_loads").inc()
+        if old is not None:
+            old.close()
+        return entry
+
+    def unload(self, name: str) -> None:
+        with self._lock:
+            entry = self._models.pop(name, None)
+            telemetry.REGISTRY.gauge("serve.models").set(
+                len(self._models))
+        if entry is not None:
+            entry.close()
+
+    # ------------------------------------------------------------ lookup
+    def get(self, name: str = "default") -> ServingModel:
+        with self._lock:
+            entry = self._models.get(name)
+        if entry is None:
+            raise LightGBMError(f"no model {name!r} loaded "
+                                f"(loaded: {self.names() or 'none'})")
+        return entry
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def predict(self, X, model: str = "default", raw_score: bool = False,
+                timeout: Optional[float] = None):
+        return self.get(model).predict(X, raw_score=raw_score,
+                                       timeout=timeout)
+
+    # ------------------------------------------------------------- close
+    def close(self) -> None:
+        with self._lock:
+            entries = list(self._models.values())
+            self._models.clear()
+            telemetry.REGISTRY.gauge("serve.models").set(0)
+        for e in entries:
+            e.close()

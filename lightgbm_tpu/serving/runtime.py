@@ -1,0 +1,237 @@
+"""Device-resident serving runtime: bucketed jit programs + exact host sum.
+
+The booster exports once (`Booster.export_predict_arrays`) into stacked
+traversal arrays; every request is padded to a power-of-two row bucket,
+so the ONE module-level jitted program compiles at most once per bucket
+— total compiles are bounded by the bucket count (log2(cap)+1) no
+matter how ragged the request-size distribution is.  The bound is
+asserted through the PR 3 `jax.monitoring` recompile listener in
+tests/test_serving.py.
+
+Byte-identity with `booster.predict`: the device program
+(`ops.predict.predict_leaf_ensemble`) returns per-tree LEAF SLOTS only.
+Leaf values are gathered on host from the export's f64 table and
+accumulated tree-by-tree in boosting order — the same f64 summation the
+host walk performs — then passed through the identical
+`objective_.convert_output` expression.  Rows are independent under the
+per-row `while_loop` traversal, so a padded batch's real-row slots are
+bitwise equal to the unpadded batch's.
+
+f32 routing caveat (same as `booster._predict_raw_device`): features
+and thresholds are cast to f32 on device, so a row lying within f32
+epsilon of a split threshold can route differently from the f64 host
+walk.  Thresholds are bin-edge midpoints, so real data essentially
+never sits there; the host fallback walk remains the exact-f64
+reference path and is used automatically when the device program
+errors or the model cannot be stacked (linear trees).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+from ..ops.predict import predict_leaf_ensemble
+
+#: padding cap (and the micro-batcher's default flush threshold): with
+#: power-of-two buckets this caps the compile count at log2(4096)+1 = 13
+DEFAULT_MAX_BATCH_ROWS = 4096
+
+# ONE process-wide jitted program: its shape-keyed compile cache IS the
+# bucket bound.  A per-runtime `jax.jit` would re-own the cache per
+# model load and re-trip graft-lint R002's factory-per-call trap.
+_LEAF_JIT = jax.jit(predict_leaf_ensemble)
+
+
+def bucket_rows(n: int, max_rows: int = DEFAULT_MAX_BATCH_ROWS) -> int:
+    """Smallest power of two >= n, clamped to [1, max_rows].
+
+    Requests larger than `max_rows` are chunked by the caller, so every
+    device shape the runtime ever presents is one of the
+    log2(max_rows)+1 bucket sizes.
+    """
+    if n <= 1:
+        return 1
+    return min(1 << int(n - 1).bit_length(), max_rows)
+
+
+class ServingRuntime:
+    """Serves one exported model through bucket-padded device programs.
+
+    Thread-safe: `predict` snapshots the export once per call, and
+    `refresh` swaps it atomically — concurrent requests either see the
+    whole old model or the whole new one, never a mix.
+    """
+
+    def __init__(self, booster, *,
+                 max_batch_rows: int = DEFAULT_MAX_BATCH_ROWS,
+                 start_iteration: int = 0,
+                 num_iteration: Optional[int] = None,
+                 name: str = "default"):
+        self._booster = booster
+        self.name = name
+        self.max_batch_rows = max(int(max_batch_rows), 1)
+        self._start = start_iteration
+        self._num = num_iteration
+        self._refresh_lock = threading.Lock()
+        self._export: Dict = {}
+        self.refresh()
+
+    # ------------------------------------------------------------ export
+    def refresh(self) -> None:
+        """(Re-)export the booster — picks up continued training,
+        `rollback_one_iter`, and `refit`-style in-place mutations (the
+        export cache is `_model_version`-keyed, so an unchanged model
+        costs one dict lookup)."""
+        with self._refresh_lock:
+            self._export = self._booster.export_predict_arrays(
+                self._start, self._num)
+
+    def stale(self) -> bool:
+        """Has the booster mutated since the last refresh()?"""
+        return self._export["version"] != getattr(
+            self._booster, "_model_version", 0)
+
+    @property
+    def num_class(self) -> int:
+        return self._export["num_class"]
+
+    def num_feature(self) -> int:
+        return int(self._booster.num_feature())
+
+    def buckets(self) -> List[int]:
+        """Every padding bucket this runtime can present to the device."""
+        out = []
+        b = 1
+        while b < self.max_batch_rows:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_batch_rows)
+        return out
+
+    def warmup(self) -> int:
+        """Compile every padding bucket up front (warm-up-on-load), so
+        no live request ever pays a device compile.  Uses the model's
+        full feature width — the jit cache is keyed on [bucket, F], so
+        warming a narrower matrix would not count.  Returns the number
+        of buckets warmed (0 when the model is host-walk only)."""
+        ex = self._export
+        if ex["stacked"] is None or not ex["trees"]:
+            return 0
+        nf = max(self.num_feature(), int(ex["stacked"]["min_features"]))
+        sizes = self.buckets()
+        with telemetry.span("serve.warmup", model=self.name,
+                            buckets=len(sizes)):
+            t0 = time.perf_counter()
+            for b in sizes:
+                self._device_slots_chunk(np.zeros((b, nf), np.float64),
+                                         ex["stacked"])
+            telemetry.REGISTRY.timing("serve.warmup").observe(
+                time.perf_counter() - t0)
+        return len(sizes)
+
+    # ----------------------------------------------------------- predict
+    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+        """Bucket-padded device prediction, byte-identical to
+        `booster.predict(X, raw_score=...)` (device errors fall back to
+        the host walk transparently)."""
+        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        n = X.shape[0]
+        ex = self._export
+        with telemetry.span("serve.predict", model=self.name, rows=n):
+            t0 = time.perf_counter()
+            raw = self._raw(X, ex)
+            out = raw if raw_score or self._booster.objective_ is None \
+                else self._convert(raw)
+            telemetry.REGISTRY.timing("serve.predict").observe(
+                time.perf_counter() - t0)
+        telemetry.REGISTRY.counter("serve.rows").inc(n)
+        return out
+
+    def _raw(self, X: np.ndarray, ex: Dict) -> np.ndarray:
+        """Exact f64 raw scores: device leaf slots (bucketed) + host
+        gather/sum in tree order — the host walk's summation, verbatim."""
+        trees = ex["trees"]
+        K = ex["num_class"]
+        n = X.shape[0]
+        raw = np.zeros((n, K), np.float64)
+        slots = self._device_slots(X, ex) if trees else None
+        if trees and slots is None:
+            # host fallback (tree.py walk, exact f64) — device error,
+            # linear trees, or an X too narrow for the stacked arrays
+            telemetry.REGISTRY.counter("serve.fallbacks").inc()
+            with telemetry.span("serve.fallback", model=self.name,
+                                rows=n):
+                for i, t in enumerate(trees):
+                    raw[:, i % K] += t.predict(X)
+        elif trees:
+            leaf_values = ex["leaf_values"]
+            for i in range(len(trees)):
+                raw[:, i % K] += leaf_values[i, slots[i]]
+        if ex["average_factor"] != 1:
+            raw /= ex["average_factor"]
+        if K == 1:
+            raw = raw[:, 0]
+        return raw
+
+    def _device_slots(self, X: np.ndarray,
+                      ex: Dict) -> Optional[np.ndarray]:
+        """[T, N] i32 leaf slots via the bucketed device program, or
+        None when the host walk must take over."""
+        stacked = ex["stacked"]
+        if stacked is None or X.shape[1] < stacked["min_features"] \
+                or X.shape[0] == 0:
+            return None
+        try:
+            outs = [self._device_slots_chunk(
+                        X[lo:lo + self.max_batch_rows], stacked)
+                    for lo in range(0, X.shape[0], self.max_batch_rows)]
+        except Exception as e:
+            # probe-wedge lesson: a dead/wedged device must degrade, not
+            # 500 — count it and serve from the host walk
+            telemetry.REGISTRY.counter("serve.device_errors").inc()
+            telemetry.event("serve.device_error", model=self.name,
+                            error=str(e)[:200])
+            return None
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=1)
+
+    def _device_slots_chunk(self, Xc: np.ndarray,
+                            stacked: Dict) -> np.ndarray:
+        n = Xc.shape[0]
+        b = bucket_rows(n, self.max_batch_rows)
+        # f64 -> f32 saturates huge values to inf — the routing we want
+        # (same errstate rationale as booster._predict_raw_device); the
+        # padding rows stay 0.0 and their slots are sliced away below
+        with np.errstate(over="ignore"):
+            Xp = np.zeros((b, Xc.shape[1]), np.float32)
+            Xp[:n] = Xc
+        arrays = {k: v for k, v in stacked.items()
+                  if k not in ("min_features", "value")}
+        out = _LEAF_JIT(arrays, jnp.asarray(Xp))
+        return np.asarray(jax.device_get(out))[:, :n]
+
+    def _convert(self, raw: np.ndarray) -> np.ndarray:
+        """`objective_.convert_output`, bucket-padded: conversions are
+        row-independent (sigmoid / per-row softmax / ...), so padding to
+        the same power-of-two buckets keeps eager-op compiles bounded
+        while producing bitwise the values `booster.predict` returns."""
+        obj = self._booster.objective_
+        n = raw.shape[0]
+        outs = []
+        for lo in range(0, n, self.max_batch_rows):
+            chunk = raw[lo:lo + self.max_batch_rows]
+            b = bucket_rows(chunk.shape[0], self.max_batch_rows)
+            pad = np.zeros((b,) + chunk.shape[1:], chunk.dtype)
+            pad[:chunk.shape[0]] = chunk
+            conv = np.asarray(jax.device_get(
+                obj.convert_output(jnp.asarray(pad))))
+            outs.append(conv[:chunk.shape[0]])
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)

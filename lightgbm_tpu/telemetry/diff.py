@@ -82,6 +82,19 @@ RULES: List[Tuple[str, str, str]] = [
     # the span rules below)
     ("*pipeline.depth", "ignore", "counter"),
     ("gauges.train.pipeline.device_idle_s", "up_is_bad", "timing"),
+    # serving: the bench `serving` block's latency percentiles /
+    # throughput are wall-clock (timing class, CPU-fallback noise
+    # warns); shed growth means overload handling regressed and fails
+    # hard; queue/in-flight/model-count gauges and traffic counters are
+    # load-dependent bookkeeping.  serve.fallbacks is caught by the
+    # *fallback* rule above; shed/device-error growth fails hard here
+    ("*serving.p50_ms", "up_is_bad", "timing"),
+    ("*serving.p99_ms", "up_is_bad", "timing"),
+    ("*serving.rows_per_sec", "down_is_bad", "timing"),
+    ("*serve.shed", "up_is_bad", "counter"),
+    ("*serve.device_errors", "up_is_bad", "counter"),
+    ("gauges.serve.*", "ignore", "counter"),
+    ("counters.serve.*", "ignore", "counter"),
     # wall-clock spans — higher is worse, timing class
     ("*total_s", "up_is_bad", "timing"),
     ("*mean_s", "up_is_bad", "timing"),

@@ -220,6 +220,28 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # the knob trades transient memory (each in-flight chunk holds its
     # stacked trees + per-iteration score snapshots) for device-idle time
     "tpu_pipeline_chunks": (2, "int", ("pipeline_chunks",)),
+    # ---- prediction serving (lightgbm_tpu/serving/) ----
+    # micro-batch flush threshold AND the device padding cap: serving
+    # requests are padded to power-of-two row buckets <= this, so the
+    # shared serving jit compiles at most log2(cap)+1 programs no
+    # matter how ragged the request sizes are (tests/test_serving.py
+    # asserts the bound via the jax.monitoring recompile listener)
+    "serve_max_batch_rows": (4096, "int", ("max_batch_rows",)),
+    # how long the batcher holds an open batch waiting for more rows
+    # before flushing it (milliseconds)
+    "serve_max_wait_ms": (2.0, "float", ("max_wait_ms",)),
+    # bounded submit queue: a full queue sheds the request immediately
+    # (HTTP 503) instead of queueing unboundedly under overload
+    "serve_queue_depth": (256, "int", ("queue_depth",)),
+    # per-request deadline: requests still queued past it are shed at
+    # flush time.  0 = never shed on age
+    "serve_deadline_ms": (0.0, "float", ("deadline_ms",)),
+    # compile every padding bucket at model load (warm-up-on-load) so
+    # no live request pays a device compile
+    "serve_warmup": (True, "bool", ()),
+    # HTTP frontend bind address (python -m lightgbm_tpu serve)
+    "serve_host": ("127.0.0.1", "str", ()),
+    "serve_port": (8080, "int", ()),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

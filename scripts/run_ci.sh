@@ -43,6 +43,53 @@ assert text(1) == text(4), "pipelined model differs from serial"
 print("[run_ci] pipeline smoke: depth 4 == depth 1 (byte-identical)")
 EOF
 
+# serving smoke: a golden model behind the stdlib HTTP frontend on an
+# ephemeral port — POST /predict must be byte-identical to
+# booster.predict, /healthz and /metrics must answer, clean shutdown.
+# Warm-up is off: the smoke checks wiring, the bucket/compile matrix
+# lives in tests/test_serving.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import ServingClient
+from lightgbm_tpu.serving.http import make_server
+
+bst = Booster(model_file="tests/data/golden_binary.model.txt")
+X, _ = make_case_data(GOLDEN_CASES["binary"])
+X = X[:64]
+client = ServingClient(bst, params={"serve_warmup": False})
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{port}"
+body = json.dumps({"rows": X.tolist()}).encode()
+req = urllib.request.Request(f"{base}/predict", data=body,
+                             headers={"Content-Type": "application/json"})
+resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
+got = np.asarray(resp["predictions"], np.float64)
+want = bst.predict(X)
+assert got.shape == want.shape and np.array_equal(got, want), \
+    "HTTP /predict != booster.predict"
+hz = json.loads(urllib.request.urlopen(f"{base}/healthz",
+                                       timeout=30).read())
+assert hz["status"] == "ok" and hz["models"] == ["default"], hz
+metrics = urllib.request.urlopen(f"{base}/metrics",
+                                 timeout=30).read().decode()
+assert "lgbm_tpu" in metrics and "serve" in metrics, "metrics exposition"
+srv.shutdown()
+srv.server_close()
+client.close()
+print("[run_ci] serving smoke: HTTP parity + healthz + metrics OK")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

@@ -1,0 +1,346 @@
+"""Serving subsystem: bucketed runtime, micro-batcher, registry, HTTP.
+
+The two load-bearing claims (ISSUE acceptance criteria):
+
+* BYTE-identity — `ServingRuntime.predict` must equal
+  `booster.predict` bit-for-bit on every golden family, raw and
+  transformed, because the device program returns leaf SLOTS only and
+  the f64 gather/sum happens on host in tree order (runtime.py).
+* BOUNDED compiles — 50 ragged request sizes through the micro-batcher
+  may compile at most one program per power-of-two bucket, asserted
+  through the PR 3 `jax.monitoring` recompile listener.
+"""
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.serving.runtime as srt
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import (MicroBatcher, ModelRegistry,
+                                  ServingClient, ServingOverloadError,
+                                  ServingRuntime, bucket_rows)
+from lightgbm_tpu.serving.http import make_server
+
+pytestmark = pytest.mark.quick
+
+
+def _golden(name):
+    bst = Booster(model_file=f"tests/data/golden_{name}.model.txt")
+    X, _ = make_case_data(GOLDEN_CASES[name])
+    return bst, X
+
+
+def _recompiles():
+    """Process-wide compile counter; skips when jax.monitoring is out."""
+    if not telemetry.install_compile_listener():
+        pytest.skip("jax.monitoring unavailable — no compile accounting")
+    return telemetry.REGISTRY.counter("jit.recompiles").value
+
+
+# --------------------------------------------------------------- buckets
+def test_bucket_rows_math():
+    assert [bucket_rows(n) for n in (0, 1, 2, 3, 4, 5, 7, 8, 9)] == \
+        [1, 1, 2, 4, 4, 8, 8, 8, 16]
+    assert bucket_rows(4096) == 4096
+    assert bucket_rows(4097) == 4096          # caller chunks above cap
+    assert bucket_rows(10, max_rows=8) == 8
+    rt = ServingRuntime(_golden("binary")[0], max_batch_rows=8)
+    assert rt.buckets() == [1, 2, 4, 8]
+
+
+# ---------------------------------------------------- golden byte-parity
+@pytest.mark.parametrize("name", sorted(GOLDEN_CASES))
+@pytest.mark.parametrize("raw", [True, False])
+def test_golden_family_byte_parity(name, raw):
+    bst, X = _golden(name)
+    rt = ServingRuntime(bst)
+    got = rt.predict(X, raw_score=raw)
+    want = bst.predict(X, raw_score=raw)
+    assert got.dtype == want.dtype and got.shape == want.shape
+    assert np.array_equal(got, want), \
+        f"{name} raw={raw}: serving != booster.predict"
+
+
+def test_padded_tail_rows_exact():
+    # the rows that force padding (n not a power of two) must still be
+    # bitwise equal — row independence under the vmap'd while_loop
+    bst, X = _golden("multiclass")
+    rt = ServingRuntime(bst)
+    for n in (1, 3, 5, 33, 1023):
+        assert np.array_equal(rt.predict(X[:n]), bst.predict(X[:n]))
+
+
+# ------------------------------------------------------ bounded compiles
+def test_bounded_compiles_under_ragged_load():
+    bst, _ = _golden("binary")
+    before = _recompiles()
+    rt = ServingRuntime(bst)
+    b = MicroBatcher(rt, max_wait_ms=0.0)
+    rng = np.random.RandomState(7)
+    sizes = [1, 2, 3, 5, 4095, 4096, 4097] + \
+        [int(s) for s in rng.randint(1, 4098, 43)]
+    assert len(sizes) == 50
+    try:
+        for n in sizes:
+            X = rng.randn(n, bst.num_feature())
+            got = b.predict(X, raw_score=True, timeout=120)
+            assert np.array_equal(got, bst.predict(X, raw_score=True))
+    finally:
+        b.close()
+    compiled = telemetry.REGISTRY.counter("jit.recompiles").value - before
+    assert compiled <= len(rt.buckets()), \
+        f"{compiled} compiles for 50 ragged sizes (buckets: " \
+        f"{len(rt.buckets())}) — padding bound is broken"
+
+
+def test_warmup_precompiles_every_bucket():
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst, max_batch_rows=8)
+    assert rt.warmup() == 4                    # buckets 1, 2, 4, 8
+    before = _recompiles()
+    for n in (1, 2, 3, 6, 8):
+        assert np.array_equal(rt.predict(X[:n], raw_score=True),
+                              bst.predict(X[:n], raw_score=True))
+    after = telemetry.REGISTRY.counter("jit.recompiles").value
+    assert after == before, "request after warmup paid a compile"
+
+
+# -------------------------------------------- export cache invalidation
+def _train(rounds=5):
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(600) > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    return bst, X, y
+
+
+def test_export_cache_hit_and_version():
+    bst, _, _ = _train()
+    ex1 = bst.export_predict_arrays()
+    ex2 = bst.export_predict_arrays()
+    assert ex1 is ex2, "unchanged model must hit the export cache"
+
+
+def test_rollback_invalidates_export():
+    bst, X, _ = _train()
+    rt = ServingRuntime(bst)
+    rt.predict(X[:8])
+    n_trees = len(rt._export["trees"])
+    bst.rollback_one_iter()
+    assert rt.stale(), "rollback must bump the model version"
+    rt.refresh()
+    assert len(rt._export["trees"]) == n_trees - 1
+    assert np.array_equal(rt.predict(X), bst.predict(X))
+
+
+def test_tree_slice_key_survives_id_reuse():
+    # rollback + retrain to the same length: list ids can be reused by
+    # the allocator, so the key must also carry the version counter
+    bst, _, _ = _train()
+    key1 = bst._tree_slice_key(bst.trees)
+    bst.rollback_one_iter()
+    bst.update()
+    key2 = bst._tree_slice_key(bst.trees)
+    assert len(bst.trees) and key1 != key2
+
+
+def test_continued_training_invalidates_export():
+    bst, X, _ = _train()
+    rt = ServingRuntime(bst)
+    p_old = rt.predict(X, raw_score=True)      # f64 raw: any new tree shows
+    bst.update()
+    bst.best_iteration = -1    # unpin predict from the pre-update round
+    assert rt.stale()
+    rt.refresh()
+    p_new = rt.predict(X, raw_score=True)
+    assert np.array_equal(p_new, bst.predict(X, raw_score=True))
+    assert not np.array_equal(p_old, p_new)
+
+
+def test_refit_booster_serves_fresh_values():
+    bst, X, y = _train()
+    new = bst.refit(X, y, decay_rate=0.5)
+    assert np.array_equal(ServingRuntime(new).predict(X),
+                          new.predict(X))
+
+
+# --------------------------------------------------------- micro-batcher
+def test_batcher_coalesces_concurrent_requests():
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    inner = rt.predict
+    rt.predict = lambda Xq, raw_score=False: (
+        time.sleep(0.03), inner(Xq, raw_score=raw_score))[1]
+    before = telemetry.REGISTRY.counter("serve.batches").value
+    with MicroBatcher(rt, max_wait_ms=50.0) as b:
+        reqs = [b.submit(X[i * 4:(i + 1) * 4]) for i in range(12)]
+        outs = [r.wait(60) for r in reqs]
+    for i, out in enumerate(outs):
+        assert np.array_equal(out, bst.predict(X[i * 4:(i + 1) * 4]))
+    batches = telemetry.REGISTRY.counter("serve.batches").value - before
+    assert batches < 12, "12 tiny requests should coalesce"
+
+
+def test_batcher_mixed_raw_and_prob_groups():
+    bst, X = _golden("binary")
+    with MicroBatcher(ServingRuntime(bst), max_wait_ms=20.0) as b:
+        r1 = b.submit(X[:16], raw_score=True)
+        r2 = b.submit(X[16:32], raw_score=False)
+        assert np.array_equal(r1.wait(60),
+                              bst.predict(X[:16], raw_score=True))
+        assert np.array_equal(r2.wait(60), bst.predict(X[16:32]))
+
+
+def test_batcher_sheds_on_full_queue():
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    inner = rt.predict
+    rt.predict = lambda Xq, raw_score=False: (
+        time.sleep(0.2), inner(Xq, raw_score=raw_score))[1]
+    shed = 0
+    with MicroBatcher(rt, max_wait_ms=0.0, queue_depth=1) as b:
+        b.submit(X[:2])
+        for _ in range(20):
+            try:
+                b.submit(X[:2])
+            except ServingOverloadError:
+                shed += 1
+    assert shed >= 1, "bounded queue must reject at submit under load"
+
+
+def test_batcher_deadline_shedding():
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    inner = rt.predict
+    rt.predict = lambda Xq, raw_score=False: (
+        time.sleep(0.05), inner(Xq, raw_score=raw_score))[1]
+    before = telemetry.REGISTRY.counter("serve.shed").value
+    with MicroBatcher(rt, max_wait_ms=0.0, deadline_ms=5.0) as b:
+        reqs = [b.submit(X[:4]) for _ in range(5)]
+        shed = 0
+        for r in reqs:
+            try:
+                r.wait(30)
+            except ServingOverloadError:
+                shed += 1
+    assert shed >= 1
+    assert telemetry.REGISTRY.counter("serve.shed").value > before
+
+
+def test_device_error_falls_back_to_host_walk(monkeypatch):
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    before = telemetry.REGISTRY.counter("serve.fallbacks").value
+
+    def boom(*a, **k):
+        raise RuntimeError("device wedged")
+
+    monkeypatch.setattr(srt, "_LEAF_JIT", boom)
+    got = rt.predict(X[:32], raw_score=True)
+    assert np.array_equal(got, bst.predict(X[:32], raw_score=True))
+    assert telemetry.REGISTRY.counter("serve.fallbacks").value > before
+
+
+# -------------------------------------------------------------- registry
+def test_registry_load_swap_unload():
+    b1, X1 = _golden("binary")
+    b2, X2 = _golden("goss_bagging")
+    reg = ModelRegistry({"serve_warmup": False})
+    try:
+        reg.load("m", "tests/data/golden_binary.model.txt")
+        assert reg.names() == ["m"]
+        assert np.array_equal(reg.predict(X1[:16], model="m"),
+                              b1.predict(X1[:16]))
+        reg.load("m", b2)                       # atomic hot-swap
+        assert np.array_equal(reg.predict(X2[:16], model="m"),
+                              b2.predict(X2[:16]))
+        with pytest.raises(lgb.LightGBMError, match="no model"):
+            reg.predict(X1[:2], model="ghost")
+    finally:
+        reg.close()
+    assert reg.names() == []
+
+
+def test_registry_warmup_on_load():
+    # (no lower-bound assert on the load itself: the jit cache is
+    # process-wide, so another test may have warmed these shapes first)
+    reg = ModelRegistry({"serve_max_batch_rows": 8})
+    _recompiles()                               # ensure listener, or skip
+    try:
+        reg.load("w", "tests/data/golden_binary.model.txt")
+        bst, X = _golden("binary")
+        after_load = telemetry.REGISTRY.counter("jit.recompiles").value
+        assert np.array_equal(reg.predict(X[:5], model="w",
+                                          raw_score=True),
+                              bst.predict(X[:5], raw_score=True))
+        assert telemetry.REGISTRY.counter("jit.recompiles").value == \
+            after_load, "first request after warm load paid a compile"
+    finally:
+        reg.close()
+
+
+# ------------------------------------------------------------------ HTTP
+def _serve(client):
+    srv = make_server(client, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=60).read())
+
+
+def test_http_predict_healthz_metrics():
+    bst, X = _golden("binary")
+    client = ServingClient(bst, params={"serve_warmup": False})
+    srv, base = _serve(client)
+    try:
+        resp = _post(f"{base}/predict",
+                     {"rows": X[:32].tolist(), "raw_score": True})
+        assert resp["model"] == "default" and resp["rows"] == 32
+        assert np.array_equal(np.asarray(resp["predictions"]),
+                              bst.predict(X[:32], raw_score=True))
+        hz = json.loads(urllib.request.urlopen(
+            f"{base}/healthz", timeout=30).read())
+        assert hz == {"status": "ok", "models": ["default"]}
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30).read().decode()
+        assert "lgbm_tpu" in metrics and "serve" in metrics
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        client.close()
+
+
+def test_http_error_codes():
+    bst, X = _golden("binary")
+    client = ServingClient(bst, params={"serve_warmup": False})
+    srv, base = _serve(client)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/predict", {"oops": 1})
+        assert e.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(f"{base}/predict",
+                  {"rows": X[:2].tolist(), "model": "ghost"})
+        assert e.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(f"{base}/nowhere", timeout=30)
+        assert e.value.code == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        client.close()

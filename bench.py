@@ -3,6 +3,11 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (+ extra
 informational keys "backend", "partial", "auc").
 
+`--kernel` runs the r6 histogram+split wave-pass micro-bench instead
+(xla / packed / pallas / pallas_q / pallas_fused / pallas_fused_q) and
+prints one JSON line with a `kernel` block — per-impl ms/pass + fused
+speedups — watched by the telemetry-diff sentinel's timing rules.
+
 Baseline anchor (documented; see BASELINE.md "Our target"): the target is
 the reference's **CUDA learner** on Higgs-10.5M (BASELINE.json: ">=1.5x
 CUDA rounds/sec, equal AUC").  No exact public CUDA-learner table exists, so
@@ -658,8 +663,216 @@ def _run_worker() -> None:
     telemetry.TRACER.flush()
 
 
+# --------------------------------------------------------------------------
+# --kernel: histogram+split wave-pass micro-bench (r6 fused kernel)
+# --------------------------------------------------------------------------
+
+def _run_kernel_worker() -> None:
+    """Per-wave histogram+split pass time across hist impls
+    (xla / packed / pallas / pallas_q / pallas_fused / pallas_fused_q),
+    emitted as one `@kernel {json}` line.
+
+    Each pass is one jitted function shaped like the wave grower's
+    per-wave work: build the [S, F, MB, 3] histograms of `width` leaves,
+    then decide every leaf's best split (`find_best_split` for the base
+    impls; the in-kernel candidates + `decide_from_candidates` for the
+    fused impls).  Every pass returns (hist, decision) — the grower
+    carries the histogram either way (sibling subtraction), so the
+    comparison isolates exactly what fusion removes: the XLA scan's
+    re-read of the histogram block and its [case, F, MB] gain grids.
+    Off-TPU the Pallas families run in interpret mode — flagged in the
+    block, useless as absolute numbers, but they keep the plumbing and
+    the sentinel rules exercised until the tunnel recovers."""
+    import numpy as np
+
+    n = int(os.environ.get("BENCH_KERNEL_N", 200_000))
+    width = int(os.environ.get("BENCH_KERNEL_WIDTH", 8))
+    reps = int(os.environ.get("BENCH_KERNEL_REPS", 10))
+    mb = 256
+
+    import jax
+    import jax.numpy as jnp
+    devs = jax.devices()
+    platform = devs[0].platform
+    print(f"@platform {platform}x{len(devs)}", flush=True)
+    interpret = platform != "tpu"
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from lightgbm_tpu.ops import pallas_hist as ph
+    from lightgbm_tpu.ops.histogram import (leaf_histogram_multi,
+                                            leaf_histogram_packed_multi)
+    from lightgbm_tpu.ops.split import (decide_from_candidates,
+                                        find_best_split)
+
+    rng = np.random.RandomState(77)
+    bins = jnp.asarray(rng.randint(0, mb, (F, n)).astype(np.uint8))
+    # quantized-lattice payload so the pallas_q/packed families measure
+    # their real input distribution (exact int8 grid, binary weights)
+    payload = np.stack([rng.randint(-15, 16, n) * 0.25,
+                        rng.randint(1, 16, n) * 0.125,
+                        np.ones(n)], axis=1).astype(np.float32)
+    pj = jnp.asarray(payload)
+    lid_np = rng.randint(0, width, n).astype(np.int32)
+    lid = jnp.asarray(lid_np)
+    slots = jnp.arange(width, dtype=jnp.int32)
+    nb = jnp.full((F,), mb, jnp.int32)
+    miss = jnp.zeros((F,), jnp.int32)
+    fdef = jnp.zeros((F,), jnp.int32)
+    allowed = jnp.ones((F,), bool)
+    iscat = jnp.zeros((F,), bool)
+    parent = jnp.asarray(np.stack([
+        np.bincount(lid_np, weights=payload[:, c], minlength=width)
+        for c in range(3)], axis=1).astype(np.float32))
+    s_g, s_h = jnp.float32(0.25), jnp.float32(0.125)
+    scan_kw = dict(l1=0.0, l2=1.0, min_data_in_leaf=20.0,
+                   min_sum_hessian=1e-3, min_gain_to_split=0.0)
+    find_kw = dict(cat_smooth=10.0, cat_l2=10.0, max_cat_threshold=32,
+                   max_cat_to_onehot=4, has_cat=False, **scan_kw)
+    pw9 = ph._split_payload9(pj)
+    pw3 = ph.quantized_lattice_rows(pj, s_g, s_h)
+
+    def scan_of(h, par):
+        return jax.vmap(
+            lambda hs, p: find_best_split(
+                hs, p[0], p[1], p[2], nb, miss, fdef, allowed, iscat,
+                **find_kw))(h, par)
+
+    def decide_of(cand, par):
+        return jax.vmap(
+            lambda cs, p: decide_from_candidates(
+                cs, p[0], p[1], p[2], miss, fdef, allowed, mb))(cand, par)
+
+    # inputs ride as ARGUMENTS (closing over them lets XLA constant-fold
+    # whole passes at trace time — same hazard grow_wave.py documents)
+    def p_xla(b, p, l, par):
+        h = leaf_histogram_multi(b, p, l, slots, mb)
+        return h, scan_of(h, par)
+
+    def p_packed(b, p, l, par):
+        h = leaf_histogram_packed_multi(b, p, l, slots, mb, s_g, s_h)
+        return h, scan_of(h, par)
+
+    def p_pallas(b, p, l, par):
+        h = ph.pallas_histogram_multi_rows(b, p, l, slots, mb,
+                                           interpret=interpret)
+        return h, scan_of(h, par)
+
+    def p_pallas_q(b, p, l, par):
+        h = ph.pallas_histogram_multi_quantized_rows(
+            b, p, l, slots, mb, s_g, s_h, interpret=interpret)
+        return h, scan_of(h, par)
+
+    def p_fused(b, p, l, par):
+        h, cand = ph.pallas_fused_hist_split_rows(
+            b, p, l, slots, nb, miss, par, mb, interpret=interpret,
+            **scan_kw)
+        return h, decide_of(cand, par)
+
+    def p_fused_q(b, p, l, par):
+        h, cand = ph.pallas_fused_hist_split_quantized_rows(
+            b, p, l, slots, nb, miss, par, mb, s_g, s_h,
+            interpret=interpret, **scan_kw)
+        return h, decide_of(cand, par)
+
+    entries = [("xla", p_xla, pj), ("packed", p_packed, pj),
+               ("pallas", p_pallas, pw9), ("pallas_q", p_pallas_q, pw3),
+               ("pallas_fused", p_fused, pw9),
+               ("pallas_fused_q", p_fused_q, pw3)]
+    times = {}
+    for name, fn, pw in entries:
+        jfn = jax.jit(fn)
+        try:
+            t0 = time.time()
+            jax.block_until_ready(jfn(bins, pw, lid, parent))  # compile
+            _log(f"kernel {name}: compiled+warm in {time.time() - t0:.1f}s")
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                out = jfn(bins, pw, lid, parent)
+            jax.block_until_ready(out)
+            times[name] = (time.perf_counter() - t0) / reps
+            _log(f"kernel {name}: {times[name] * 1e3:.2f} ms/pass")
+        except Exception as e:  # backend can't run this impl — recorded
+            _log(f"kernel {name} failed: {type(e).__name__}: {e}")
+    blk = {"n": n, "f": F, "max_bin": mb, "width": width, "reps": reps,
+           "interpret": interpret}
+    blk.update({f"{k}_ms": round(v * 1e3, 3) for k, v in times.items()})
+    for base, fused_ in (("pallas", "pallas_fused"),
+                         ("pallas_q", "pallas_fused_q")):
+        if times.get(base) and times.get(fused_):
+            blk[f"speedup_{fused_}"] = round(times[base] / times[fused_],
+                                             3)
+    print("@kernel " + json.dumps(blk, separators=(",", ":")), flush=True)
+
+
+def _run_kernel_orchestrator() -> None:
+    """--kernel mode: probe the backend, run the micro-bench worker under
+    the wall budget, emit ONE JSON line with the `kernel` block.  The
+    headline `value` is the fused-vs-pallas speedup (down_is_bad under
+    the sentinel's timing rules)."""
+    backend_ok, probe_attempts = _probe_backend()
+    env = dict(os.environ)
+    if backend_ok:
+        backend_tag = "probed-default"
+    else:
+        env_py = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "lightgbm_tpu", "utils", "env.py")
+        spec_ = _ilu.spec_from_file_location("_bench_env", env_py)
+        mod_ = _ilu.module_from_spec(spec_)
+        spec_.loader.exec_module(mod_)
+        env = mod_.cleaned_cpu_env(env, 1)
+        backend_tag = "cpu-fallback"
+        _log("WARNING: kernel bench on CPU fallback — interpret-mode "
+             "numbers, not comparable to TPU")
+    probed_plats = {str(a.get("backend", "")).split()[0]
+                    for a in probe_attempts if a.get("outcome") == "ok"}
+    if "tpu" not in probed_plats:
+        # off-TPU the worker flips to interpret mode, and interpret-mode
+        # Pallas emulation is python-speed: shrink hard
+        env.setdefault("BENCH_KERNEL_N", "20000")
+        env.setdefault("BENCH_KERNEL_REPS", "3")
+    env["BENCH_KERNEL"] = "1"
+    timeout = max(60.0, _remaining() - 20)
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, timeout=timeout, capture_output=True, text=True)
+        sys.stderr.write(r.stderr)
+    except subprocess.TimeoutExpired as e:
+        _event("kernel.worker_timeout", timeout_s=round(timeout, 1))
+        out = e.stdout or b""
+        r = None
+        stdout = out.decode("utf-8", "replace") \
+            if isinstance(out, bytes) else out
+    else:
+        stdout = r.stdout
+    blk = None
+    platform = backend_tag
+    for line in (stdout or "").splitlines():
+        if line.startswith("@kernel "):
+            try:
+                blk = json.loads(line.split(None, 1)[1])
+            except ValueError:
+                pass
+        elif line.startswith("@platform "):
+            platform = line.split(None, 1)[1]
+    if backend_tag == "cpu-fallback":
+        platform = "cpu-fallback"
+    value = (blk or {}).get("speedup_pallas_fused", 0.0)
+    line = {"metric": "hist_split_fused_speedup",
+            "value": value, "unit": "x",
+            "backend": platform, "partial": blk is None,
+            "kernel": blk, "probe": {"ok": backend_ok,
+                                     "attempts": probe_attempts}}
+    print(json.dumps(line), flush=True)
+
+
 if __name__ == "__main__":
     if "--worker" in sys.argv:
-        _run_worker()
+        if os.environ.get("BENCH_KERNEL"):
+            _run_kernel_worker()
+        else:
+            _run_worker()
+    elif "--kernel" in sys.argv:
+        _run_kernel_orchestrator()
     else:
         _run_orchestrator()

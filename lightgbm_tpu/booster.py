@@ -408,6 +408,7 @@ class Booster:
             debug_checks=bool(self.config.tpu_debug_nans),
         )
         self._grow_policy = self._resolve_grow_policy()
+        self._maybe_fuse_hist_impl()
         self._rng_key0 = jax.random.PRNGKey(
             self.config.bagging_seed % (2 ** 31))
         self._ff_key0 = jax.random.PRNGKey(
@@ -862,6 +863,58 @@ class Booster:
             return "packed"
         return "segment_sum"
 
+    def _maybe_fuse_hist_impl(self) -> None:
+        """Upgrade a probe-certified pallas/pallas_q impl to the fused
+        hist+split variant (hist_impl='pallas_fused'/'pallas_fused_q',
+        tpu_fused_split): the wave kernel scans each histogram in VMEM
+        and emits compact split candidates instead of re-reading the
+        wave's [S, F, MB, 3] block from HBM for the XLA scan.  The gate
+        mirrors ops/grow_wave.py's `fused` eligibility plus the
+        booster-only conditions the grower cannot check: monotone
+        constraints ride a runtime array there (the in-kernel scan is
+        the PLAIN closed-form gain — finite output bounds switch
+        find_best_split to given-output gain), and the EXACT-parity
+        fused probe (ops/pallas_hist._probe_fused) certifies this
+        backend's Mosaic lowering matches the XLA scan bitwise."""
+        spec = self._grower_spec
+        if spec.hist_impl not in ("pallas", "pallas_q"):
+            return
+        cfg = self.config
+        if not cfg.tpu_fused_split:
+            return
+        reasons = []
+        if self._grow_policy != "wave":
+            reasons.append("tree_grow_policy != wave (the strict policy "
+                           "re-scans cached histograms per split)")
+        if any(int(v) for v in (cfg.monotone_constraints or [])):
+            reasons.append("monotone_constraints")
+        if spec.bundled:
+            reasons.append("EFB bundling")
+        if spec.path_smooth > 0.0:
+            reasons.append("path_smooth")
+        if spec.extra_trees:
+            reasons.append("extra_trees")
+        kind, shards, _, _, _, _ = self._learner_topology()
+        if shards > 1 and kind != "serial":
+            reasons.append(f"tree_learner={kind} (distributed growers "
+                           "scan reduced histograms, not kernel output)")
+        if not reasons:
+            from .ops.grow_wave import wave_sizes
+            from .ops.pallas_hist import probe_cached
+            _, w = wave_sizes(spec)
+            pb, pc = self._probe_shape()
+            if not probe_cached(pb, pc, width=w,
+                                quantized=spec.hist_impl == "pallas_q",
+                                fused=True):
+                reasons.append("a failing fused-kernel exact-parity "
+                               "probe on this backend")
+        if reasons:
+            telemetry.event("fallback.fused_split", reasons=reasons)
+            return
+        self._grower_spec = spec._replace(
+            hist_impl="pallas_fused" if spec.hist_impl == "pallas"
+            else "pallas_fused_q")
+
     def _build_feat(self) -> None:
         """Per-feature metadata pytree for the grower, incl. monotone
         constraints (ref: monotone_constraints.hpp BasicLeafConstraints;
@@ -1098,8 +1151,9 @@ class Booster:
             # set_leaf_output mutated the model — cached scores are wrong
             self._rebuild_train_scores()
         fobj = fobj or self._fobj
-        if fobj is not None and self._grower_spec.hist_impl in ("packed",
-                                                                  "pallas_q"):
+        from .ops.pallas_hist import base_hist_impl
+        if fobj is not None and base_hist_impl(
+                self._grower_spec.hist_impl) in ("packed", "pallas_q"):
             # ad-hoc update(fobj=...) on a booster whose grower was
             # specialized for packed quantized histograms: custom
             # hessians may be negative, which corrupts the packed field
@@ -1172,7 +1226,9 @@ class Booster:
             from .ops.fused import quantize_gradients
             qkey = jax.random.fold_in(self._rng_key0, it * 2 + 1) \
                 if cfg.stochastic_rounding else None
-            if self._grower_spec.hist_impl in ("packed", "pallas_q"):
+            from .ops.pallas_hist import base_hist_impl
+            if base_hist_impl(self._grower_spec.hist_impl) \
+                    in ("packed", "pallas_q"):
                 grad, hess, qs = quantize_gradients(
                     grad, hess, cfg.num_grad_quant_bins, qkey,
                     return_scales=True,
@@ -2058,11 +2114,12 @@ class Booster:
         # (ops/predict.py predict_raw_ensemble) instead of the host
         # per-tree walk — the batched analog of predictor.hpp's OpenMP
         # row loop.  Covers categorical splits (r5: per-node bitset
-        # planes); falls back silently to the host path for multiclass,
-        # linear trees, and prediction early stop.
+        # planes) and multiclass (r6: per-tree class plane, [N, K]
+        # carry); falls back silently to the host path for linear trees
+        # and prediction early stop.
         if (_b(kwargs.get("device_predict",
                           self.params.get("device_predict", False)))
-                and K == 1 and not es):
+                and not es):
             # the stacked ensemble is model-constant: cache the padded
             # arrays (and their device copies) across calls, keyed by
             # the resolved slice's object identity (stale on any model
@@ -2079,12 +2136,15 @@ class Booster:
             if stacked is not None and X.shape[1] >= stacked["min_features"]:
                 with telemetry.span("predict.device", rows=n,
                                     trees=len(trees)):
-                    raw = self._predict_raw_device(stacked, X)
+                    raw = self._predict_raw_device(stacked, X, K)
                 if self._flight is not None:
                     from .telemetry.recorder import sample_memory
                     sample_memory("predict")
-                if getattr(self, "_average_output", False) and len(trees):
-                    raw = raw / max(len(trees), 1)
+                # same RF divisor as the host path (rounds, not trees —
+                # identical for K == 1)
+                if getattr(self, "_average_output", False) \
+                        and len(trees) >= K:
+                    raw = raw / max(len(trees) // K, 1)
                 if raw_score or self.objective_ is None:
                     return raw
                 return np.asarray(jax.device_get(
@@ -2205,6 +2265,13 @@ class Booster:
         if has_cat:
             out["cat_words"] = jnp.asarray(cat_words)
             out["cat_nwords"] = jnp.asarray(cat_nwords)
+        # multiclass (r6): per-tree class plane — same shape trick as the
+        # bitset planes; slices always start on an iteration boundary, so
+        # position-in-slice mod K IS the class (the host walk's i % K).
+        # Absent for K == 1 so the single-class program is unchanged.
+        K = self.num_tree_per_iteration
+        if K > 1:
+            out["cls"] = jnp.asarray(np.arange(T, dtype=np.int32) % K)
         return out
 
     def _tree_slice_key(self, trees: List[Tree]):
@@ -2267,8 +2334,11 @@ class Booster:
         self._pred_native_cache = (ck, flat)
         return flat
 
-    def _predict_raw_device(self, stacked, X: np.ndarray) -> np.ndarray:
-        """Jitted stacked-ensemble batch predict in f32.
+    def _predict_raw_device(self, stacked, X: np.ndarray,
+                            n_class: int = 1) -> np.ndarray:
+        """Jitted stacked-ensemble batch predict in f32 ([N] for one
+        class, [N, K] multiclass — the per-tree `cls` plane routes each
+        scan step's output into its class column).
 
         Parity caveat: features AND thresholds are cast to f32, so a
         feature value lying strictly between a threshold and its f32
@@ -2277,9 +2347,12 @@ class Booster:
         within f32 epsilon of a split threshold (thresholds are bin-edge
         midpoints, so real data virtually never sits there); the host
         walk remains the exact-f64 reference path."""
-        from .ops.predict import predict_raw_ensemble
+        from .ops.predict import (predict_raw_ensemble,
+                                  predict_raw_ensemble_multi)
         if getattr(self, "_pred_dev_jit", None) is None:
             self._pred_dev_jit = jax.jit(predict_raw_ensemble)
+            self._pred_dev_jit_multi = jax.jit(
+                predict_raw_ensemble_multi, static_argnames="n_class")
         arrays = {k: v for k, v in stacked.items() if k != "min_features"}
         # f64 values beyond f32 range overflow to ±inf in this cast — the
         # routing we WANT (inf exceeds every threshold/span, so such rows
@@ -2287,7 +2360,11 @@ class Booster:
         # errstate so the intended saturation doesn't warn
         with np.errstate(over="ignore"):
             X32 = np.asarray(X, dtype=np.float32)
-        out = self._pred_dev_jit(arrays, jnp.asarray(X32))
+        if n_class > 1:
+            out = self._pred_dev_jit_multi(arrays, jnp.asarray(X32),
+                                           n_class=n_class)
+        else:
+            out = self._pred_dev_jit(arrays, jnp.asarray(X32))
         return np.asarray(jax.device_get(out), dtype=np.float64)
 
     def export_predict_arrays(self, start_iteration: int = 0,
@@ -2875,6 +2952,7 @@ class Booster:
             wave_gain_ratio=self._wave_gain_ratio(),
             wave_overgrow=self._wave_overgrow())
         self._grow_policy = self._resolve_grow_policy()
+        self._maybe_fuse_hist_impl()
         self._grower = self._make_serial_grower()
         self._build_feat()
         self._setup_tree_learner()

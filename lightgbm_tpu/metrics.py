@@ -221,24 +221,82 @@ def _dcg_at(scores, labels, k, label_gain):
     return float(np.sum(gains * discounts))
 
 
+def _ndcg_scalar(score, label, qb, eval_at, lg):
+    """Reference per-query loop (ref: rank_metric.hpp `NDCGMetric` /
+    dcg_calculator.cpp) — kept as the parity oracle for the bucketed
+    path below (tests/test_rank_bucketing.py)."""
+    results = []
+    for k in eval_at:
+        vals = []
+        for q in range(len(qb) - 1):
+            s, e = qb[q], qb[q + 1]
+            ideal = _dcg_at(label[s:e].astype(np.float64), label[s:e], k, lg)
+            if ideal <= 0:
+                vals.append(1.0)
+                continue
+            vals.append(_dcg_at(score[s:e], label[s:e], k, lg) / ideal)
+        results.append((f"ndcg@{k}", float(np.mean(vals))))
+    return results
+
+
+def _ndcg_bucketed(score, label, qb, eval_at, lg):
+    """Vectorized NDCG over length buckets (r6, VERDICT r5 weak #4).
+
+    The per-query loop above runs O(num_queries * len(eval_at)) numpy
+    calls per eval — 72 ms at MSLR-like shape (800 queries, 92k rows,
+    eval_at=1/5/10; PROFILE.md r6).  Against this round's CPU-fallback
+    training that is only ~1% of a round, but at the TPU round record
+    (PROFILE.md r3c: ~340 ms/round at 2M rows — tens of ms at this
+    shape) the host eval is a same-order serial tax on every eval
+    round.  Bucketed it drops 6.1x to 12 ms.  This path reuses
+    `rank_objective._bucket_queries`' length bucketing (the r5 gradient
+    layout) to sort/gather every query of a bucket in one [Q_b, P_b]
+    batch.  Per-query values match the scalar loop to f64 round-off:
+    the padded tail contributes exact zero terms, which only regroups
+    np.sum's pairwise accumulation (row order inside a bucket is the
+    within-query order, and `stable` argsort reproduces mergesort's
+    tie-breaks), and per-query results scatter back into original query
+    order before the mean."""
+    from .rank_objective import _bucket_queries
+    sizes = np.diff(qb).astype(np.int64)
+    nq = len(sizes)
+    lab = label.astype(np.int64)
+    score = np.asarray(score, dtype=np.float64)
+    out = {k: np.ones(nq, dtype=np.float64) for k in eval_at}
+    for qidx in _bucket_queries(sizes):
+        pb = int(sizes[qidx].max())
+        idx = np.full((len(qidx), pb), -1, dtype=np.int64)
+        for row, q in enumerate(qidx):
+            idx[row, :sizes[q]] = np.arange(qb[q], qb[q + 1])
+        valid = idx >= 0
+        g = np.maximum(idx, 0)
+        gains = np.where(valid, lg[lab[g]], 0.0)
+        # pads sort last (-inf score); `stable` keeps within-query order
+        # on ties, same as the scalar mergesort
+        o_s = np.argsort(np.where(valid, -score[g], np.inf), axis=1,
+                         kind="stable")
+        o_i = np.argsort(np.where(valid, -lab[g].astype(np.float64),
+                                  np.inf), axis=1, kind="stable")
+        disc = 1.0 / np.log2(np.arange(2, pb + 2, dtype=np.float64))
+        dcg_t = np.take_along_axis(gains, o_s, axis=1) * disc
+        ideal_t = np.take_along_axis(gains, o_i, axis=1) * disc
+        for k in eval_at:
+            ideal = ideal_t[:, :k].sum(axis=1)
+            dcg = dcg_t[:, :k].sum(axis=1)
+            out[k][qidx] = np.where(ideal > 0,
+                                    dcg / np.where(ideal > 0, ideal, 1.0),
+                                    1.0)
+    return [(f"ndcg@{k}", float(np.mean(out[k]))) for k in eval_at]
+
+
 def _make_ndcg(eval_at, label_gain):
     lg = np.asarray(label_gain, dtype=np.float64)
 
     def f(score, label, weight, qb):
         if qb is None:
             raise LightGBMError("NDCG metric requires query information")
-        results = []
-        for k in eval_at:
-            vals = []
-            for q in range(len(qb) - 1):
-                s, e = qb[q], qb[q + 1]
-                ideal = _dcg_at(label[s:e].astype(np.float64), label[s:e], k, lg)
-                if ideal <= 0:
-                    vals.append(1.0)
-                    continue
-                vals.append(_dcg_at(score[s:e], label[s:e], k, lg) / ideal)
-            results.append((f"ndcg@{k}", float(np.mean(vals))))
-        return results
+        return _ndcg_bucketed(score, label, np.asarray(qb),
+                              tuple(eval_at), lg)
     return f
 
 

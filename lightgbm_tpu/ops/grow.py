@@ -149,6 +149,10 @@ class GrowerSpec(NamedTuple):
     # reference's `leaves_to_update` re-search.  Serial, un-pooled
     # growers only (booster downgrades otherwise).
     monotone_intermediate: bool = False
+    # run the Pallas kernels in interpret mode (CPU parity tests: the
+    # pallas/pallas_q/pallas_fused families become runnable — and
+    # byte-comparable — off-TPU); never set on real backends
+    hist_interpret: bool = False
 
 
 class DeviceTree(NamedTuple):
@@ -457,6 +461,14 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
       psummed — communication drops from O(F·MB) to O(2k·MB), the
       strategy for DCN-crossing meshes.  `n_shards` = total shard count.
     """
+    # the strict policy has no fused hist+split path (its per-split
+    # searches re-scan CACHED histograms, which the fused kernel never
+    # materializes candidates for): normalize a fused impl to its base
+    # histogram family — silent, because the fused candidates are
+    # byte-identical to find_best_split by construction
+    from .pallas_hist import base_hist_impl
+    if spec.hist_impl != base_hist_impl(spec.hist_impl):
+        spec = spec._replace(hist_impl=base_hist_impl(spec.hist_impl))
     L = spec.num_leaves
     MB = spec.max_bin
     find = functools.partial(
@@ -567,12 +579,14 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                 if spec.hist_impl == "pallas":
                     lid = jnp.where(mask_rows, 0, -1).astype(jnp.int32)
                     h = pallas_histogram_multi_rows(
-                        hist_bins, pw_prep, lid, one_slot, HB)[0]
+                        hist_bins, pw_prep, lid, one_slot, HB,
+                        interpret=spec.hist_interpret)[0]
                 elif spec.hist_impl == "pallas_q":
                     lid = jnp.where(mask_rows, 0, -1).astype(jnp.int32)
                     h = pallas_histogram_multi_quantized_rows(
                         hist_bins, pw_prep, lid, one_slot, HB,
-                        feat["qscales"][0], feat["qscales"][1])[0]
+                        feat["qscales"][0], feat["qscales"][1],
+                        interpret=spec.hist_interpret)[0]
                 elif spec.hist_impl == "packed":
                     # quantized-gradient packed-int scatter (2 sweeps);
                     # scales ride in feat["qscales"] (booster/fused set

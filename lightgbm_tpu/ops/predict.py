@@ -208,6 +208,31 @@ def predict_raw_ensemble(stacked, X: Array) -> Array:
         return total
 
 
+@contract(stacked="tree", X="[N, F] float", ret="[N, K] f32")
+def predict_raw_ensemble_multi(stacked, X: Array, n_class: int) -> Array:
+    """Multiclass raw scores via the same stacked scan, [N, K] carry.
+
+    `stacked` carries one extra per-tree plane `cls` [T] i32 — tree i's
+    class index (i % K at stacking time, matching the host walk's
+    `raw[:, i % K] += t.predict(X)` interleaving).  Each scan step
+    scatter-adds its tree's [N] output into the carry's class column,
+    so multiclass ensembles traverse on device instead of forcing the
+    host per-tree walk.  Kept separate from `predict_raw_ensemble` so
+    the K == 1 program (shape, HLO, bytes) is untouched.
+    """
+    def step(carry, tree):
+        out = traverse_raw(tree["feat"], tree["thr"], tree["dtype"],
+                           tree["left"], tree["right"], tree["value"], X,
+                           cat_words=tree.get("cat_words"),
+                           cat_nwords=tree.get("cat_nwords"))
+        return carry.at[:, tree["cls"]].add(out), None
+
+    with jax.named_scope("predict_ensemble"):
+        init = jnp.zeros((X.shape[0], n_class), dtype=jnp.float32)
+        total, _ = jax.lax.scan(step, init, stacked)
+        return total
+
+
 @contract(stacked="tree", X="[N, F] float", ret="[T, N] i32")
 def predict_leaf_ensemble(stacked, X: Array) -> Array:
     """Per-tree leaf slots over padded stacked tree arrays (serving path).

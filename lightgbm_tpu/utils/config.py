@@ -189,6 +189,15 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # Only consulted on TPU backends (CPU keeps segment-sum), and probe-
     # gated so a Mosaic regression degrades to the XLA path
     "tpu_use_pallas": (True, "bool", ()),
+    # fused Pallas histogram+split (ops/pallas_hist.py, wave policy
+    # only): the wave kernel scans each histogram in VMEM and emits
+    # compact split candidates instead of re-reading the [S, F, MB, 3]
+    # block from HBM for the XLA scan.  Byte-identical to the unfused
+    # kernel by construction and probe-gated on EXACT output equality,
+    # so any backend divergence degrades to the base pallas/pallas_q
+    # path.  Auto-disabled off the plain numerical gain path (monotone
+    # constraints, path smoothing, extra_trees, EFB, distributed)
+    "tpu_fused_split": (True, "bool", ("fused_split",)),
     # growth policy (ops/grow_wave.py): "leafwise" = stock-exact strict
     # best-first (ref: serial_tree_learner.cpp Train); "wave" = TPU-first
     # wave-batched best-first — each wave splits every positive-gain

@@ -115,11 +115,56 @@ def test_linear_model_falls_back_to_host():
         bst.predict(X, device_predict=True), bst.predict(X))
 
 
-def test_multiclass_falls_back_to_host():
-    X, _ = _data()
+def test_multiclass_device_parity():
+    # r6: multiclass runs ON DEVICE — the stacked scan carries a
+    # per-tree `cls` plane and scatter-adds into an [N, K] carry,
+    # reproducing the host walk's `raw[:, i % K] += tree` interleaving
+    X, _ = _data(with_nan=True)
     y = np.random.RandomState(2).randint(0, 3, len(X)).astype(float)
     bst = lgb.train({"objective": "multiclass", "num_class": 3,
                      "num_leaves": 8, "verbosity": -1},
                     lgb.Dataset(X, label=y), num_boost_round=6)
-    np.testing.assert_array_equal(
-        bst.predict(X, device_predict=True), bst.predict(X))
+    stacked = bst._stack_for_device(bst.trees)
+    assert stacked["cls"].shape == (len(bst.trees),)
+    assert list(stacked["cls"][:6]) == [0, 1, 2, 0, 1, 2]
+    for raw in (True, False):
+        host = bst.predict(X, raw_score=raw)
+        dev = bst.predict(X, raw_score=raw, device_predict=True)
+        assert dev.shape == host.shape == (len(X), 3)
+        np.testing.assert_allclose(dev, host, rtol=2e-5, atol=2e-6)
+    # iteration-bounded slices start on a K boundary, so the stacked
+    # class plane stays aligned with the host walk's i % K
+    np.testing.assert_allclose(
+        bst.predict(X, device_predict=True, start_iteration=2,
+                    num_iteration=3),
+        bst.predict(X, start_iteration=2, num_iteration=3),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_multiclass_rf_device_parity():
+    # RF averaging divides by ROUNDS (len(trees) // K) on both paths
+    X, _ = _data()
+    y = np.random.RandomState(4).randint(0, 3, len(X)).astype(float)
+    rf = lgb.train({"objective": "multiclass", "num_class": 3,
+                    "boosting": "rf", "bagging_fraction": 0.7,
+                    "bagging_freq": 1, "num_leaves": 8,
+                    "verbosity": -1},
+                   lgb.Dataset(X, label=y), num_boost_round=5)
+    np.testing.assert_allclose(
+        rf.predict(X, device_predict=True), rf.predict(X),
+        rtol=2e-5, atol=2e-6)
+
+
+def test_multiclass_serving_runtime_smoke():
+    # the serving runtime's host-side gather already interleaved
+    # classes; the unused `cls` plane in the stacked export must not
+    # perturb its exact-f64 parity
+    from lightgbm_tpu.serving.runtime import ServingRuntime
+    X, _ = _data(n=1200)
+    y = np.random.RandomState(6).randint(0, 3, len(X)).astype(float)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 8, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    srv = ServingRuntime(bst)
+    np.testing.assert_array_equal(srv.predict(X[:257]),
+                                  bst.predict(X[:257]))

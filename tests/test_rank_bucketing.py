@@ -116,3 +116,53 @@ def test_position_debias_consistent_under_bucketing():
     tp, tm = np.asarray(tp), np.asarray(tm)
     assert np.isfinite(tp).all() and np.isfinite(tm).all()
     assert tp[0] == 1.0 and tm[0] == 1.0
+
+
+def test_ndcg_eval_bucketed_matches_scalar_oracle():
+    """r6: `_make_ndcg` evaluates via the bucketed vectorized layout
+    (`metrics._ndcg_bucketed`); the retired per-query loop stays as the
+    parity oracle (`_ndcg_scalar`).  Same pairwise f64 accumulation
+    order within a query, so agreement is near-bitwise."""
+    from lightgbm_tpu.metrics import _make_ndcg, _ndcg_bucketed, \
+        _ndcg_scalar
+
+    lg = [float(2 ** i - 1) for i in range(32)]
+    eval_at = (1, 3, 5, 10)
+    for seed in (0, 1):
+        X, y, sizes = make_skewed_ranking(90, seed=seed)
+        rng = np.random.RandomState(seed)
+        score = X[:, 0] + 0.5 * rng.randn(len(y))
+        # exercise tie-breaking: quantize scores so duplicates abound
+        score = np.round(score * 4) / 4
+        # a few degenerate queries: all-zero labels (ideal DCG == 0)
+        y2 = y.copy()
+        for q in range(0, 90, 17):
+            y2[int(sizes[:q].sum()):int(sizes[:q + 1].sum())] = 0.0
+        qb = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+        want = _ndcg_scalar(score, y2, qb, eval_at, np.asarray(lg))
+        got = _ndcg_bucketed(score, y2, qb, eval_at, np.asarray(lg))
+        for (kn_w, v_w), (kn_g, v_g) in zip(want, got):
+            assert kn_w == kn_g
+            np.testing.assert_allclose(v_g, v_w, rtol=1e-12)
+        # the public entry uses the bucketed path
+        pub = _make_ndcg(list(eval_at), lg)(score, y2, None, qb)
+        for (kn_w, v_w), (kn_p, v_p) in zip(want, pub):
+            assert kn_w == kn_p
+            np.testing.assert_allclose(v_p, v_w, rtol=1e-12)
+
+
+def test_ndcg_single_doc_queries_and_truncation_edges():
+    from lightgbm_tpu.metrics import _ndcg_bucketed, _ndcg_scalar
+
+    lg = np.asarray([float(2 ** i - 1) for i in range(32)])
+    rng = np.random.RandomState(5)
+    sizes = np.asarray([1, 1, 2, 7, 1, 40, 3, 1])   # k > size for most
+    qb = np.concatenate([[0], np.cumsum(sizes)]).astype(np.int64)
+    n = int(sizes.sum())
+    score = rng.randn(n)
+    label = rng.randint(0, 5, n).astype(np.float64)
+    eval_at = (1, 2, 5, 100)
+    want = _ndcg_scalar(score, label, qb, eval_at, lg)
+    got = _ndcg_bucketed(score, label, qb, eval_at, lg)
+    for (_, v_w), (_, v_g) in zip(want, got):
+        np.testing.assert_allclose(v_g, v_w, rtol=1e-12)

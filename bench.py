@@ -650,8 +650,59 @@ def _run_worker() -> None:
                    "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
                    "rows_per_sec": round(batch * iters / total_s, 1)}
+
+            # per-rung split at full 4096-row buckets: the exact
+            # device-sum rung vs the slot path it replaces (same
+            # workload, serve_device_sum toggled).  `active` records
+            # whether the parity probe actually enabled the rung —
+            # diff.py fails hard if it flips back to 0, so the slot
+            # path cannot silently return
+            def _rung_bench(mode, rows, n_iters):
+                Xr = X_eval
+                if len(Xr) < rows:
+                    Xr = np.tile(Xr, (-(-rows // max(len(Xr), 1)), 1))
+                Xr = np.ascontiguousarray(Xr[:rows], np.float64)
+                c = ServingClient(bst, params={
+                    "serve_max_wait_ms": 0.0, "serve_device_sum": mode})
+                rt = c.registry.get().runtime
+                d2h = telemetry.REGISTRY.counter("serve.d2h_bytes")
+                d2h0 = d2h.value
+                c.predict(Xr, raw_score=True)      # steady state
+                rlat = []
+                t_rall = time.time()
+                for _ in range(n_iters):
+                    t0 = time.perf_counter()
+                    c.predict(Xr, raw_score=True)
+                    rlat.append(time.perf_counter() - t0)
+                rtotal = time.time() - t_rall
+                d2h_bytes = d2h.value - d2h0
+                active = bool(getattr(rt, "device_sum_active", False))
+                c.close()
+                rlat_ms = np.sort(np.asarray(rlat)) * 1e3
+                return {
+                    "rows_per_request": rows, "requests": n_iters,
+                    "p50_ms": round(float(np.percentile(rlat_ms, 50)), 3),
+                    "p99_ms": round(float(np.percentile(rlat_ms, 99)), 3),
+                    "rows_per_sec": round(rows * n_iters / rtotal, 1),
+                    "active": int(active),
+                    "d2h_bytes_per_row": round(
+                        d2h_bytes / (rows * (n_iters + 1)), 1)}
+
+            rung_rows = int(os.environ.get("BENCH_SERVE_RUNG_ROWS", 4096))
+            rung_iters = max(int(os.environ.get("BENCH_SERVE_RUNG_ITERS",
+                                                max(iters // 5, 5))), 1)
+            blk["device_sum"] = _rung_bench("auto", rung_rows, rung_iters)
+            slot = _rung_bench("off", rung_rows, rung_iters)
+            slot.pop("active")
+            blk["slot_path"] = slot
             print("@serving " + json.dumps(blk, separators=(",", ":")),
                   flush=True)
+            _log(f"serving rungs @{rung_rows} rows: device_sum "
+                 f"{blk['device_sum']['rows_per_sec']:,.0f} rows/s "
+                 f"(active={blk['device_sum']['active']}, "
+                 f"{blk['device_sum']['d2h_bytes_per_row']} B/row D2H) "
+                 f"vs slot {slot['rows_per_sec']:,.0f} rows/s "
+                 f"({slot['d2h_bytes_per_row']} B/row D2H)")
             _log(f"serving bench: p50 {blk['p50_ms']} ms, "
                  f"p99 {blk['p99_ms']} ms, "
                  f"{blk['rows_per_sec']:,.0f} rows/s "

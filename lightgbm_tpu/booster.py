@@ -2382,6 +2382,16 @@ class Booster:
           stacked        — device arrays for predict_leaf_ensemble, or
                            None (linear trees: host-walk only)
           leaf_values    — [T, NL] f64 leaf outputs, tree-padded
+          value_hi/lo    — [T, NL] u32 device planes: the raw bit
+                           halves of `leaf_values` (hi = sign/exponent/
+                           top mantissa word, lo = low mantissa word),
+                           consumed by the exact device-sum program
+                           (`ops.predict.predict_raw_ensemble_exact`).
+                           A f32/f32 VALUE split cannot stand in: a
+                           53-bit leaf mantissa does not fit two f32
+                           significands, so the device carries the f64
+                           bit patterns themselves.  None when stacked
+                           is None.
           trees          — the resolved host Tree slice (fallback walk)
           num_class      — trees per iteration (K)
           average_factor — RF averaging divisor (1 = plain sum)
@@ -2397,11 +2407,17 @@ class Booster:
         leaf_values = np.zeros((len(trees), nl), np.float64)
         for i, t in enumerate(trees):
             leaf_values[i, :t.num_leaves] = t.leaf_value[:t.num_leaves]
+        value_hi = value_lo = None
+        if stacked is not None:
+            bits = leaf_values.view(np.uint64)
+            value_hi = jnp.asarray((bits >> 32).astype(np.uint32))
+            value_lo = jnp.asarray(bits.astype(np.uint32))
         K = self.num_tree_per_iteration
         avg = max(len(trees) // K, 1) \
             if getattr(self, "_average_output", False) \
             and len(trees) >= K else 1
         export = {"stacked": stacked, "leaf_values": leaf_values,
+                  "value_hi": value_hi, "value_lo": value_lo,
                   "trees": trees, "num_class": K, "average_factor": avg,
                   "version": getattr(self, "_model_version", 0)}
         if ck:

@@ -233,6 +233,268 @@ def predict_raw_ensemble_multi(stacked, X: Array, n_class: int) -> Array:
         return total
 
 
+# ------------------------------------------------------------------
+# software binary64 arithmetic on u32 bit-plane pairs
+#
+# Serving byte-identity requires the device to reproduce the host
+# walk's SEQUENTIAL f64 leaf-value summation bit for bit.  TPUs have no
+# f64 unit, and double-float (TwoSum/Dekker) accumulation over f32
+# halves cannot do it either: a leaf value with a full 52-bit mantissa
+# is not representable as f32(v) + f32(v - f32(v)) (48 mantissa bits at
+# best), and compensated sums round differently from the sequential sum
+# at nearly every step (measured: 50-100% of rows mismatch on 4 of the
+# 5 golden families).  So the exact serving program carries the f64
+# accumulator as a pair of u32 bit-planes (the two halves of each IEEE
+# 754 binary64 pattern) and performs real binary64 addition —
+# align/add/normalize/round-to-nearest-even — in integer ops.  ~100
+# elementwise u32 ops per tree step, fused by XLA under the scan, and
+# bit-exact by construction; the serving runtime's export-time parity
+# probe remains the gate for anything out of scope below.
+#
+# Out of scope (probe-guarded, cannot occur for finite GBDT scores):
+# NaN/Inf INPUTS and subnormal or overflowing RESULTS.  Signed zeros
+# and subnormal inputs on the y (smaller) side are handled.
+
+
+def _u(x) -> Array:
+    return jnp.uint32(x)
+
+
+def _clz32(x: Array) -> Array:
+    """Leading-zero count of u32 (32 for x == 0 handled by callers)."""
+    n = jnp.zeros(x.shape, jnp.int32)
+    for sh in (16, 8, 4, 2, 1):
+        mask = x < (_u(1) << _u(32 - sh))
+        n = jnp.where(mask, n + sh, n)
+        x = jnp.where(mask, x << _u(sh), x)
+    return n
+
+
+def _clz64(hi: Array, lo: Array) -> Array:
+    return jnp.where(hi == 0, 32 + _clz32(lo), _clz32(hi))
+
+
+def _shr64_sticky(hi: Array, lo: Array, d: Array):
+    """Logical right shift of a u32 pair by d in [0, 63] plus a sticky
+    flag (any shifted-out bit set).  XLA leaves shifts >= the bit width
+    implementation-defined, so every shift amount is clamped below 32
+    and the >= 32 case is reassembled from two sub-32 shifts."""
+    d = d.astype(jnp.uint32)
+    ds = jnp.clip(d, 0, 31)
+    dc = (_u(31) - ds).astype(jnp.uint32)
+    lo_a = jnp.where(d == 0, lo, (lo >> ds) | ((hi << dc) << _u(1)))
+    hi_a = hi >> ds
+    st_a = jnp.where(d == 0, _u(0), lo & ((_u(1) << ds) - _u(1)))
+    d2 = jnp.clip(d - _u(32), 0, 31)
+    lo_b = hi >> d2
+    st_b = lo | jnp.where(d2 == 0, _u(0), hi & ((_u(1) << d2) - _u(1)))
+    big = d >= 32
+    return (jnp.where(big, _u(0), hi_a),
+            jnp.where(big, lo_b, lo_a),
+            (jnp.where(big, st_b, st_a) != 0))
+
+
+def _shl64(hi: Array, lo: Array, d: Array):
+    """Left shift of a u32 pair by d in [0, 63] (zero fill)."""
+    d = d.astype(jnp.uint32)
+    ds = jnp.clip(d, 0, 31)
+    dc = (_u(31) - ds).astype(jnp.uint32)
+    hi_a = jnp.where(d == 0, hi, (hi << ds) | ((lo >> dc) >> _u(1)))
+    lo_a = lo << ds
+    hi_b = lo << jnp.clip(d - _u(32), 0, 31)
+    big = d >= 32
+    return (jnp.where(big, hi_b, hi_a), jnp.where(big, _u(0), lo_a))
+
+
+def _add64(ahi: Array, alo: Array, bhi: Array, blo: Array):
+    lo = alo + blo
+    return ahi + bhi + (lo < alo).astype(jnp.uint32), lo
+
+
+def _sub64(ahi: Array, alo: Array, bhi: Array, blo: Array):
+    lo = alo - blo
+    return ahi - bhi - (alo < blo).astype(jnp.uint32), lo
+
+
+def _f64_add_bits(ahi: Array, alo: Array, bhi: Array, blo: Array):
+    """Bit-exact IEEE 754 binary64 addition (round-to-nearest-even) on
+    raw-bit u32 (hi, lo) pairs, in pure integer ops.
+
+    Working format: the 53-bit significand sits in a u32 pair shifted
+    left by 9 (implicit bit at global bit 61), leaving 9 guard bits for
+    alignment plus 1 headroom bit for the add carry.  Sticky bits
+    dropped past the guard range fold into bit 0 before rounding — for
+    effective subtraction the dropped tail additionally borrows one
+    unit first, so the computed value brackets the exact one tightly
+    enough that round-to-nearest-even at bit 9 is unaffected (the
+    standard guard/round/sticky argument; massive cancellation only
+    happens when the exponent gap is <= 1, where no bits are dropped
+    at all and the result is exact)."""
+    # finite IEEE magnitudes order like their bit patterns
+    mag_a = ahi & _u(0x7FFFFFFF)
+    mag_b = bhi & _u(0x7FFFFFFF)
+    a_ge = (mag_a > mag_b) | ((mag_a == mag_b) & (alo >= blo))
+    xhi = jnp.where(a_ge, ahi, bhi)
+    xlo = jnp.where(a_ge, alo, blo)
+    yhi = jnp.where(a_ge, bhi, ahi)
+    ylo = jnp.where(a_ge, blo, alo)
+
+    sx = xhi >> _u(31)
+    sy = yhi >> _u(31)
+    ex = (xhi >> _u(20)) & _u(0x7FF)
+    ey = (yhi >> _u(20)) & _u(0x7FF)
+
+    def mant(hi, lo, e):
+        imp = (e > 0).astype(jnp.uint32)
+        return ((imp << _u(29)) | ((hi & _u(0xFFFFF)) << _u(9))
+                | (lo >> _u(23)), lo << _u(9))
+
+    mxhi, mxlo = mant(xhi, xlo, ex)
+    myhi, mylo = mant(yhi, ylo, ey)
+    eex = jnp.maximum(ex, _u(1))
+    d = eex - jnp.maximum(ey, _u(1))
+    far = d >= 64
+    syhi, sylo, st = _shr64_sticky(myhi, mylo, jnp.minimum(d, _u(63)))
+    sticky = jnp.where(far, (myhi | mylo) != 0, st)
+    syhi = jnp.where(far, _u(0), syhi)
+    sylo = jnp.where(far, _u(0), sylo)
+
+    sub = sx != sy
+    bor = (sub & sticky).astype(jnp.uint32)
+    add_hi, add_lo = _add64(mxhi, mxlo, syhi, sylo)
+    sub_hi, sub_lo = _sub64(mxhi, mxlo, syhi, sylo + bor)
+    # sylo + bor cannot wrap: bor == 1 implies d >= 10, so the shifted
+    # sylo has its top 9 bits clear
+    rhi = jnp.where(sub, sub_hi, add_hi)
+    rlo = jnp.where(sub, sub_lo, add_lo)
+
+    is_zero = (rhi | rlo) == 0
+    ovf = (rhi >> _u(30)) != 0          # addition carried into bit 62
+    rs_hi, rs_lo, st2 = _shr64_sticky(rhi, rlo, jnp.ones_like(rhi))
+    sticky = sticky | (ovf & st2)
+    rhi = jnp.where(ovf, rs_hi, rhi)
+    rlo = jnp.where(ovf, rs_lo, rlo)
+    e = eex.astype(jnp.int32) + ovf.astype(jnp.int32)
+    lsh = jnp.clip(_clz64(rhi, rlo) - 2, 0, 63).astype(jnp.uint32)
+    ln_hi, ln_lo = _shl64(rhi, rlo, lsh)
+    norm = (~ovf) & (~is_zero)
+    rhi = jnp.where(norm, ln_hi, rhi)
+    rlo = jnp.where(norm, ln_lo, rlo)
+    e = jnp.where(norm, e - lsh.astype(jnp.int32), e)
+
+    # round to nearest even at bit 9 (sticky folded into bit 0)
+    rlo = rlo | sticky.astype(jnp.uint32)
+    rb = rlo & _u(0x1FF)
+    up = (rb > _u(0x100)) | ((rb == _u(0x100))
+                             & (((rlo >> _u(9)) & _u(1)) == _u(1)))
+    m_hi, m_lo, _st = _shr64_sticky(rhi, rlo, jnp.full_like(rhi, 9))
+    m_hi, m_lo = _add64(m_hi, m_lo, jnp.zeros_like(m_hi),
+                        up.astype(jnp.uint32))
+    rnd_ovf = (m_hi >> _u(21)) != 0     # 2^53 -> 2^52: exact, exp bumps
+    m_hi = jnp.where(rnd_ovf, _u(1) << _u(20), m_hi)
+    m_lo = jnp.where(rnd_ovf, _u(0), m_lo)
+    e = e + rnd_ovf.astype(jnp.int32)
+
+    # exact cancellation gives +0 under round-to-nearest; an all-zero
+    # effective add keeps the shared sign (so -0 + -0 == -0)
+    sign = jnp.where(sub, jnp.where(is_zero, _u(0), sx),
+                     jnp.where(is_zero, sx & sy, sx))
+    out_hi = ((sign << _u(31)) | (e.astype(jnp.uint32) << _u(20))
+              | (m_hi & _u(0xFFFFF)))
+    return (jnp.where(is_zero, sign << _u(31), out_hi),
+            jnp.where(is_zero, _u(0), m_lo))
+
+
+def _f64_bits_to_f32(hi: Array, lo: Array) -> Array:
+    """Round-to-nearest-even f64 -> f32 conversion on raw u32 bit
+    planes — the device-side twin of the `jnp.asarray(raw_f64)`
+    downcast the host conversion path performs with x64 disabled.
+    Handles signed zeros, overflow to inf, and subnormal f32 results
+    (f64 subnormal inputs underflow straight to +-0, exactly as the
+    native cast does).  NaN inputs are out of scope (probe-guarded)."""
+    sign = hi >> _u(31)
+    e = (hi >> _u(20)) & _u(0x7FF)
+    mhi = hi & _u(0xFFFFF)
+    e32 = e.astype(jnp.int32) - 1023 + 127
+    # normal result: top 23 mantissa bits, RNE on the dropped 29, with
+    # the rounding carry rippling into the exponent (and into the inf
+    # pattern at e32 == 254) by plain integer addition
+    m23 = (mhi << _u(3)) | (lo >> _u(29))
+    rb = lo & _u((1 << 29) - 1)
+    half = _u(1 << 28)
+    up = ((rb > half) | ((rb == half) & ((m23 & _u(1)) == _u(1))))
+    norm = ((jnp.clip(e32, 0, 254).astype(jnp.uint32) << _u(23)) | m23) \
+        + up.astype(jnp.uint32)
+    # subnormal result (e32 <= 0): shift the full 53-bit significand
+    # down to 2^-149 units keeping a round bit + sticky, then RNE; a
+    # carry to 2^23 lands on the min-normal pattern by construction
+    smhi = (_u(1) << _u(20)) | mhi
+    sh = jnp.clip(30 - e32, 1, 64).astype(jnp.uint32)
+    _h1, l1, st1 = _shr64_sticky(smhi, lo, jnp.minimum(sh - _u(1), _u(63)))
+    msub = (l1 >> _u(1)) + ((l1 & _u(1))
+                            & (st1.astype(jnp.uint32) | ((l1 >> _u(1))
+                                                         & _u(1))))
+    out = jnp.where(e32 >= 255, _u(0x7F800000),
+                    jnp.where(e32 >= 1, norm, msub))
+    out = jnp.where(e == 0, _u(0), out)
+    return jax.lax.bitcast_convert_type((sign << _u(31)) | out,
+                                        jnp.float32)
+
+
+@contract(stacked="tree", X="[N, F] float", n_class="static int",
+          convert="static", ret="tree")
+def predict_raw_ensemble_exact(stacked, X: Array, n_class: int = 1,
+                               convert=None):
+    """Device-resident EXACT raw scores: traversal + bit-exact f64
+    leaf-value accumulation in one program (the serving fast path).
+
+    `stacked` is the `predict_leaf_ensemble` dict plus two u32 planes
+    `value_hi` / `value_lo` [T, NL] — the bit halves of the f64 leaf
+    table (`Booster.export_predict_arrays`).  Each scan step routes the
+    batch through one tree (`_leaf_slots`, shared with the slot
+    program, so routing is bitwise identical), gathers the leaf's bit
+    pair and adds it into the accumulator with `_f64_add_bits` — the
+    same value, in the same tree order, with the same per-step rounding
+    as the host walk's `raw[:, i % K] += leaf_values[i, slots]`.
+    Multiclass carries one accumulator pair per class and each step
+    updates column `cls` (the host walk's i % K interleaving).
+
+    Returns the raw accumulator bit planes `(hi, lo)` — [N]/[N, K]
+    u32 each, 8 bytes per score over the wire — when `convert` is None;
+    otherwise folds the objective's `convert_output` into the program
+    (applied to the RNE f32 downcast of the raw sum, exactly like the
+    host's `jnp.asarray(raw)` under disabled x64) and returns finished
+    f32 scores, 4 bytes per score.  Either way D2H is O(N*K), not the
+    slot program's O(T*N).
+    """
+    if n_class > 1:
+        shape = (X.shape[0], n_class)
+    else:
+        shape = (X.shape[0],)
+
+    def step(carry, tree):
+        chi, clo = carry
+        slots = _leaf_slots(tree["feat"], tree["thr"], tree["dtype"],
+                            tree["left"], tree["right"], X,
+                            cat_words=tree.get("cat_words"),
+                            cat_nwords=tree.get("cat_nwords"))
+        vhi = tree["value_hi"][slots]
+        vlo = tree["value_lo"][slots]
+        if n_class > 1:
+            k = tree["cls"]
+            nhi, nlo = _f64_add_bits(chi[:, k], clo[:, k], vhi, vlo)
+            return (chi.at[:, k].set(nhi), clo.at[:, k].set(nlo)), None
+        nhi, nlo = _f64_add_bits(chi, clo, vhi, vlo)
+        return (nhi, nlo), None
+
+    with jax.named_scope("predict_ensemble_exact"):
+        init = (jnp.zeros(shape, jnp.uint32), jnp.zeros(shape, jnp.uint32))
+        (hi, lo), _ = jax.lax.scan(step, init, stacked)
+        if convert is None:
+            return hi, lo
+        return convert(_f64_bits_to_f32(hi, lo))
+
+
 @contract(stacked="tree", X="[N, F] float", ret="[T, N] i32")
 def predict_leaf_ensemble(stacked, X: Array) -> Array:
     """Per-tree leaf slots over padded stacked tree arrays (serving path).

@@ -72,6 +72,12 @@ class MicroBatcher:
         self.deadline_s = max(float(deadline_ms), 0.0) / 1000.0
         self._q: "queue.Queue[_Request]" = queue.Queue(
             maxsize=max(int(queue_depth), 1))
+        # flush staging, keyed by feature width: requests are written
+        # straight into this buffer (one copy, no np.concatenate
+        # intermediate).  Only the single worker thread touches it, and
+        # the runtime consumes the batch synchronously inside
+        # `predict`, so reuse across flushes is race-free.
+        self._stage: dict = {}
         self._closed = False
         self._worker = threading.Thread(
             target=self._loop, name=f"lgbm-serve-{runtime.name}",
@@ -84,7 +90,12 @@ class MicroBatcher:
         queue sheds immediately (bounded memory under overload)."""
         if self._closed:
             raise ServingClosedError("batcher is closed")
-        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        # already-contiguous f64 input passes through untouched (the
+        # runtime trusts contiguous f64 too, so the request path does
+        # zero redundant host copies end to end)
+        X = np.asarray(X, dtype=np.float64)
+        if not X.flags["C_CONTIGUOUS"]:
+            X = np.ascontiguousarray(X)
         if X.ndim == 1:
             X = X.reshape(1, -1)
         deadline = (time.monotonic() + self.deadline_s) \
@@ -164,8 +175,21 @@ class MicroBatcher:
 
     def _run_group(self, reqs: List[_Request], raw: bool) -> None:
         try:
-            X = reqs[0].X if len(reqs) == 1 \
-                else np.concatenate([r.X for r in reqs], axis=0)
+            if len(reqs) == 1:
+                X = reqs[0].X
+            else:
+                total = sum(r.n for r in reqs)
+                w = reqs[0].X.shape[1]
+                buf = self._stage.get(w)
+                if buf is None or buf.shape[0] < total:
+                    buf = np.empty((max(total, self.max_batch_rows), w),
+                                   np.float64)
+                    self._stage[w] = buf
+                lo = 0
+                for r in reqs:
+                    buf[lo:lo + r.n] = r.X
+                    lo += r.n
+                X = buf[:total]
             out = self.runtime.predict(X, raw_score=raw)
             lo = 0
             done_t = time.monotonic()

@@ -40,6 +40,11 @@ class ServingClient:
     def models(self) -> List[str]:
         return self.registry.names()
 
+    def status(self) -> dict:
+        """Registry health snapshot — model names plus stale/demoted
+        entries and per-entry device bytes (the `/healthz` body)."""
+        return self.registry.status()
+
     def predict(self, X, model: str = "default", raw_score: bool = False,
                 timeout: Optional[float] = None):
         return self.registry.predict(X, model=model, raw_score=raw_score,

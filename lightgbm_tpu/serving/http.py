@@ -10,8 +10,11 @@ Endpoints (JSON in/out, no dependencies beyond http.server):
                   bit-exact — byte-identity with `booster.predict`
                   survives the wire (scripts/run_ci.sh smoke asserts
                   this end to end).
-  GET  /healthz   -> {"status": "ok", "models": [...]} (503 when no
-                  model is loaded)
+  GET  /healthz   -> {"status": "ok", "models": [...], "stale": [...],
+                  "demoted": [...], "device_bytes": {...}} (503 when
+                  no model is loaded; `stale` lists models whose
+                  booster mutated since their export — see
+                  ModelRegistry.status)
   GET  /metrics   -> Prometheus text exposition of the process
                   MetricsRegistry (serve.* counters/gauges/timings
                   next to the training metrics)
@@ -71,10 +74,14 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib name)
         telemetry.REGISTRY.counter("serve.http.requests").inc()
         if self.path == "/healthz":
-            models = self.client.models()
+            st = self.client.status()
+            models = st["models"]
             self._send_json(200 if models else 503,
                             {"status": "ok" if models else "no_models",
-                             "models": models})
+                             "models": models,
+                             "stale": st["stale"],
+                             "demoted": st["demoted"],
+                             "device_bytes": st["device_bytes"]})
         elif self.path == "/metrics":
             self._send_text(200, telemetry.REGISTRY.to_prometheus())
         else:

@@ -1,4 +1,4 @@
-"""Multi-model registry: warm-up-on-load, atomic hot-swap.
+"""Multi-model registry: warm-up-on-load, atomic hot-swap, budgeting.
 
 `load()` builds the full serving stack for a model — export, optional
 all-bucket warm-up, micro-batcher — **before** the name becomes
@@ -6,10 +6,27 @@ visible, then swaps it in under the registry lock.  A hot-swap
 therefore never serves a cold model: readers resolve either the whole
 old entry or the whole new one, and the old entry's batcher is closed
 only after the swap (in-flight requests on it complete).
+
+Co-residency budgeting (`serve_vram_budget_mb`, 0 = unlimited): each
+entry accounts its export's device bytes (stacked traversal planes +
+leaf-value bit planes, `ServingRuntime.device_bytes`).  A load that
+would exceed the budget first DEMOTES least-recently-used entries
+(their device arrays move to host copies — they keep serving
+bit-identical results, re-uploading per call, until a `refresh()`
+re-promotes them) and, if still over, is rejected with a clear
+`LightGBMError` while every already-loaded model keeps serving —
+budget pressure degrades throughput, never availability or
+correctness.
+
+Staleness: `status()` reports entries whose booster mutated since
+their last export (`ServingRuntime.stale`) — surfaced in `/healthz`
+and the `serve.stale` gauge; with `serve_auto_refresh` the entry
+re-exports itself on the next predict instead.
 """
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Union
 
 from .. import telemetry
@@ -23,13 +40,19 @@ class ServingModel:
     """One registered model: its runtime + micro-batcher."""
 
     def __init__(self, name: str, runtime: ServingRuntime,
-                 batcher: MicroBatcher):
+                 batcher: MicroBatcher, auto_refresh: bool = False):
         self.name = name
         self.runtime = runtime
         self.batcher = batcher
+        self.auto_refresh = auto_refresh
+        self.last_used = time.monotonic()
 
     def predict(self, X, raw_score: bool = False,
                 timeout: Optional[float] = None):
+        self.last_used = time.monotonic()
+        if self.auto_refresh and self.runtime.stale():
+            telemetry.REGISTRY.counter("serve.auto_refresh").inc()
+            self.runtime.refresh()
         return self.batcher.predict(X, raw_score=raw_score,
                                     timeout=timeout)
 
@@ -42,8 +65,9 @@ class ModelRegistry:
 
     `params` takes the serving knobs (`serve_max_batch_rows`,
     `serve_max_wait_ms`, `serve_queue_depth`, `serve_deadline_ms`,
-    `serve_warmup` — aliases resolve through utils/config.py like every
-    other param).
+    `serve_warmup`, `serve_device_sum`, `serve_vram_budget_mb`,
+    `serve_auto_refresh` — aliases resolve through utils/config.py
+    like every other param).
     """
 
     def __init__(self, params: Optional[dict] = None):
@@ -55,7 +79,10 @@ class ModelRegistry:
     def load(self, name: str, model: Union[str, object], *,
              warmup: Optional[bool] = None) -> ServingModel:
         """Register `model` (a Booster or a model-file path) under
-        `name`, warmed up, replacing any previous holder atomically."""
+        `name`, warmed up, replacing any previous holder atomically.
+        Raises `LightGBMError` without touching the registry when the
+        export would not fit `serve_vram_budget_mb` even after LRU
+        demotion of the other entries."""
         from ..booster import Booster
         booster = model if isinstance(model, Booster) \
             else Booster(model_file=str(model))
@@ -63,7 +90,8 @@ class ModelRegistry:
         with telemetry.span("serve.load", model=name):
             runtime = ServingRuntime(
                 booster, max_batch_rows=cfg.serve_max_batch_rows,
-                name=name)
+                name=name, device_sum=cfg.serve_device_sum)
+            self._admit(name, runtime)
             if cfg.serve_warmup if warmup is None else warmup:
                 runtime.warmup()
             batcher = MicroBatcher(
@@ -71,16 +99,54 @@ class ModelRegistry:
                 max_wait_ms=cfg.serve_max_wait_ms,
                 queue_depth=cfg.serve_queue_depth,
                 deadline_ms=cfg.serve_deadline_ms)
-            entry = ServingModel(name, runtime, batcher)
+            entry = ServingModel(name, runtime, batcher,
+                                 auto_refresh=cfg.serve_auto_refresh)
         with self._lock:
             old = self._models.get(name)
             self._models[name] = entry
             telemetry.REGISTRY.gauge("serve.models").set(
                 len(self._models))
         telemetry.REGISTRY.counter("serve.model_loads").inc()
+        self._update_vram_gauge()
         if old is not None:
             old.close()
         return entry
+
+    def _admit(self, name: str, runtime: ServingRuntime) -> None:
+        """Budget gate for a new export: demote LRU entries until the
+        newcomer fits, else reject it — loaded models keep serving
+        either way.  (Concurrent loads race the check benignly: the
+        budget bounds steady state, not the swap instant.)"""
+        budget = int(self._config.serve_vram_budget_mb * (1 << 20))
+        if budget <= 0:
+            return
+        need = runtime.device_bytes()
+        with self._lock:
+            others = [e for n, e in self._models.items() if n != name]
+        used = sum(e.runtime.device_bytes() for e in others)
+        if used + need > budget:
+            for e in sorted(others, key=lambda e: e.last_used):
+                if used + need <= budget:
+                    break
+                freed = e.runtime.demote()
+                if freed:
+                    telemetry.event("serve.demote", model=e.name,
+                                    freed_bytes=freed)
+                    used -= freed
+        self._update_vram_gauge()
+        if used + need > budget:
+            raise LightGBMError(
+                f"serving model {name!r} needs {need} device bytes but "
+                f"only {max(budget - used, 0)} of the "
+                f"serve_vram_budget_mb={self._config.serve_vram_budget_mb:g}"
+                f" budget remain ({used} in use); raise the budget or "
+                f"unload a model — already-loaded models keep serving")
+
+    def _update_vram_gauge(self) -> None:
+        with self._lock:
+            total = sum(e.runtime.device_bytes()
+                        for e in self._models.values())
+        telemetry.REGISTRY.gauge("serve.vram_bytes").set(total)
 
     def unload(self, name: str) -> None:
         with self._lock:
@@ -89,6 +155,7 @@ class ModelRegistry:
                 len(self._models))
         if entry is not None:
             entry.close()
+        self._update_vram_gauge()
 
     # ------------------------------------------------------------ lookup
     def get(self, name: str = "default") -> ServingModel:
@@ -103,6 +170,23 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._models)
 
+    def status(self) -> Dict:
+        """Registry health snapshot (the `/healthz` payload body):
+        model names, entries whose booster mutated since export
+        (`stale`), demoted entries, and per-entry device bytes.  Also
+        refreshes the `serve.stale` gauge."""
+        with self._lock:
+            entries = dict(self._models)
+        stale = sorted(n for n, e in entries.items()
+                       if e.runtime.stale())
+        telemetry.REGISTRY.gauge("serve.stale").set(len(stale))
+        return {"models": sorted(entries),
+                "stale": stale,
+                "demoted": sorted(n for n, e in entries.items()
+                                  if e.runtime.demoted),
+                "device_bytes": {n: e.runtime.device_bytes()
+                                 for n, e in sorted(entries.items())}}
+
     def predict(self, X, model: str = "default", raw_score: bool = False,
                 timeout: Optional[float] = None):
         return self.get(model).predict(X, raw_score=raw_score,
@@ -116,3 +200,4 @@ class ModelRegistry:
             telemetry.REGISTRY.gauge("serve.models").set(0)
         for e in entries:
             e.close()
+        telemetry.REGISTRY.gauge("serve.vram_bytes").set(0)

@@ -1,29 +1,41 @@
-"""Device-resident serving runtime: bucketed jit programs + exact host sum.
+"""Device-resident serving runtime: bucketed jit programs, exact sums.
 
 The booster exports once (`Booster.export_predict_arrays`) into stacked
 traversal arrays; every request is padded to a power-of-two row bucket,
-so the ONE module-level jitted program compiles at most once per bucket
-— total compiles are bounded by the bucket count (log2(cap)+1) no
-matter how ragged the request-size distribution is.  The bound is
-asserted through the PR 3 `jax.monitoring` recompile listener in
-tests/test_serving.py.
+so each module-level jitted program compiles at most once per bucket —
+total compiles are bounded by the bucket count (log2(cap)+1) per
+program no matter how ragged the request-size distribution is.  The
+bound is asserted through the PR 3 `jax.monitoring` recompile listener
+in tests/test_serving.py.
 
-Byte-identity with `booster.predict`: the device program
-(`ops.predict.predict_leaf_ensemble`) returns per-tree LEAF SLOTS only.
-Leaf values are gathered on host from the export's f64 table and
-accumulated tree-by-tree in boosting order — the same f64 summation the
-host walk performs — then passed through the identical
-`objective_.convert_output` expression.  Rows are independent under the
-per-row `while_loop` traversal, so a padded batch's real-row slots are
-bitwise equal to the unpadded batch's.
+Fallback ladder (every rung byte-identical to `booster.predict`):
+
+  1. device-sum  — `ops.predict.predict_raw_ensemble_exact`: traversal
+     AND f64 leaf accumulation on device (software binary64 over u32
+     bit planes), `convert_output` folded into the program.  D2H is
+     N*K scores (8 B raw / 4 B converted each), not T*N slots.  Gated
+     by an export-time parity probe: the device sum must bit-match the
+     host f64 reference on the probe batch or the model degrades one
+     rung and `serve.device_sum_disabled` counts it.
+  2. slot path   — `ops.predict.predict_leaf_ensemble` returns [T, N]
+     i32 leaf slots; leaf values are gathered on host from the
+     export's f64 table and accumulated tree-by-tree in boosting
+     order, then passed through the identical eager `convert_output`.
+  3. host walk   — tree.py f64 walk (device errors, linear trees, X
+     narrower than the stacked arrays).
+
+Rows are independent under the per-row `while_loop` traversal, so a
+padded batch's real-row results are bitwise equal to the unpadded
+batch's.
 
 f32 routing caveat (same as `booster._predict_raw_device`): features
 and thresholds are cast to f32 on device, so a row lying within f32
 epsilon of a split threshold can route differently from the f64 host
 walk.  Thresholds are bin-edge midpoints, so real data essentially
 never sits there; the host fallback walk remains the exact-f64
-reference path and is used automatically when the device program
-errors or the model cannot be stacked (linear trees).
+reference path.  Both device rungs share `_leaf_slots`, so they route
+identically — the probe therefore isolates ACCUMULATION parity, which
+is exactly the property the device-sum rung adds.
 """
 from __future__ import annotations
 
@@ -37,16 +49,21 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
-from ..ops.predict import predict_leaf_ensemble
+from ..ops.predict import predict_leaf_ensemble, predict_raw_ensemble_exact
 
 #: padding cap (and the micro-batcher's default flush threshold): with
 #: power-of-two buckets this caps the compile count at log2(4096)+1 = 13
 DEFAULT_MAX_BATCH_ROWS = 4096
 
-# ONE process-wide jitted program: its shape-keyed compile cache IS the
-# bucket bound.  A per-runtime `jax.jit` would re-own the cache per
-# model load and re-trip graft-lint R002's factory-per-call trap.
+# ONE process-wide jitted program each: their shape-keyed compile
+# caches ARE the bucket bound.  A per-runtime `jax.jit` would re-own
+# the cache per model load and re-trip graft-lint R002's
+# factory-per-call trap.  `convert` is a bound method of the booster's
+# objective — stable hash/eq per booster instance, so it keys the
+# cache without recompiling per call.
 _LEAF_JIT = jax.jit(predict_leaf_ensemble)
+_EXACT_JIT = jax.jit(predict_raw_ensemble_exact,
+                     static_argnames=("n_class", "convert"))
 
 
 def bucket_rows(n: int, max_rows: int = DEFAULT_MAX_BATCH_ROWS) -> int:
@@ -67,19 +84,30 @@ class ServingRuntime:
     Thread-safe: `predict` snapshots the export once per call, and
     `refresh` swaps it atomically — concurrent requests either see the
     whole old model or the whole new one, never a mix.
+
+    `device_sum` selects the top ladder rung: "auto" (default) enables
+    the exact device-sum program only after the export-time parity
+    probe bit-matches, "force" skips the probe (tests/benches of the
+    machinery), "off" pins the slot path.
     """
 
     def __init__(self, booster, *,
                  max_batch_rows: int = DEFAULT_MAX_BATCH_ROWS,
                  start_iteration: int = 0,
                  num_iteration: Optional[int] = None,
-                 name: str = "default"):
+                 name: str = "default",
+                 device_sum: str = "auto"):
         self._booster = booster
         self.name = name
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self._start = start_iteration
         self._num = num_iteration
+        self._device_sum_mode = str(device_sum).lower()
+        self._device_sum_ok = False
+        self.demoted = False
         self._refresh_lock = threading.Lock()
+        self._staging_lock = threading.Lock()
+        self._staging: Dict = {}
         self._export: Dict = {}
         self.refresh()
 
@@ -88,10 +116,13 @@ class ServingRuntime:
         """(Re-)export the booster — picks up continued training,
         `rollback_one_iter`, and `refit`-style in-place mutations (the
         export cache is `_model_version`-keyed, so an unchanged model
-        costs one dict lookup)."""
+        costs one dict lookup).  Re-runs the device-sum parity probe
+        against the new export and re-promotes a demoted runtime."""
         with self._refresh_lock:
             self._export = self._booster.export_predict_arrays(
                 self._start, self._num)
+            self.demoted = False
+            self._device_sum_ok = self._device_sum_enable(self._export)
 
     def stale(self) -> bool:
         """Has the booster mutated since the last refresh()?"""
@@ -99,11 +130,147 @@ class ServingRuntime:
             self._booster, "_model_version", 0)
 
     @property
+    def device_sum_active(self) -> bool:
+        """Is the device-sum rung serving (probe passed, not off)?"""
+        return self._device_sum_ok
+
+    @property
     def num_class(self) -> int:
         return self._export["num_class"]
 
     def num_feature(self) -> int:
         return int(self._booster.num_feature())
+
+    def device_bytes(self) -> int:
+        """Accelerator-resident bytes of this runtime's export (stacked
+        traversal planes + leaf-value bit planes) — the registry's
+        `serve_vram_budget_mb` accounting unit.  0 after `demote()`."""
+        ex = self._export
+        if self.demoted or not ex:
+            return 0
+        total = 0
+        st = ex.get("stacked")
+        if st:
+            total += sum(int(v.nbytes) for v in st.values()
+                         if hasattr(v, "nbytes"))
+        for k in ("value_hi", "value_lo"):
+            if ex.get(k) is not None:
+                total += int(ex[k].nbytes)
+        return total
+
+    def demote(self) -> int:
+        """Move the export's device arrays to host copies (the
+        registry's LRU budget demotion).  The runtime keeps serving
+        bit-identical results — the jitted programs re-upload per call
+        — at reduced throughput until the next `refresh()` promotes it
+        back.  Returns the device bytes freed."""
+        with self._refresh_lock:
+            freed = self.device_bytes()
+            if freed == 0:
+                return 0
+            ex = dict(self._export)
+            st = ex.get("stacked")
+            if st:
+                ex["stacked"] = {
+                    k: np.asarray(v) if isinstance(v, jax.Array) else v
+                    for k, v in st.items()}
+            for k in ("value_hi", "value_lo"):
+                if ex.get(k) is not None:
+                    ex[k] = np.asarray(ex[k])
+            self._export = ex
+            # the booster-side export cache pins the same device
+            # buffers — drop it so they can actually free
+            if getattr(self._booster, "_serving_export_cache",
+                       None) is not None:
+                self._booster._serving_export_cache = None
+            self.demoted = True
+        telemetry.REGISTRY.counter("serve.demotions").inc()
+        return freed
+
+    # -------------------------------------------------- device-sum gate
+    def _device_sum_enable(self, ex: Dict) -> bool:
+        """Decide the top ladder rung for this export (refresh-time)."""
+        if self._device_sum_mode == "off":
+            return False
+        if ex["stacked"] is None or not ex["trees"] \
+                or ex.get("value_hi") is None:
+            return False
+        if ex["average_factor"] != 1:
+            # RF averaging would need f64 division on device — the
+            # slot path serves these models exactly instead
+            return False
+        if self._device_sum_mode == "force":
+            return True
+        ok = self._probe_device_sum(ex)
+        if not ok:
+            telemetry.REGISTRY.counter("serve.device_sum_disabled").inc()
+            telemetry.event("serve.device_sum_disabled", model=self.name)
+        return ok
+
+    def _probe_device_sum(self, ex: Dict) -> bool:
+        """Export-time exact-parity gate (the `_probe_fused` pattern
+        from ops/pallas_hist.py): the device-sum program must
+        bit-match the host f64 gather/sum over the SAME device slots —
+        raw and converted — on a threshold-clustered probe batch, or
+        the model degrades to the slot path.  Any exception counts as
+        a failed probe (a broken rung must degrade, not raise)."""
+        try:
+            # single-chunk probe: stay within the bucket cap so the
+            # staging buffer fits (small-bucket runtimes probe small)
+            X = self._probe_batch(ex, rows=min(256, self.max_batch_rows))
+            slots = self._device_slots_chunk(X, ex["stacked"])
+            K = ex["num_class"]
+            leaf_values = ex["leaf_values"]
+            want = np.zeros((X.shape[0], K), np.float64)
+            for i in range(slots.shape[0]):
+                want[:, i % K] += leaf_values[i, slots[i]]
+            if K == 1:
+                want = want[:, 0]
+            got = self._device_sum_chunk(X, ex, want_raw=True)
+            if got.shape != want.shape or not np.array_equal(
+                    got.view(np.uint64), want.view(np.uint64)):
+                return False
+            obj = self._booster.objective_
+            if obj is not None:
+                got_c = self._device_sum_chunk(X, ex, want_raw=False)
+                want_c = self._convert(want)
+                if got_c.shape != want_c.shape \
+                        or got_c.dtype != want_c.dtype \
+                        or not np.array_equal(got_c.view(np.uint32),
+                                              want_c.view(np.uint32)):
+                    return False
+            return True
+        except Exception as e:
+            telemetry.event("serve.device_sum_probe_error",
+                            model=self.name, error=str(e)[:200])
+            return False
+
+    def _probe_batch(self, ex: Dict, rows: int = 256) -> np.ndarray:
+        """Deterministic adversarial probe batch: feature values
+        clustered at the model's own split thresholds (maximum routing
+        and accumulation diversity), NaN/zero sprinkles for the
+        missing-value paths, plus plain gaussian noise so large
+        exponent gaps and cancellations in the adder all fire."""
+        nf = max(self.num_feature(), int(ex["stacked"]["min_features"]), 1)
+        rng = np.random.RandomState(0)
+        X = rng.randn(rows, nf)
+        thr, feats = [], []
+        for t in ex["trees"]:
+            k = max(t.num_leaves - 1, 0)
+            thr.append(np.asarray(t.threshold[:k], np.float64))
+            feats.append(np.asarray(t.split_feature[:k], np.int64))
+        if thr:
+            thr = np.concatenate(thr)
+            feats = np.concatenate(feats)
+            for f in np.unique(feats):
+                v = thr[feats == f]
+                pick = v[rng.randint(len(v), size=rows)]
+                noise = rng.randn(rows) * (np.std(v) + 1e-3)
+                X[:, f] = np.where(rng.rand(rows) < 0.5, pick,
+                                   pick + noise)
+        X[rng.rand(rows, nf) < 0.03] = np.nan
+        X[rng.rand(rows, nf) < 0.03] = 0.0
+        return np.ascontiguousarray(X)
 
     def buckets(self) -> List[int]:
         """Every padding bucket this runtime can present to the device."""
@@ -117,8 +284,12 @@ class ServingRuntime:
 
     def warmup(self) -> int:
         """Compile every padding bucket up front (warm-up-on-load), so
-        no live request ever pays a device compile.  Uses the model's
-        full feature width — the jit cache is keyed on [bucket, F], so
+        no live request ever pays a device compile: the slot program,
+        the device-sum programs (raw + converted) when active, AND the
+        eager `convert_output` per-bucket compiles — the eager path
+        stays live on the fallback ladder, so a degradation must not
+        reintroduce a first-request compile.  Uses the model's full
+        feature width — the jit caches are keyed on [bucket, F], so
         warming a narrower matrix would not count.  Returns the number
         of buckets warmed (0 when the model is host-walk only)."""
         ex = self._export
@@ -126,12 +297,21 @@ class ServingRuntime:
             return 0
         nf = max(self.num_feature(), int(ex["stacked"]["min_features"]))
         sizes = self.buckets()
+        obj = self._booster.objective_
+        K = ex["num_class"]
         with telemetry.span("serve.warmup", model=self.name,
                             buckets=len(sizes)):
             t0 = time.perf_counter()
             for b in sizes:
-                self._device_slots_chunk(np.zeros((b, nf), np.float64),
-                                         ex["stacked"])
+                Z = np.zeros((b, nf), np.float64)
+                self._device_slots_chunk(Z, ex["stacked"])
+                if self._device_sum_ok:
+                    self._device_sum_chunk(Z, ex, want_raw=True)
+                    if obj is not None:
+                        self._device_sum_chunk(Z, ex, want_raw=False)
+                if obj is not None:
+                    shape = (b,) if K == 1 else (b, K)
+                    self._convert(np.zeros(shape, np.float64))
             telemetry.REGISTRY.timing("serve.warmup").observe(
                 time.perf_counter() - t0)
         return len(sizes)
@@ -139,23 +319,77 @@ class ServingRuntime:
     # ----------------------------------------------------------- predict
     def predict(self, X, raw_score: bool = False) -> np.ndarray:
         """Bucket-padded device prediction, byte-identical to
-        `booster.predict(X, raw_score=...)` (device errors fall back to
-        the host walk transparently)."""
-        X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+        `booster.predict(X, raw_score=...)` (ladder rungs degrade
+        transparently on device errors)."""
+        if not (isinstance(X, np.ndarray) and X.dtype == np.float64
+                and X.flags["C_CONTIGUOUS"]):
+            # the micro-batcher hands over already-normalized arrays —
+            # don't copy a contiguous f64 matrix a second time
+            X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
         if X.ndim == 1:
             X = X.reshape(1, -1)
         n = X.shape[0]
         ex = self._export
         with telemetry.span("serve.predict", model=self.name, rows=n):
             t0 = time.perf_counter()
-            raw = self._raw(X, ex)
-            out = raw if raw_score or self._booster.objective_ is None \
-                else self._convert(raw)
+            want_raw = raw_score or self._booster.objective_ is None
+            out = None
+            if self._device_sum_ok and ex["trees"]:
+                out = self._device_sum(X, ex, want_raw)
+            if out is None:
+                raw = self._raw(X, ex)
+                out = raw if want_raw else self._convert(raw)
             telemetry.REGISTRY.timing("serve.predict").observe(
                 time.perf_counter() - t0)
         telemetry.REGISTRY.counter("serve.rows").inc(n)
         return out
 
+    # ----------------------------------------------- rung 1: device sum
+    def _device_sum(self, X: np.ndarray, ex: Dict,
+                    want_raw: bool) -> Optional[np.ndarray]:
+        """Finished scores straight off the device, or None when the
+        next rung (slot path) must take over."""
+        stacked = ex["stacked"]
+        if X.shape[1] < stacked["min_features"] or X.shape[0] == 0:
+            return None
+        try:
+            outs = [self._device_sum_chunk(
+                        X[lo:lo + self.max_batch_rows], ex, want_raw)
+                    for lo in range(0, X.shape[0], self.max_batch_rows)]
+        except Exception as e:
+            telemetry.REGISTRY.counter("serve.device_errors").inc()
+            telemetry.event("serve.device_error", model=self.name,
+                            path="device_sum", error=str(e)[:200])
+            return None
+        telemetry.REGISTRY.counter("serve.device_sum").inc()
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def _device_sum_chunk(self, Xc: np.ndarray, ex: Dict,
+                          want_raw: bool) -> np.ndarray:
+        b = bucket_rows(Xc.shape[0], self.max_batch_rows)
+        Xd = self._stage32(Xc, b)
+        stacked = ex["stacked"]
+        arrays = {k: v for k, v in stacked.items()
+                  if k not in ("min_features", "value")}
+        arrays["value_hi"] = ex["value_hi"]
+        arrays["value_lo"] = ex["value_lo"]
+        K = ex["num_class"]
+        conv = None if want_raw else self._booster.objective_.convert_output
+        out = _EXACT_JIT(arrays, Xd, n_class=K, convert=conv)
+        n = Xc.shape[0]
+        if want_raw:
+            hi = np.asarray(jax.device_get(out[0]))
+            lo = np.asarray(jax.device_get(out[1]))
+            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                hi.nbytes + lo.nbytes)
+            raw = ((hi.astype(np.uint64) << np.uint64(32))
+                   | lo).view(np.float64)
+            return raw[:n]
+        o = np.asarray(jax.device_get(out))
+        telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
+        return o[:n]
+
+    # ------------------------------------------- rungs 2+3: slots, host
     def _raw(self, X: np.ndarray, ex: Dict) -> np.ndarray:
         """Exact f64 raw scores: device leaf slots (bucketed) + host
         gather/sum in tree order — the host walk's summation, verbatim."""
@@ -173,6 +407,7 @@ class ServingRuntime:
                 for i, t in enumerate(trees):
                     raw[:, i % K] += t.predict(X)
         elif trees:
+            telemetry.REGISTRY.counter("serve.slot_path").inc()
             leaf_values = ex["leaf_values"]
             for i in range(len(trees)):
                 raw[:, i % K] += leaf_values[i, slots[i]]
@@ -207,16 +442,33 @@ class ServingRuntime:
                             stacked: Dict) -> np.ndarray:
         n = Xc.shape[0]
         b = bucket_rows(n, self.max_batch_rows)
-        # f64 -> f32 saturates huge values to inf — the routing we want
-        # (same errstate rationale as booster._predict_raw_device); the
-        # padding rows stay 0.0 and their slots are sliced away below
-        with np.errstate(over="ignore"):
-            Xp = np.zeros((b, Xc.shape[1]), np.float32)
-            Xp[:n] = Xc
+        Xd = self._stage32(Xc, b)
         arrays = {k: v for k, v in stacked.items()
                   if k not in ("min_features", "value")}
-        out = _LEAF_JIT(arrays, jnp.asarray(Xp))
-        return np.asarray(jax.device_get(out))[:, :n]
+        out = _LEAF_JIT(arrays, Xd)
+        slots = np.asarray(jax.device_get(out))
+        telemetry.REGISTRY.counter("serve.d2h_bytes").inc(slots.nbytes)
+        return slots[:, :n]
+
+    def _stage32(self, Xc: np.ndarray, b: int):
+        """Pad `Xc` into a reused per-(bucket, width) f32 staging
+        buffer and hand the device a COPY (`jnp.array` copies by
+        default), so the buffer is reusable the moment this returns.
+        f64 -> f32 saturates huge values to inf — the routing we want
+        (same errstate rationale as booster._predict_raw_device); the
+        padding rows stay 0.0 and are sliced away by the callers.  The
+        lock covers concurrent `predict` callers sharing a bucket."""
+        n = Xc.shape[0]
+        key = (b, Xc.shape[1])
+        with self._staging_lock:
+            buf = self._staging.get(key)
+            if buf is None:
+                buf = np.empty((b, Xc.shape[1]), np.float32)
+                self._staging[key] = buf
+            with np.errstate(over="ignore"):
+                buf[:n] = Xc
+            buf[n:] = 0.0
+            return jnp.array(buf)
 
     def _convert(self, raw: np.ndarray) -> np.ndarray:
         """`objective_.convert_output`, bucket-padded: conversions are

@@ -91,6 +91,19 @@ RULES: List[Tuple[str, str, str]] = [
     ("*serving.p50_ms", "up_is_bad", "timing"),
     ("*serving.p99_ms", "up_is_bad", "timing"),
     ("*serving.rows_per_sec", "down_is_bad", "timing"),
+    # device-sum rung sentinels: `active` flipping 1 -> 0 or the
+    # disabled/demotion counters growing means the exact device-sum
+    # path silently fell back to the slot path — fail hard.  The
+    # per-rung bench stats are wall-clock (timing class); the slot-path
+    # comparison block is informational (the rung we WANT to lose).
+    ("*serve.device_sum_disabled", "up_is_bad", "counter"),
+    ("*serve.demotions", "up_is_bad", "counter"),
+    ("*serving.device_sum.active", "down_is_bad", "counter"),
+    ("*serving.device_sum.d2h_bytes_per_row", "up_is_bad", "counter"),
+    ("*serving.device_sum.rows_per_sec", "down_is_bad", "timing"),
+    ("*serving.device_sum.p50_ms", "up_is_bad", "timing"),
+    ("*serving.device_sum.p99_ms", "up_is_bad", "timing"),
+    ("*serving.slot_path.*", "ignore", "timing"),
     ("*serve.shed", "up_is_bad", "counter"),
     ("*serve.device_errors", "up_is_bad", "counter"),
     ("gauges.serve.*", "ignore", "counter"),

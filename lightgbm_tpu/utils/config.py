@@ -248,6 +248,19 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # compile every padding bucket at model load (warm-up-on-load) so
     # no live request pays a device compile
     "serve_warmup": (True, "bool", ()),
+    # device-resident exact accumulation (ops/predict.py
+    # predict_raw_ensemble_exact): "auto" enables it per model only
+    # after the export-time parity probe bit-matches the host f64
+    # reference; "force" skips the probe; "off" pins the slot path
+    "serve_device_sum": ("auto", "str", ("device_sum",)),
+    # co-residency budget for registry exports in MB (stacked traversal
+    # planes + leaf-value bit planes); a load over budget demotes LRU
+    # entries to host copies and, still over, is rejected with a clear
+    # error.  0 = unlimited
+    "serve_vram_budget_mb": (0.0, "float", ("vram_budget_mb",)),
+    # re-export a stale runtime (booster mutated since load) on the
+    # next predict instead of only reporting it via /healthz
+    "serve_auto_refresh": (False, "bool", ("auto_refresh",)),
     # HTTP frontend bind address (python -m lightgbm_tpu serve)
     "serve_host": ("127.0.0.1", "str", ()),
     "serve_port": (8080, "int", ()),

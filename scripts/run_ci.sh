@@ -132,6 +132,42 @@ client.close()
 print("[run_ci] serving smoke: HTTP parity + healthz + metrics OK")
 EOF
 
+# device-sum parity smoke: the exact on-device accumulation rung must
+# pass its probe on a golden model and serve bytes identical to
+# booster.predict, raw and transformed, with the N*K-score D2H payload
+# (not T*N slots).  The per-family matrix + probe-degradation cases
+# live in tests/test_serving.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import sys
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import ServingRuntime, bucket_rows
+
+bst = Booster(model_file="tests/data/golden_multiclass.model.txt")
+X, _ = make_case_data(GOLDEN_CASES["multiclass"])
+rt = ServingRuntime(bst)
+assert rt.device_sum_active, "device-sum parity probe failed"
+d2h = telemetry.REGISTRY.counter("serve.d2h_bytes")
+before = d2h.value
+for raw in (True, False):
+    got = rt.predict(X[:300], raw_score=raw)
+    want = bst.predict(X[:300], raw_score=raw)
+    assert got.dtype == want.dtype and np.array_equal(got, want), \
+        f"device-sum != booster.predict (raw={raw})"
+K = rt.num_class
+moved = d2h.value - before
+assert moved == bucket_rows(300) * K * (8 + 4), \
+    f"D2H {moved} B is not N*K scores"
+assert telemetry.REGISTRY.counter("serve.device_sum").value >= 2
+print("[run_ci] device-sum smoke: exact parity, "
+      f"{moved} B D2H for 2x300x{K} scores")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

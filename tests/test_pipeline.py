@@ -212,10 +212,16 @@ class TestInstrumentation:
 
     def test_flight_round_records_depth(self):
         X, y = make_data(1500)
-        bst = Booster(params={"objective": "binary", "num_leaves": 15,
-                              "verbosity": -1, "flight_recorder": True,
-                              "tpu_pipeline_chunks": 2},
-                      train_set=lgb.Dataset(X, label=y))
-        bst.update_many(32)
+        forced = telemetry.TRACER._forced
+        try:
+            bst = Booster(params={"objective": "binary", "num_leaves": 15,
+                                  "verbosity": -1, "flight_recorder": True,
+                                  "tpu_pipeline_chunks": 2},
+                          train_set=lgb.Dataset(X, label=y))
+            bst.update_many(32)
+        finally:
+            # flight_recorder force-enables span recording process-wide;
+            # restore so later tests see the default-inactive tracer
+            telemetry.TRACER.enable(forced)
         recs = list(bst._flight.ring)
         assert recs and all(r.get("pipeline_depth") == 2 for r in recs)

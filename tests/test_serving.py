@@ -1,14 +1,22 @@
 """Serving subsystem: bucketed runtime, micro-batcher, registry, HTTP.
 
-The two load-bearing claims (ISSUE acceptance criteria):
+The load-bearing claims (ISSUE acceptance criteria):
 
 * BYTE-identity — `ServingRuntime.predict` must equal
   `booster.predict` bit-for-bit on every golden family, raw and
-  transformed, because the device program returns leaf SLOTS only and
-  the f64 gather/sum happens on host in tree order (runtime.py).
+  transformed, on EVERY ladder rung: the device-sum rung (software
+  binary64 accumulation on device), the slot rung (device slots + host
+  f64 gather/sum in tree order), and the host walk.
+* PROBE gate — a device-sum rung that cannot bit-match the host
+  reference must degrade to the slot path at refresh time (counted in
+  `serve.device_sum_disabled`), never serve wrong bytes.
+* D2H — the device-sum rung moves N*K scores per request, not T*N
+  slots, measured through `serve.d2h_bytes`.
 * BOUNDED compiles — 50 ragged request sizes through the micro-batcher
   may compile at most one program per power-of-two bucket, asserted
   through the PR 3 `jax.monitoring` recompile listener.
+* BUDGET — a load exceeding `serve_vram_budget_mb` demotes LRU entries
+  and, still over, is rejected while loaded models keep serving.
 """
 import json
 import threading
@@ -59,14 +67,28 @@ def test_bucket_rows_math():
 # ---------------------------------------------------- golden byte-parity
 @pytest.mark.parametrize("name", sorted(GOLDEN_CASES))
 @pytest.mark.parametrize("raw", [True, False])
-def test_golden_family_byte_parity(name, raw):
+@pytest.mark.parametrize("mode", ["auto", "off"])
+def test_golden_family_byte_parity(name, raw, mode):
+    # mode="auto" exercises the device-sum rung (probe-gated),
+    # mode="off" pins the slot rung — both must be byte-identical
     bst, X = _golden(name)
-    rt = ServingRuntime(bst)
+    rt = ServingRuntime(bst, device_sum=mode)
+    ds = telemetry.REGISTRY.counter("serve.device_sum")
+    sp = telemetry.REGISTRY.counter("serve.slot_path")
+    ds0, sp0 = ds.value, sp.value
     got = rt.predict(X, raw_score=raw)
     want = bst.predict(X, raw_score=raw)
     assert got.dtype == want.dtype and got.shape == want.shape
     assert np.array_equal(got, want), \
-        f"{name} raw={raw}: serving != booster.predict"
+        f"{name} raw={raw} mode={mode}: serving != booster.predict"
+    if mode == "auto":
+        # the probe must actually PASS on every golden family — the
+        # fast path silently never engaging would also "pass" parity
+        assert rt.device_sum_active, f"{name}: device-sum probe failed"
+        assert ds.value > ds0 and sp.value == sp0
+    else:
+        assert not rt.device_sum_active
+        assert ds.value == ds0 and sp.value > sp0
 
 
 def test_padded_tail_rows_exact():
@@ -78,11 +100,87 @@ def test_padded_tail_rows_exact():
         assert np.array_equal(rt.predict(X[:n]), bst.predict(X[:n]))
 
 
+# ------------------------------------------------- device-sum probe gate
+def test_probe_gate_degrades_on_bad_leaf_planes(monkeypatch):
+    # a device-sum rung that cannot bit-match the host reference must
+    # NOT serve: corrupt the hi bit plane the device program sums from
+    # (the slot path's f64 table stays intact) and the refresh-time
+    # probe has to catch the mismatch, count it, and degrade — with
+    # predictions still byte-identical through the slot rung
+    bst, X = _golden("binary")
+    orig = bst.export_predict_arrays
+
+    def bad_export(*a, **k):
+        ex = dict(orig(*a, **k))
+        hi = np.asarray(ex["value_hi"])
+        ex["value_hi"] = srt.jnp.asarray(hi ^ np.uint32(1 << 12))
+        return ex
+
+    monkeypatch.setattr(bst, "export_predict_arrays", bad_export)
+    dis = telemetry.REGISTRY.counter("serve.device_sum_disabled")
+    before = dis.value
+    rt = ServingRuntime(bst)                   # probe runs here
+    assert not rt.device_sum_active
+    assert dis.value == before + 1
+    for raw in (True, False):
+        assert np.array_equal(rt.predict(X[:100], raw_score=raw),
+                              bst.predict(X[:100], raw_score=raw))
+
+
+def test_probe_rungs_share_routing_not_required_for_rf():
+    # average_factor != 1 (random-forest averaging) stays off the
+    # device-sum rung by construction — no probe, no disabled counter
+    rng = np.random.RandomState(5)
+    X = rng.randn(400, 4)
+    y = (X[:, 0] + 0.2 * rng.randn(400) > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "boosting": "rf",
+                     "bagging_freq": 1, "bagging_fraction": 0.6,
+                     "feature_fraction": 0.8, "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=4)
+    dis = telemetry.REGISTRY.counter("serve.device_sum_disabled")
+    before = dis.value
+    rt = ServingRuntime(bst)
+    assert not rt.device_sum_active
+    assert dis.value == before, "RF exclusion is silent, not a failure"
+    assert np.array_equal(rt.predict(X), bst.predict(X))
+
+
+# ------------------------------------------------------- D2H accounting
+def test_d2h_bytes_scores_not_slots():
+    # the point of the device-sum rung: D2H shrinks from T*N slot words
+    # to N*K finished scores (8 B raw hi/lo pair, 4 B converted f32)
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)                   # probe traffic excluded
+    assert rt.device_sum_active
+    c = telemetry.REGISTRY.counter("serve.d2h_bytes")
+    b = bucket_rows(300)                       # 512, K == 1
+
+    before = c.value
+    rt.predict(X[:300], raw_score=True)
+    assert c.value - before == b * 8           # u32 hi + u32 lo planes
+
+    before = c.value
+    rt.predict(X[:300], raw_score=False)
+    assert c.value - before == b * 4           # f32 scores
+
+    off = ServingRuntime(bst, device_sum="off")
+    T = len(off._export["trees"])
+    before = c.value
+    off.predict(X[:300], raw_score=True)
+    slot_bytes = c.value - before
+    assert slot_bytes == T * b * 4             # [T, N] i32 slots
+    assert b * 8 < slot_bytes, "device-sum must move fewer bytes"
+
+
 # ------------------------------------------------------ bounded compiles
 def test_bounded_compiles_under_ragged_load():
     bst, _ = _golden("binary")
     before = _recompiles()
-    rt = ServingRuntime(bst)
+    # slot rung pinned: the device-sum probe compiles its own programs
+    # at construction, which would double-count against the slot bound
+    # (the device-sum bound gets its own test below)
+    rt = ServingRuntime(bst, device_sum="off")
     b = MicroBatcher(rt, max_wait_ms=0.0)
     rng = np.random.RandomState(7)
     sizes = [1, 2, 3, 5, 4095, 4096, 4097] + \
@@ -111,6 +209,32 @@ def test_warmup_precompiles_every_bucket():
                               bst.predict(X[:n], raw_score=True))
     after = telemetry.REGISTRY.counter("jit.recompiles").value
     assert after == before, "request after warmup paid a compile"
+
+
+def test_device_sum_compiles_bounded_and_warmed():
+    # the device-sum rung gets the same padding bound: after warmup
+    # (which also warms the eager convert_output per bucket), ragged
+    # requests — raw AND transformed — pay zero compiles, and the whole
+    # runtime lifetime stays within buckets * programs
+    bst, X = _golden("binary")
+    sizes = (1, 2, 3, 5, 17, 33, 63, 64)
+    # reference predictions first: booster.predict compiles its own
+    # unpadded per-N programs, which must not count against serving
+    wants = {(n, raw): bst.predict(X[:n], raw_score=raw)
+             for n in sizes for raw in (True, False)}
+    before = _recompiles()
+    rt = ServingRuntime(bst, max_batch_rows=64)
+    assert rt.device_sum_active
+    rt.warmup()
+    warmed = telemetry.REGISTRY.counter("jit.recompiles").value
+    # slot + exact-raw + exact-converted + eager convert, one compile
+    # each per bucket at most (construction probe shares bucket shapes)
+    assert warmed - before <= 4 * len(rt.buckets())
+    for (n, raw), want in wants.items():
+        assert np.array_equal(rt.predict(X[:n], raw_score=raw), want)
+    after = telemetry.REGISTRY.counter("jit.recompiles").value
+    assert after == warmed, \
+        "ragged device-sum request after warmup paid a compile"
 
 
 # -------------------------------------------- export cache invalidation
@@ -238,17 +362,44 @@ def test_batcher_deadline_shedding():
 
 
 def test_device_error_falls_back_to_host_walk(monkeypatch):
+    # wedge BOTH device programs after the probe passed: the ladder
+    # must walk device-sum -> slot -> host and still return the exact
+    # bytes, counting each degradation
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)
-    before = telemetry.REGISTRY.counter("serve.fallbacks").value
+    assert rt.device_sum_active
+    fb = telemetry.REGISTRY.counter("serve.fallbacks")
+    de = telemetry.REGISTRY.counter("serve.device_errors")
+    before_fb, before_de = fb.value, de.value
 
     def boom(*a, **k):
         raise RuntimeError("device wedged")
 
+    monkeypatch.setattr(srt, "_EXACT_JIT", boom)
     monkeypatch.setattr(srt, "_LEAF_JIT", boom)
     got = rt.predict(X[:32], raw_score=True)
     assert np.array_equal(got, bst.predict(X[:32], raw_score=True))
-    assert telemetry.REGISTRY.counter("serve.fallbacks").value > before
+    assert fb.value > before_fb
+    assert de.value >= before_de + 2           # one per wedged rung
+
+
+def test_device_sum_error_degrades_one_rung_only(monkeypatch):
+    # only the device-sum program wedged: the slot rung (not the host
+    # walk) takes over, and no host fallback is counted
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    assert rt.device_sum_active
+    fb = telemetry.REGISTRY.counter("serve.fallbacks")
+    sp = telemetry.REGISTRY.counter("serve.slot_path")
+    before_fb, before_sp = fb.value, sp.value
+
+    def boom(*a, **k):
+        raise RuntimeError("device wedged")
+
+    monkeypatch.setattr(srt, "_EXACT_JIT", boom)
+    got = rt.predict(X[:32], raw_score=True)
+    assert np.array_equal(got, bst.predict(X[:32], raw_score=True))
+    assert sp.value > before_sp and fb.value == before_fb
 
 
 # -------------------------------------------------------------- registry
@@ -269,6 +420,95 @@ def test_registry_load_swap_unload():
     finally:
         reg.close()
     assert reg.names() == []
+
+
+def _device_bytes(path):
+    # size an export without engaging the probe (device_bytes is
+    # mode-independent)
+    return ServingRuntime(Booster(model_file=path),
+                          device_sum="off").device_bytes()
+
+
+def test_registry_budget_lru_demotes_then_serves():
+    small_p = "tests/data/golden_binary.model.txt"
+    big_p = "tests/data/golden_multiclass.model.txt"
+    b_small, b_big = _device_bytes(small_p), _device_bytes(big_p)
+    # budget fits either model alone, never both
+    budget_mb = max(b_small, b_big) / float(1 << 20)
+    dem = telemetry.REGISTRY.counter("serve.demotions")
+    before = dem.value
+    reg = ModelRegistry({"serve_warmup": False,
+                         "serve_vram_budget_mb": budget_mb})
+    try:
+        reg.load("small", small_p)
+        reg.load("big", big_p)                 # LRU-demotes "small"
+        assert dem.value == before + 1
+        st = reg.status()
+        assert st["models"] == ["big", "small"]
+        assert st["demoted"] == ["small"]
+        assert st["device_bytes"]["small"] == 0
+        assert st["device_bytes"]["big"] == b_big
+        # a demoted entry keeps serving bit-identical results
+        bs, Xs = _golden("binary")
+        bb, Xb = _golden("multiclass")
+        assert np.array_equal(reg.predict(Xs[:64], model="small"),
+                              bs.predict(Xs[:64]))
+        assert np.array_equal(reg.predict(Xb[:64], model="big"),
+                              bb.predict(Xb[:64]))
+        # refresh re-promotes the demoted entry
+        reg.get("small").runtime.refresh()
+        assert reg.status()["demoted"] == []
+    finally:
+        reg.close()
+
+
+def test_registry_budget_rejects_unfittable_load():
+    fams = {"binary": "tests/data/golden_binary.model.txt",
+            "multiclass": "tests/data/golden_multiclass.model.txt"}
+    sizes = {f: _device_bytes(p) for f, p in fams.items()}
+    small = min(sizes, key=sizes.get)
+    big = max(sizes, key=sizes.get)
+    assert sizes[small] < sizes[big]
+    # budget fits the small model but can NEVER fit the big one
+    budget_mb = ((sizes[small] + sizes[big]) // 2) / float(1 << 20)
+    reg = ModelRegistry({"serve_warmup": False,
+                         "serve_vram_budget_mb": budget_mb})
+    try:
+        reg.load("small", fams[small])
+        with pytest.raises(lgb.LightGBMError,
+                           match="keep serving"):
+            reg.load("big", fams[big])
+        # the failed load demoted "small" trying to make room, but
+        # never touched availability — it still serves, exactly
+        assert reg.names() == ["small"]
+        bs, Xs = _golden(small)
+        assert np.array_equal(reg.predict(Xs[:64], model="small"),
+                              bs.predict(Xs[:64]))
+    finally:
+        reg.close()
+
+
+def test_registry_staleness_and_auto_refresh():
+    bst, X, _ = _train()
+    reg = ModelRegistry({"serve_warmup": False,
+                         "serve_auto_refresh": True})
+    ar = telemetry.REGISTRY.counter("serve.auto_refresh")
+    before = ar.value
+    try:
+        reg.load("m", bst)
+        assert reg.status()["stale"] == []
+        bst.update()
+        bst.best_iteration = -1    # unpin predict from the old round
+        assert reg.status()["stale"] == ["m"]
+        assert telemetry.REGISTRY.gauge("serve.stale").value == 1
+        # auto-refresh re-exports on the next predict
+        got = reg.predict(X, model="m", raw_score=True)
+        assert ar.value == before + 1
+        assert np.array_equal(got, bst.predict(X, raw_score=True))
+        assert reg.status()["stale"] == []
+        assert telemetry.REGISTRY.gauge("serve.stale").value == 0
+    finally:
+        reg.close()
 
 
 def test_registry_warmup_on_load():
@@ -315,7 +555,9 @@ def test_http_predict_healthz_metrics():
                               bst.predict(X[:32], raw_score=True))
         hz = json.loads(urllib.request.urlopen(
             f"{base}/healthz", timeout=30).read())
-        assert hz == {"status": "ok", "models": ["default"]}
+        assert hz["status"] == "ok" and hz["models"] == ["default"]
+        assert hz["stale"] == [] and hz["demoted"] == []
+        assert hz["device_bytes"]["default"] > 0
         metrics = urllib.request.urlopen(
             f"{base}/metrics", timeout=30).read().decode()
         assert "lgbm_tpu" in metrics and "serve" in metrics

@@ -651,6 +651,16 @@ def _run_worker() -> None:
                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
                    "rows_per_sec": round(batch * iters / total_s, 1)}
 
+            # server-side view of the same closed loop: per-rung e2e
+            # percentiles from the serve.stage.e2e histograms the
+            # serving stack itself filled (request_trace.py), so the
+            # bench records what the server measured, not just what the
+            # client timed — diff.py watches
+            # serving.server.<rung>.p50_ms/p99_ms as timing metrics
+            server = telemetry.server_latency_block()
+            if server:
+                blk["server"] = server
+
             # per-rung split at full 4096-row buckets: the exact
             # device-sum rung vs the slot path it replaces (same
             # workload, serve_device_sum toggled).  `active` records

@@ -227,7 +227,7 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu lint [--format json|text]"
               " [--update-baseline]\n"
               "       python -m lightgbm_tpu serve model=<model_file>"
-              " [serve_port=...]",
+              " [serve_port=...] [serve_trace=...]",
               file=sys.stderr)
         return 0
     if argv[0] == "serve":

@@ -14,7 +14,13 @@ Four layers (docs/SERVING.md):
                 hot-swap.
   client.py / http.py — frontends: in-process `ServingClient` and the
                 stdlib HTTP endpoint (`python -m lightgbm_tpu serve`)
-                with /predict, /healthz, /metrics.
+                with /predict, /healthz, /metrics, /debug/requests.
+
+Request-scoped observability (ISSUE 8) threads through all four
+layers: each request carries a `telemetry.RequestTrace` (the HTTP
+frontend honors/echoes `X-Request-Id`), per-stage wall-clock deltas
+land in per-rung `serve.stage.*` histograms, and completed traces are
+tail-sampled into `telemetry.SERVE_RECORDER` (`/debug/requests`).
 """
 from .batcher import (MicroBatcher, ServingClosedError,
                       ServingOverloadError)

@@ -7,13 +7,23 @@ batch through the `ServingRuntime` once.  Under overload the batcher
 sheds instead of queueing unboundedly: a full queue rejects at submit
 time, and requests whose deadline passed while queued are dropped at
 flush time (both raise `ServingOverloadError`, both counted under
-`serve.shed`).  Device failures inside the runtime degrade to the host
+`serve.shed` plus a per-cause counter — `serve.shed.queue_full` vs
+`serve.shed.deadline` — so overload causes are distinguishable at the
+metrics level).  Device failures inside the runtime degrade to the host
 walk there (`serve.fallbacks`), so a wedged accelerator slows serving
 rather than erroring it — the probe-wedge lesson from bench.py.
 
 Batches coalesce only compatible requests (same raw/prob flavor, same
 feature width); a flush holding both flavors simply runs the runtime
 once per group.
+
+Tracing (ISSUE 8): every request carries a `telemetry.RequestTrace` —
+the HTTP frontend passes one in (honoring `X-Request-Id`), in-process
+callers get one made here.  The batcher stamps the queue-side stages
+(queue_wait / coalesce / finish), the runtime's `StageClock` supplies
+the device-side ones, and at each request's terminal point the deltas
+land in the per-rung `serve.stage.*` histograms and the trace goes to
+the tail-sampled `SERVE_RECORDER` ring (`/debug/requests`).
 """
 from __future__ import annotations
 
@@ -38,10 +48,11 @@ class ServingClosedError(LightGBMError):
 
 class _Request:
     __slots__ = ("X", "raw", "n", "enqueued", "deadline", "done",
-                 "result", "error")
+                 "result", "error", "trace", "t_submit", "t_dequeued")
 
     def __init__(self, X: np.ndarray, raw: bool,
-                 deadline: Optional[float]):
+                 deadline: Optional[float],
+                 trace: Optional[telemetry.RequestTrace] = None):
         self.X = X
         self.raw = raw
         self.n = X.shape[0]
@@ -50,6 +61,9 @@ class _Request:
         self.done = threading.Event()
         self.result: Optional[np.ndarray] = None
         self.error: Optional[BaseException] = None
+        self.trace = trace
+        self.t_submit = time.perf_counter()   # queue_wait stage origin
+        self.t_dequeued = 0.0
 
     def wait(self, timeout: Optional[float] = None) -> np.ndarray:
         if not self.done.wait(timeout):
@@ -85,7 +99,8 @@ class MicroBatcher:
         self._worker.start()
 
     # ------------------------------------------------------------ submit
-    def submit(self, X, raw_score: bool = False) -> _Request:
+    def submit(self, X, raw_score: bool = False,
+               trace: Optional[telemetry.RequestTrace] = None) -> _Request:
         """Enqueue one request; returns a waitable handle.  A full
         queue sheds immediately (bounded memory under overload)."""
         if self._closed:
@@ -98,13 +113,24 @@ class MicroBatcher:
             X = np.ascontiguousarray(X)
         if X.ndim == 1:
             X = X.reshape(1, -1)
+        if trace is None:
+            trace = telemetry.RequestTrace(model=self.runtime.name,
+                                           rows=X.shape[0],
+                                           raw=bool(raw_score))
+        else:
+            trace.model = trace.model or self.runtime.name
+            trace.rows = X.shape[0]
+            trace.raw = bool(raw_score)
         deadline = (time.monotonic() + self.deadline_s) \
             if self.deadline_s > 0 else None
-        req = _Request(X, bool(raw_score), deadline)
+        req = _Request(X, bool(raw_score), deadline, trace)
         try:
             self._q.put_nowait(req)
         except queue.Full:
             telemetry.REGISTRY.counter("serve.shed").inc()
+            telemetry.REGISTRY.counter("serve.shed.queue_full").inc()
+            trace.finish("shed_queue_full", "queue full at submit")
+            telemetry.SERVE_RECORDER.record(trace)
             raise ServingOverloadError(
                 f"serving queue full ({self._q.maxsize} requests)")
         telemetry.REGISTRY.counter("serve.requests").inc()
@@ -112,9 +138,11 @@ class MicroBatcher:
         return req
 
     def predict(self, X, raw_score: bool = False,
-                timeout: Optional[float] = None) -> np.ndarray:
+                timeout: Optional[float] = None,
+                trace: Optional[telemetry.RequestTrace] = None,
+                ) -> np.ndarray:
         """Synchronous submit-and-wait."""
-        return self.submit(X, raw_score=raw_score).wait(timeout)
+        return self.submit(X, raw_score=raw_score, trace=trace).wait(timeout)
 
     # ------------------------------------------------------------- worker
     def _loop(self) -> None:
@@ -125,6 +153,7 @@ class MicroBatcher:
                 if self._closed:
                     return
                 continue
+            first.t_dequeued = time.perf_counter()
             batch = [first]
             rows = first.n
             t0 = time.monotonic()
@@ -136,11 +165,14 @@ class MicroBatcher:
                     nxt = self._q.get(timeout=remaining)
                 except queue.Empty:
                     break
+                nxt.t_dequeued = time.perf_counter()
                 batch.append(nxt)
                 rows += nxt.n
             telemetry.REGISTRY.gauge("serve.queue_depth").set(
                 self._q.qsize())
             self._flush(batch)
+            telemetry.REGISTRY.gauge("serve.queue_depth").set(
+                self._q.qsize())
 
     def _flush(self, batch: List[_Request]) -> None:
         telemetry.REGISTRY.gauge("serve.in_flight").set(len(batch))
@@ -149,13 +181,17 @@ class MicroBatcher:
         for req in batch:
             if self._closed:
                 req.error = ServingClosedError("batcher closed")
+                self._finalize(req, "closed", "batcher closed")
                 req.done.set()
             elif req.deadline is not None and now > req.deadline:
                 # deadline-based load shedding: the caller has given up
                 # (or will) — don't burn device time on a dead request
                 telemetry.REGISTRY.counter("serve.shed").inc()
+                telemetry.REGISTRY.counter("serve.shed.deadline").inc()
                 req.error = ServingOverloadError(
                     "request deadline exceeded while queued")
+                self._finalize(req, "shed_deadline",
+                               "deadline exceeded while queued")
                 req.done.set()
             else:
                 live.append(req)
@@ -174,9 +210,12 @@ class MicroBatcher:
         telemetry.REGISTRY.gauge("serve.in_flight").set(0)
 
     def _run_group(self, reqs: List[_Request], raw: bool) -> None:
+        t_group = time.perf_counter()
+        clock = telemetry.StageClock()
         try:
             if len(reqs) == 1:
                 X = reqs[0].X
+                build_dt = 0.0
             else:
                 total = sum(r.n for r in reqs)
                 w = reqs[0].X.shape[1]
@@ -190,7 +229,12 @@ class MicroBatcher:
                     buf[lo:lo + r.n] = r.X
                     lo += r.n
                 X = buf[:total]
-            out = self.runtime.predict(X, raw_score=raw)
+                build_dt = time.perf_counter() - t_group
+            out = self.runtime.predict(X, raw_score=raw, clock=clock)
+            # the group-assembly copy is staging work too; added after
+            # predict() so its convert-remainder accounting stays exact
+            clock.add("stage_copy", build_dt)
+            rt_end = time.perf_counter()
             lo = 0
             done_t = time.monotonic()
             for r in reqs:
@@ -198,12 +242,37 @@ class MicroBatcher:
                 lo += r.n
                 telemetry.REGISTRY.timing("serve.latency").observe(
                     done_t - r.enqueued)
+                if r.trace is not None:
+                    tr = r.trace
+                    tr.add_stage("queue_wait", r.t_dequeued - r.t_submit)
+                    tr.add_stage("coalesce", t_group - r.t_dequeued)
+                    tr.merge_clock(clock)
+                    tr.add_stage("finish", time.perf_counter() - rt_end)
+                    tr.finish("ok")
+                    telemetry.observe_stages(tr)
+                    telemetry.SERVE_RECORDER.record(tr)
                 r.done.set()
         except BaseException as e:
             for r in reqs:
                 if not r.done.is_set():
                     r.error = e
+                    self._finalize(r, "error", str(e)[:200], clock)
                     r.done.set()
+
+    def _finalize(self, req: _Request, status: str, why: str,
+                  clock: Optional[telemetry.StageClock] = None) -> None:
+        """Terminal bookkeeping for a request that did NOT complete
+        normally: finalize its trace once and offer it to the recorder
+        (shed / error / closed traces are always kept)."""
+        tr = req.trace
+        if tr is None or tr.status is not None:
+            return
+        if clock is not None:
+            tr.merge_clock(clock)
+        if req.t_dequeued:
+            tr.add_stage("queue_wait", req.t_dequeued - req.t_submit)
+        tr.finish(status, why)
+        telemetry.SERVE_RECORDER.record(tr)
 
     # -------------------------------------------------------------- close
     def close(self, timeout: float = 5.0) -> None:
@@ -218,6 +287,7 @@ class MicroBatcher:
             except queue.Empty:
                 break
             req.error = ServingClosedError("batcher closed")
+            self._finalize(req, "closed", "batcher closed")
             req.done.set()
 
     def __enter__(self) -> "MicroBatcher":

@@ -46,9 +46,13 @@ class ServingClient:
         return self.registry.status()
 
     def predict(self, X, model: str = "default", raw_score: bool = False,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None, trace=None):
+        """Micro-batched predict.  `trace` takes a
+        `telemetry.RequestTrace` (the HTTP frontend passes one carrying
+        the caller's `X-Request-Id`); in-process callers can omit it —
+        the batcher creates one per request."""
         return self.registry.predict(X, model=model, raw_score=raw_score,
-                                     timeout=timeout)
+                                     timeout=timeout, trace=trace)
 
     def close(self) -> None:
         if self._owns_registry:

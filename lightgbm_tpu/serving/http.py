@@ -4,20 +4,34 @@ Endpoints (JSON in/out, no dependencies beyond http.server):
 
   POST /predict   {"rows": [[...], ...], "model": "default",
                    "raw_score": false}
-                  -> {"model", "rows", "predictions"}
+                  -> {"model", "rows", "predictions", "request_id"}
                   Predictions ride as JSON numbers; Python float repr
                   is shortest-roundtrip, so the f64 values parse back
                   bit-exact — byte-identity with `booster.predict`
                   survives the wire (scripts/run_ci.sh smoke asserts
                   this end to end).
   GET  /healthz   -> {"status": "ok", "models": [...], "stale": [...],
-                  "demoted": [...], "device_bytes": {...}} (503 when
-                  no model is loaded; `stale` lists models whose
-                  booster mutated since their export — see
-                  ModelRegistry.status)
+                  "demoted": [...], "device_bytes": {...},
+                  "latency_ms": {...}} (503 when no model is loaded;
+                  `stale` lists models whose booster mutated since
+                  their export, `latency_ms` is the all-rung
+                  server-side e2e percentile block once any request
+                  has completed — see ModelRegistry.status)
   GET  /metrics   -> Prometheus text exposition of the process
                   MetricsRegistry (serve.* counters/gauges/timings
-                  next to the training metrics)
+                  plus the per-rung `serve.stage.*` classic-histogram
+                  `_bucket`/`le` series, next to the training metrics)
+  GET  /debug/requests[?n=K]
+                  -> the tail-sampled serving flight-recorder ring
+                  (telemetry.SERVE_RECORDER.snapshot(): newest-first
+                  completed traces with per-stage ms), gated by the
+                  `serve_trace*` params
+
+Trace-header contract: a caller may send `X-Request-Id: <token>`; the
+id (or a generated one) tags the request's `RequestTrace`, comes back
+as an `X-Request-Id` response header AND a `request_id` body field on
+every /predict response — success or error — and is searchable in
+`/debug/requests`.
 
 Overload maps to HTTP 503 (`ServingOverloadError` — shed or queue
 full), malformed bodies to 400, unknown models to 404.
@@ -26,8 +40,9 @@ from __future__ import annotations
 
 import json
 import sys
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import List
+from typing import List, Optional
 
 import numpy as np
 
@@ -53,10 +68,15 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: N802 (stdlib name)
         log.debug(f"[serve] {self.address_string()} {fmt % args}")
 
-    def _send_json(self, code: int, payload: dict) -> None:
+    def _send_json(self, code: int, payload: dict,
+                   request_id: Optional[str] = None) -> None:
+        if request_id:
+            payload = dict(payload, request_id=request_id)
         body = json.dumps(payload).encode("utf-8")
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
+        if request_id:
+            self.send_header("X-Request-Id", request_id)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -73,17 +93,31 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
     # --------------------------------------------------------------- GET
     def do_GET(self) -> None:  # noqa: N802 (stdlib name)
         telemetry.REGISTRY.counter("serve.http.requests").inc()
-        if self.path == "/healthz":
+        url = urllib.parse.urlsplit(self.path)
+        if url.path == "/healthz":
             st = self.client.status()
             models = st["models"]
-            self._send_json(200 if models else 503,
-                            {"status": "ok" if models else "no_models",
-                             "models": models,
-                             "stale": st["stale"],
-                             "demoted": st["demoted"],
-                             "device_bytes": st["device_bytes"]})
-        elif self.path == "/metrics":
+            payload = {"status": "ok" if models else "no_models",
+                       "models": models,
+                       "stale": st["stale"],
+                       "demoted": st["demoted"],
+                       "device_bytes": st["device_bytes"]}
+            if "latency_ms" in st:
+                payload["latency_ms"] = st["latency_ms"]
+            self._send_json(200 if models else 503, payload)
+        elif url.path == "/metrics":
             self._send_text(200, telemetry.REGISTRY.to_prometheus())
+        elif url.path == "/debug/requests":
+            qs = urllib.parse.parse_qs(url.query)
+            limit = None
+            try:
+                if "n" in qs:
+                    limit = int(qs["n"][0])
+            except (ValueError, IndexError):
+                self._send_json(400, {"error": "n must be an integer"})
+                return
+            self._send_json(
+                200, telemetry.SERVE_RECORDER.snapshot(limit=limit))
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"})
 
@@ -110,22 +144,43 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
                 return
             model = str(body.get("model", "default"))
             raw = bool(body.get("raw_score", False))
+            # trace creation AFTER parsing: its e2e then brackets exactly
+            # the stages the batcher/runtime stamp, which is what makes
+            # stage-sum ≈ e2e hold (the /debug/requests contract)
+            rid = self.headers.get("X-Request-Id") or None
+            tr = telemetry.RequestTrace(request_id=rid, model=model,
+                                        rows=int(X.shape[0]), raw=raw)
             try:
-                preds = self.client.predict(X, model=model, raw_score=raw)
+                preds = self.client.predict(X, model=model, raw_score=raw,
+                                            trace=tr)
             except ServingOverloadError as e:
-                self._send_json(503, {"error": str(e)})
+                self._trace_error(tr, "shed_overload", e)
+                self._send_json(503, {"error": str(e)}, request_id=tr.id)
                 return
             except LightGBMError as e:
                 # unknown model name (or model-shape errors): caller bug
-                self._send_json(404, {"error": str(e)})
+                self._trace_error(tr, "error", e)
+                self._send_json(404, {"error": str(e)}, request_id=tr.id)
                 return
             except Exception as e:
                 telemetry.REGISTRY.counter("serve.http.errors").inc()
-                self._send_json(500, {"error": str(e)[:500]})
+                self._trace_error(tr, "error", e)
+                self._send_json(500, {"error": str(e)[:500]},
+                                request_id=tr.id)
                 return
             self._send_json(200, {"model": model,
                                   "rows": int(X.shape[0]),
-                                  "predictions": np.asarray(preds).tolist()})
+                                  "predictions": np.asarray(preds).tolist()},
+                            request_id=tr.id)
+
+    @staticmethod
+    def _trace_error(tr, status: str, e: BaseException) -> None:
+        """Finalize+record a trace the batcher never terminated (e.g.
+        an unknown model fails before submit); traces the batcher
+        already finalized — sheds, group errors — pass through."""
+        if tr.status is None:
+            tr.finish(status, str(e)[:200])
+            telemetry.SERVE_RECORDER.record(tr)
 
 
 def make_server(client: ServingClient, host: str = "127.0.0.1",

@@ -48,13 +48,14 @@ class ServingModel:
         self.last_used = time.monotonic()
 
     def predict(self, X, raw_score: bool = False,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                trace: Optional[telemetry.RequestTrace] = None):
         self.last_used = time.monotonic()
         if self.auto_refresh and self.runtime.stale():
             telemetry.REGISTRY.counter("serve.auto_refresh").inc()
             self.runtime.refresh()
         return self.batcher.predict(X, raw_score=raw_score,
-                                    timeout=timeout)
+                                    timeout=timeout, trace=trace)
 
     def close(self) -> None:
         self.batcher.close()
@@ -66,14 +67,25 @@ class ModelRegistry:
     `params` takes the serving knobs (`serve_max_batch_rows`,
     `serve_max_wait_ms`, `serve_queue_depth`, `serve_deadline_ms`,
     `serve_warmup`, `serve_device_sum`, `serve_vram_budget_mb`,
-    `serve_auto_refresh` — aliases resolve through utils/config.py
-    like every other param).
+    `serve_auto_refresh`, plus the `serve_trace*` flight-recorder knobs
+    — aliases resolve through utils/config.py like every other param).
+
+    Constructing a registry configures the process-global
+    `telemetry.SERVE_RECORDER` from its `serve_trace*` params (the
+    recorder is a process singleton like REGISTRY/TRACER, so
+    `/debug/requests` and bench can read it without plumbing; the last
+    registry constructed wins, which is the one serving).
     """
 
     def __init__(self, params: Optional[dict] = None):
         self._config = Config(dict(params or {}))
         self._lock = threading.Lock()
         self._models: Dict[str, ServingModel] = {}
+        cfg = self._config
+        telemetry.SERVE_RECORDER.configure(
+            enabled=cfg.serve_trace, capacity=cfg.serve_trace_ring,
+            slow_ms=cfg.serve_trace_slow_ms,
+            sample_every=cfg.serve_trace_sample)
 
     # -------------------------------------------------------------- load
     def load(self, name: str, model: Union[str, object], *,
@@ -173,24 +185,32 @@ class ModelRegistry:
     def status(self) -> Dict:
         """Registry health snapshot (the `/healthz` payload body):
         model names, entries whose booster mutated since export
-        (`stale`), demoted entries, and per-entry device bytes.  Also
-        refreshes the `serve.stale` gauge."""
+        (`stale`), demoted entries, per-entry device bytes, and — once
+        any request has completed — all-rung server-side latency
+        percentiles from the `serve.stage.e2e` histograms
+        (`latency_ms`: count/p50/p90/p99/p999).  Also refreshes the
+        `serve.stale` gauge."""
         with self._lock:
             entries = dict(self._models)
         stale = sorted(n for n, e in entries.items()
                        if e.runtime.stale())
         telemetry.REGISTRY.gauge("serve.stale").set(len(stale))
-        return {"models": sorted(entries),
-                "stale": stale,
-                "demoted": sorted(n for n, e in entries.items()
-                                  if e.runtime.demoted),
-                "device_bytes": {n: e.runtime.device_bytes()
-                                 for n, e in sorted(entries.items())}}
+        out = {"models": sorted(entries),
+               "stale": stale,
+               "demoted": sorted(n for n, e in entries.items()
+                                 if e.runtime.demoted),
+               "device_bytes": {n: e.runtime.device_bytes()
+                                for n, e in sorted(entries.items())}}
+        lat = telemetry.e2e_latency_summary()
+        if lat is not None:
+            out["latency_ms"] = lat
+        return out
 
     def predict(self, X, model: str = "default", raw_score: bool = False,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                trace: Optional[telemetry.RequestTrace] = None):
         return self.get(model).predict(X, raw_score=raw_score,
-                                       timeout=timeout)
+                                       timeout=timeout, trace=trace)
 
     # ------------------------------------------------------------- close
     def close(self) -> None:

@@ -317,10 +317,22 @@ class ServingRuntime:
         return len(sizes)
 
     # ----------------------------------------------------------- predict
-    def predict(self, X, raw_score: bool = False) -> np.ndarray:
+    def predict(self, X, raw_score: bool = False,
+                clock: Optional[telemetry.StageClock] = None) -> np.ndarray:
         """Bucket-padded device prediction, byte-identical to
         `booster.predict(X, raw_score=...)` (ladder rungs degrade
-        transparently on device errors)."""
+        transparently on device errors).
+
+        `clock` (ISSUE 8 tracing) collects per-stage wall-clock deltas —
+        staging copy, device dispatch, D2H — and the ladder rung that
+        produced the bytes; the remainder of the call (host gather/sum,
+        output conversion, slicing) lands in the `convert` stage.  All
+        stamps are `perf_counter` reads around boundaries the call
+        already crosses: tracing adds no device syncs and never touches
+        the data, so traced and untraced outputs are byte-identical.
+        """
+        if clock is None:
+            clock = telemetry.StageClock()
         if not (isinstance(X, np.ndarray) and X.dtype == np.float64
                 and X.flags["C_CONTIGUOUS"]):
             # the micro-batcher hands over already-normalized arrays —
@@ -335,18 +347,25 @@ class ServingRuntime:
             want_raw = raw_score or self._booster.objective_ is None
             out = None
             if self._device_sum_ok and ex["trees"]:
-                out = self._device_sum(X, ex, want_raw)
-            if out is None:
-                raw = self._raw(X, ex)
+                out = self._device_sum(X, ex, want_raw, clock)
+            if out is not None:
+                clock.rung = "device_sum"
+            else:
+                raw = self._raw(X, ex, clock)
                 out = raw if want_raw else self._convert(raw)
-            telemetry.REGISTRY.timing("serve.predict").observe(
-                time.perf_counter() - t0)
+            total = time.perf_counter() - t0
+            telemetry.REGISTRY.timing("serve.predict").observe(total)
+            accounted = sum(clock.stages.get(s, 0.0)
+                            for s in ("stage_copy", "dispatch", "d2h",
+                                      "convert"))
+            clock.add("convert", max(total - accounted, 0.0))
         telemetry.REGISTRY.counter("serve.rows").inc(n)
         return out
 
     # ----------------------------------------------- rung 1: device sum
-    def _device_sum(self, X: np.ndarray, ex: Dict,
-                    want_raw: bool) -> Optional[np.ndarray]:
+    def _device_sum(self, X: np.ndarray, ex: Dict, want_raw: bool,
+                    clock: Optional[telemetry.StageClock] = None,
+                    ) -> Optional[np.ndarray]:
         """Finished scores straight off the device, or None when the
         next rung (slot path) must take over."""
         stacked = ex["stacked"]
@@ -354,7 +373,8 @@ class ServingRuntime:
             return None
         try:
             outs = [self._device_sum_chunk(
-                        X[lo:lo + self.max_batch_rows], ex, want_raw)
+                        X[lo:lo + self.max_batch_rows], ex, want_raw,
+                        clock)
                     for lo in range(0, X.shape[0], self.max_batch_rows)]
         except Exception as e:
             telemetry.REGISTRY.counter("serve.device_errors").inc()
@@ -364,10 +384,15 @@ class ServingRuntime:
         telemetry.REGISTRY.counter("serve.device_sum").inc()
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
-    def _device_sum_chunk(self, Xc: np.ndarray, ex: Dict,
-                          want_raw: bool) -> np.ndarray:
+    def _device_sum_chunk(self, Xc: np.ndarray, ex: Dict, want_raw: bool,
+                          clock: Optional[telemetry.StageClock] = None,
+                          ) -> np.ndarray:
+        if clock is None:
+            clock = telemetry.StageClock()
         b = bucket_rows(Xc.shape[0], self.max_batch_rows)
+        t = time.perf_counter()
         Xd = self._stage32(Xc, b)
+        clock.add("stage_copy", time.perf_counter() - t)
         stacked = ex["stacked"]
         arrays = {k: v for k, v in stacked.items()
                   if k not in ("min_features", "value")}
@@ -375,29 +400,38 @@ class ServingRuntime:
         arrays["value_lo"] = ex["value_lo"]
         K = ex["num_class"]
         conv = None if want_raw else self._booster.objective_.convert_output
+        t = time.perf_counter()
         out = _EXACT_JIT(arrays, Xd, n_class=K, convert=conv)
+        clock.add("dispatch", time.perf_counter() - t)
         n = Xc.shape[0]
         if want_raw:
+            t = time.perf_counter()
             hi = np.asarray(jax.device_get(out[0]))
             lo = np.asarray(jax.device_get(out[1]))
+            clock.add("d2h", time.perf_counter() - t)
             telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
                 hi.nbytes + lo.nbytes)
             raw = ((hi.astype(np.uint64) << np.uint64(32))
                    | lo).view(np.float64)
             return raw[:n]
+        t = time.perf_counter()
         o = np.asarray(jax.device_get(out))
+        clock.add("d2h", time.perf_counter() - t)
         telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
         return o[:n]
 
     # ------------------------------------------- rungs 2+3: slots, host
-    def _raw(self, X: np.ndarray, ex: Dict) -> np.ndarray:
+    def _raw(self, X: np.ndarray, ex: Dict,
+             clock: Optional[telemetry.StageClock] = None) -> np.ndarray:
         """Exact f64 raw scores: device leaf slots (bucketed) + host
         gather/sum in tree order — the host walk's summation, verbatim."""
         trees = ex["trees"]
         K = ex["num_class"]
         n = X.shape[0]
         raw = np.zeros((n, K), np.float64)
-        slots = self._device_slots(X, ex) if trees else None
+        slots = self._device_slots(X, ex, clock) if trees else None
+        if clock is not None:
+            clock.rung = "slot_path" if slots is not None else "host_walk"
         if trees and slots is None:
             # host fallback (tree.py walk, exact f64) — device error,
             # linear trees, or an X too narrow for the stacked arrays
@@ -417,8 +451,9 @@ class ServingRuntime:
             raw = raw[:, 0]
         return raw
 
-    def _device_slots(self, X: np.ndarray,
-                      ex: Dict) -> Optional[np.ndarray]:
+    def _device_slots(self, X: np.ndarray, ex: Dict,
+                      clock: Optional[telemetry.StageClock] = None,
+                      ) -> Optional[np.ndarray]:
         """[T, N] i32 leaf slots via the bucketed device program, or
         None when the host walk must take over."""
         stacked = ex["stacked"]
@@ -427,7 +462,7 @@ class ServingRuntime:
             return None
         try:
             outs = [self._device_slots_chunk(
-                        X[lo:lo + self.max_batch_rows], stacked)
+                        X[lo:lo + self.max_batch_rows], stacked, clock)
                     for lo in range(0, X.shape[0], self.max_batch_rows)]
         except Exception as e:
             # probe-wedge lesson: a dead/wedged device must degrade, not
@@ -438,15 +473,24 @@ class ServingRuntime:
             return None
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=1)
 
-    def _device_slots_chunk(self, Xc: np.ndarray,
-                            stacked: Dict) -> np.ndarray:
+    def _device_slots_chunk(self, Xc: np.ndarray, stacked: Dict,
+                            clock: Optional[telemetry.StageClock] = None,
+                            ) -> np.ndarray:
+        if clock is None:
+            clock = telemetry.StageClock()
         n = Xc.shape[0]
         b = bucket_rows(n, self.max_batch_rows)
+        t = time.perf_counter()
         Xd = self._stage32(Xc, b)
+        clock.add("stage_copy", time.perf_counter() - t)
         arrays = {k: v for k, v in stacked.items()
                   if k not in ("min_features", "value")}
+        t = time.perf_counter()
         out = _LEAF_JIT(arrays, Xd)
+        clock.add("dispatch", time.perf_counter() - t)
+        t = time.perf_counter()
         slots = np.asarray(jax.device_get(out))
+        clock.add("d2h", time.perf_counter() - t)
         telemetry.REGISTRY.counter("serve.d2h_bytes").inc(slots.nbytes)
         return slots[:, :n]
 

@@ -16,23 +16,29 @@ jax-free processes.  (Importing it as `lightgbm_tpu.telemetry` runs
 use `importlib.util.spec_from_file_location` on the submodule files, as
 bench.py already does for utils/env.py.)
 """
-from .metrics import (Counter, Gauge, MetricsRegistry, REGISTRY, Timing,
-                      write_prometheus)
+from .metrics import (Counter, Gauge, Histogram, HISTOGRAM_BOUNDS,
+                      MetricsRegistry, REGISTRY, Timing, write_prometheus)
 from .sinks import JsonlSink, MemorySink, Sink, iso_ts, make_event, read_jsonl
 from .spans import NOOP, Span, TRACER, Tracer, event, span
 from .report import render, summarize
 from .recorder import (FlightRecorder, install_compile_listener,
                        memory_watermarks, poll_jit_caches, sample_memory,
                        throughput_report, tree_stats)
+from .request_trace import (RequestTrace, SERVE_RECORDER, ServeRecorder,
+                            StageClock, e2e_latency_summary, new_request_id,
+                            observe_stages, server_latency_block)
 from .diff import diff_snapshots, flatten, load_snapshot
 
 __all__ = [
-    "Counter", "Gauge", "MetricsRegistry", "REGISTRY", "Timing",
-    "write_prometheus",
+    "Counter", "Gauge", "Histogram", "HISTOGRAM_BOUNDS", "MetricsRegistry",
+    "REGISTRY", "Timing", "write_prometheus",
     "JsonlSink", "MemorySink", "Sink", "iso_ts", "make_event", "read_jsonl",
     "NOOP", "Span", "TRACER", "Tracer", "event", "span",
     "render", "summarize",
     "FlightRecorder", "install_compile_listener", "memory_watermarks",
     "poll_jit_caches", "sample_memory", "throughput_report", "tree_stats",
+    "RequestTrace", "SERVE_RECORDER", "ServeRecorder", "StageClock",
+    "e2e_latency_summary", "new_request_id", "observe_stages",
+    "server_latency_block",
     "diff_snapshots", "flatten", "load_snapshot",
 ]

@@ -104,7 +104,26 @@ RULES: List[Tuple[str, str, str]] = [
     ("*serving.device_sum.p50_ms", "up_is_bad", "timing"),
     ("*serving.device_sum.p99_ms", "up_is_bad", "timing"),
     ("*serving.slot_path.*", "ignore", "timing"),
+    # server-side per-rung latency histograms (ISSUE 8): the
+    # `serve.stage.e2e{rung=...}` percentile paths in a registry
+    # snapshot, and the bench `serving.server.<rung>` block next to the
+    # client-side numbers.  Wall-clock → timing class (warns on the
+    # shared-core CI fallback, fails a plain `telemetry diff`); the
+    # per-rung counts are load-dependent bookkeeping.
+    ("*serve.stage.*.p50_s", "up_is_bad", "timing"),
+    ("*serve.stage.*.p90_s", "up_is_bad", "timing"),
+    ("*serve.stage.*.p99_s", "up_is_bad", "timing"),
+    ("*serve.stage.*.p999_s", "up_is_bad", "timing"),
+    ("*serve.stage.*", "ignore", "counter"),
+    ("*serving.server.*.p50_ms", "up_is_bad", "timing"),
+    ("*serving.server.*.p99_ms", "up_is_bad", "timing"),
+    ("*serving.server.*", "ignore", "counter"),
+    # per-cause shed split (serve.shed.queue_full / serve.shed.deadline)
+    # fails on growth like the aggregate; recorder traffic stats are
+    # load-dependent
+    ("*serve.shed.*", "up_is_bad", "counter"),
     ("*serve.shed", "up_is_bad", "counter"),
+    ("*serve.trace.*", "ignore", "counter"),
     ("*serve.device_errors", "up_is_bad", "counter"),
     ("gauges.serve.*", "ignore", "counter"),
     ("counters.serve.*", "ignore", "counter"),

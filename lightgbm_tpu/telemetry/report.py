@@ -2,9 +2,14 @@
 
 Backs `python -m lightgbm_tpu telemetry-report <file.jsonl>`: aggregates
 span events by name (count / total / mean / min / max seconds, plus each
-phase's share of the top-level span time), lists point events, and shows
-the final counters from the last embedded metrics snapshot if the run
-wrote one.
+phase's share of the top-level span time), lists point events, shows the
+final counters from the last embedded metrics snapshot if the run wrote
+one, and — when the sink carries `ev == "trace"` serving records (the
+tail-sampled flight recorder, request_trace.py) — a per-status/rung
+latency table.  Recorded traces are tail-biased BY DESIGN (every shed /
+error / slow request plus 1-in-N of the healthy rest), so that table
+describes the recorded population, not overall traffic; the rendered
+header says so.
 
 STDLIB-ONLY by design (see metrics.py): usable from jax-free processes
 and loadable by file path.
@@ -32,6 +37,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     """Aggregate parsed events into a JSON-friendly summary dict."""
     phases: Dict[str, Dict[str, Any]] = {}
     point_events: Dict[str, int] = {}
+    trace_groups: Dict[str, List[float]] = {}
     snapshot: Optional[Dict[str, Any]] = None
     root_total = 0.0
     for rec in events:
@@ -61,6 +67,26 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             point_events[n] = point_events.get(n, 0) + 1
         elif kind == "metrics":
             snapshot = rec.get("snapshot") or snapshot
+        elif kind == "trace":
+            key = (f"{rec.get('status', '?')}/"
+                   f"{rec.get('rung', '?')}")
+            try:
+                e2e = float(rec.get("e2e_ms", 0.0) or 0.0)
+            except (TypeError, ValueError):
+                e2e = 0.0
+            trace_groups.setdefault(key, []).append(e2e)
+    traces: Dict[str, Dict[str, Any]] = {}
+    for key, vals in sorted(trace_groups.items()):
+        vals.sort()
+        # nearest-rank over the recorded (tail-biased) sample — good
+        # enough for a forensic table; the live histograms own the
+        # authoritative percentiles
+        q = lambda p: vals[min(len(vals) - 1,          # noqa: E731
+                               int(p * (len(vals) - 1) + 0.5))]
+        traces[key] = {"count": len(vals),
+                       "p50_ms": round(q(0.50), 3),
+                       "p99_ms": round(q(0.99), 3),
+                       "max_ms": round(vals[-1], 3)}
     for name, p in phases.items():
         p["mean_s"] = p["total_s"] / p["count"] if p["count"] else 0.0
         if p["min_s"] == float("inf"):
@@ -73,6 +99,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "root_total_s": root_total,
         "phases": phases,
         "events": point_events,
+        "traces": traces,
         "metrics": snapshot,
     }
 
@@ -141,6 +168,19 @@ def render(summary: Dict[str, Any]) -> str:
         lines.append("point events:")
         for name, n in sorted(summary["events"].items()):
             lines.append(f"  {name:<40} x{n}")
+    traces = summary.get("traces")
+    if traces:
+        lines.append("")
+        lines.append("serving traces (tail-sampled — sheds/errors/slow "
+                     "over-represented by design):")
+        header = (f"  {'status/rung':<28} {'count':>6} {'p50_ms':>9} "
+                  f"{'p99_ms':>9} {'max_ms':>9}")
+        lines.append(header)
+        lines.append("  " + "-" * (len(header) - 2))
+        for key, t in sorted(traces.items()):
+            lines.append(
+                f"  {key:<28} {t['count']:>6} {t['p50_ms']:>9.3f} "
+                f"{t['p99_ms']:>9.3f} {t['max_ms']:>9.3f}")
     snap = summary.get("metrics")
     if snap and snap.get("counters"):
         lines.append("")

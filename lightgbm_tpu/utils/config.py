@@ -264,6 +264,19 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # HTTP frontend bind address (python -m lightgbm_tpu serve)
     "serve_host": ("127.0.0.1", "str", ()),
     "serve_port": (8080, "int", ()),
+    # serving flight recorder (telemetry.SERVE_RECORDER): tail-sample
+    # completed request traces into a bounded ring served at
+    # /debug/requests.  Per-stage serve.stage.* histograms stay on
+    # either way — this gates only the per-request ring
+    "serve_trace": (True, "bool", ()),
+    # ring capacity (completed traces kept, newest win)
+    "serve_trace_ring": (256, "int", ()),
+    # latency tail threshold: any request with e2e >= this many ms is
+    # recorded (sheds/errors/host-walk fallbacks are always recorded)
+    "serve_trace_slow_ms": (100.0, "float", ()),
+    # deterministic 1-in-N sampling of healthy requests, so the ring
+    # shows what normal looks like next to the tail
+    "serve_trace_sample": (64, "int", ()),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -87,9 +87,12 @@ EOF
 
 # serving smoke: a golden model behind the stdlib HTTP frontend on an
 # ephemeral port — POST /predict must be byte-identical to
-# booster.predict, /healthz and /metrics must answer, clean shutdown.
-# Warm-up is off: the smoke checks wiring, the bucket/compile matrix
-# lives in tests/test_serving.py
+# booster.predict, /healthz and /metrics must answer, an X-Request-Id
+# must round-trip to a /debug/requests trace whose stage deltas sum to
+# its e2e within 5% (the ISSUE 8 acceptance bound), and the /metrics
+# exposition must carry classic histogram _bucket series.  Warm-up is
+# off: the smoke checks wiring, the bucket/compile matrix lives in
+# tests/test_serving.py, the trace matrix in tests/test_serving_trace.py
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json
 import sys
@@ -107,15 +110,22 @@ from lightgbm_tpu.serving.http import make_server
 bst = Booster(model_file="tests/data/golden_binary.model.txt")
 X, _ = make_case_data(GOLDEN_CASES["binary"])
 X = X[:64]
-client = ServingClient(bst, params={"serve_warmup": False})
+# serve_trace_slow_ms=0: every completed request is recorded, so the
+# smoke's one request is guaranteed to be inspectable at /debug/requests
+client = ServingClient(bst, params={"serve_warmup": False,
+                                    "serve_trace_slow_ms": 0.0})
 srv = make_server(client, "127.0.0.1", 0)
 port = srv.server_address[1]
 threading.Thread(target=srv.serve_forever, daemon=True).start()
 base = f"http://127.0.0.1:{port}"
 body = json.dumps({"rows": X.tolist()}).encode()
 req = urllib.request.Request(f"{base}/predict", data=body,
-                             headers={"Content-Type": "application/json"})
-resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
+                             headers={"Content-Type": "application/json",
+                                      "X-Request-Id": "ci-smoke-1"})
+raw = urllib.request.urlopen(req, timeout=60)
+assert raw.headers["X-Request-Id"] == "ci-smoke-1", "id header not echoed"
+resp = json.loads(raw.read())
+assert resp["request_id"] == "ci-smoke-1", "id body field not echoed"
 got = np.asarray(resp["predictions"], np.float64)
 want = bst.predict(X)
 assert got.shape == want.shape and np.array_equal(got, want), \
@@ -123,13 +133,25 @@ assert got.shape == want.shape and np.array_equal(got, want), \
 hz = json.loads(urllib.request.urlopen(f"{base}/healthz",
                                        timeout=30).read())
 assert hz["status"] == "ok" and hz["models"] == ["default"], hz
+assert hz["latency_ms"]["count"] >= 1 and hz["latency_ms"]["p99_ms"] > 0
 metrics = urllib.request.urlopen(f"{base}/metrics",
                                  timeout=30).read().decode()
 assert "lgbm_tpu" in metrics and "serve" in metrics, "metrics exposition"
+assert "lgbm_tpu_serve_stage_e2e_seconds_bucket{" in metrics and \
+    'le="+Inf"' in metrics, "histogram _bucket series missing"
+dbg = json.loads(urllib.request.urlopen(f"{base}/debug/requests",
+                                        timeout=30).read())
+tr = next(t for t in dbg["requests"] if t["id"] == "ci-smoke-1")
+assert tr["status"] == "ok" and tr["rows"] == 64, tr
+stage_sum = sum(tr["stages_ms"].values())
+assert abs(stage_sum - tr["e2e_ms"]) <= 0.05 * tr["e2e_ms"], \
+    f"stages sum {stage_sum}ms vs e2e {tr['e2e_ms']}ms (>5% apart)"
 srv.shutdown()
 srv.server_close()
 client.close()
-print("[run_ci] serving smoke: HTTP parity + healthz + metrics OK")
+print("[run_ci] serving smoke: HTTP parity + trace round-trip "
+      f"(stages {stage_sum:.1f}ms ~ e2e {tr['e2e_ms']:.1f}ms) + "
+      "histogram buckets OK")
 EOF
 
 # device-sum parity smoke: the exact on-device accumulation rung must

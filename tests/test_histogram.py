@@ -1,0 +1,188 @@
+"""Histogram metric (telemetry/metrics.py, ISSUE 8 tentpole part 1).
+
+The claims under test:
+
+* LAYOUT — `HISTOGRAM_BOUNDS` is a fixed log-scaled ladder, µs to 10 s,
+  strictly increasing, within the 64-bucket budget, shared by every
+  instance so merged views are element-wise sums.
+* QUANTILES — `quantile(q)` agrees with a numpy oracle on the raw
+  samples to within one bucket's relative width (~33% for 8 buckets per
+  decade): good enough for a p99, cheap enough for a hot path.
+* EXPORT — `to_prometheus` emits classic cumulative `_bucket{le=...}`
+  series (labels merged ahead of `le`), `_sum`/`_count`, and `+Inf`
+  equal to the total count; the timing summary's min/max ride as
+  SEPARATE gauges with their own TYPE lines (min/max are not valid
+  summary series — the PR 8 satellite fix).
+* CONCURRENCY — Counter.inc / Timing.observe / Histogram.observe from
+  many threads lose nothing.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.telemetry.metrics import (HISTOGRAM_BOUNDS, Histogram,
+                                            MetricsRegistry)
+
+pytestmark = pytest.mark.quick
+
+
+# ---------------------------------------------------------------- layout
+def test_bounds_layout():
+    assert len(HISTOGRAM_BOUNDS) + 1 <= 64          # +1 for the +Inf bucket
+    assert all(b1 < b2 for b1, b2 in
+               zip(HISTOGRAM_BOUNDS, HISTOGRAM_BOUNDS[1:]))
+    assert HISTOGRAM_BOUNDS[0] == pytest.approx(1e-6)
+    assert HISTOGRAM_BOUNDS[-1] == pytest.approx(10.0)
+    # log-uniform: constant ratio between adjacent edges
+    ratios = [b2 / b1 for b1, b2 in
+              zip(HISTOGRAM_BOUNDS, HISTOGRAM_BOUNDS[1:])]
+    assert max(ratios) / min(ratios) == pytest.approx(1.0, rel=1e-9)
+
+
+def test_observe_basic_accounting():
+    h = Histogram("t")
+    for v in (0.001, 0.002, 0.004, 5.0):
+        h.observe(v)
+    assert h.count == 4
+    assert h.sum == pytest.approx(5.007)
+    assert h.max == pytest.approx(5.0)
+    assert sum(h.counts) == 4
+
+
+def test_empty_and_overflow_buckets():
+    h = Histogram("t")
+    assert h.quantile(0.99) == 0.0                  # empty: no crash
+    h.observe(100.0)                                # beyond the last edge
+    assert h.counts[-1] == 1                        # +Inf bucket
+    # the open bucket interpolates toward the observed max, so the
+    # estimate can't run away past what was actually seen
+    assert HISTOGRAM_BOUNDS[-1] <= h.quantile(0.999) <= 100.0
+
+
+# -------------------------------------------------------- numpy oracle
+@pytest.mark.parametrize("dist", ["lognormal", "bimodal", "uniform"])
+def test_quantiles_match_numpy_oracle(dist):
+    rng = np.random.RandomState(11)
+    if dist == "lognormal":
+        vals = np.exp(rng.randn(5000) * 1.2 - 6.0)  # ~ms scale, long tail
+    elif dist == "bimodal":
+        # unbalanced modes so the tested quantiles land INSIDE a mode
+        # (a quantile falling in the empty inter-mode gap is ill-posed:
+        # nearest-rank and numpy's midpoint interpolation legitimately
+        # disagree there by the width of the gap)
+        vals = np.concatenate([np.exp(rng.randn(1500) * 0.3 - 8.0),
+                               np.exp(rng.randn(3500) * 0.3 - 2.0)])
+    else:
+        vals = rng.uniform(1e-4, 1e-1, 5000)
+    h = Histogram("o")
+    for v in vals:
+        h.observe(float(v))
+    # one log-bucket is a 10^(1/8) ≈ 1.334x span; the interpolated
+    # estimate must land within that bucket's width of the true value
+    tol = 10 ** (1.0 / 8.0) - 1.0
+    for q in (0.50, 0.90, 0.99):
+        want = float(np.quantile(vals, q))
+        got = h.quantile(q)
+        assert got == pytest.approx(want, rel=tol), \
+            f"{dist} q={q}: hist {got} vs numpy {want}"
+
+
+def test_merged_equals_single_stream():
+    rng = np.random.RandomState(3)
+    vals = np.exp(rng.randn(2000) - 5.0)
+    one = Histogram("all")
+    parts = [Histogram("part", (("rung", r),))
+             for r in ("device_sum", "slot_path")]
+    for i, v in enumerate(vals):
+        one.observe(float(v))
+        parts[i % 2].observe(float(v))
+    m = Histogram.merged(parts)
+    assert m.count == one.count and m.counts == one.counts
+    assert m.sum == pytest.approx(one.sum)
+    assert m.quantile(0.99) == pytest.approx(one.quantile(0.99))
+
+
+# -------------------------------------------------------------- registry
+def test_registry_labels_and_snapshot():
+    reg = MetricsRegistry()
+    a = reg.histogram("serve.stage.e2e", rung="device_sum")
+    b = reg.histogram("serve.stage.e2e", rung="host_walk")
+    assert a is reg.histogram("serve.stage.e2e", rung="device_sum")
+    assert a is not b
+    a.observe(0.001)
+    b.observe(1.0)
+    fam = reg.histogram_family("serve.stage.e2e")
+    assert sorted(dict(h.labels)["rung"] for h in fam) == \
+        ["device_sum", "host_walk"]
+    snap = reg.snapshot()["histograms"]
+    key = 'serve.stage.e2e{rung=device_sum}'
+    assert snap[key]["count"] == 1
+    assert set(snap[key]) >= {"count", "sum_s", "max_s", "p50_s",
+                              "p90_s", "p99_s", "p999_s"}
+
+
+def test_prometheus_histogram_exposition():
+    reg = MetricsRegistry()
+    h = reg.histogram("serve.stage.e2e", rung="device_sum")
+    for v in (0.0005, 0.002, 0.002, 0.5, 20.0):
+        h.observe(v)
+    text = reg.to_prometheus()
+    lines = text.splitlines()
+    assert "# TYPE lgbm_tpu_serve_stage_e2e_seconds histogram" in lines
+    bucket_lines = [l for l in lines if "_bucket{" in l]
+    assert bucket_lines, "no _bucket series exported"
+    # instance labels merged ahead of le, on every bucket line
+    assert all('rung="device_sum"' in l and 'le="' in l
+               for l in bucket_lines)
+    # cumulative and ending at the total count
+    counts = [int(l.rsplit(" ", 1)[1]) for l in bucket_lines]
+    assert counts == sorted(counts)
+    assert bucket_lines[-1].endswith(" 5") and 'le="+Inf"' in \
+        bucket_lines[-1]
+    assert ('lgbm_tpu_serve_stage_e2e_seconds_count'
+            '{rung="device_sum"} 5') in lines
+    sums = [l for l in lines if l.startswith(
+        'lgbm_tpu_serve_stage_e2e_seconds_sum')]
+    assert len(sums) == 1 and float(sums[0].rsplit(" ", 1)[1]) == \
+        pytest.approx(20.5045)
+
+
+def test_prometheus_summary_min_max_are_gauges():
+    # min/max are NOT valid summary series — they must ride as separate
+    # gauge families with their own TYPE lines (the PR 8 satellite fix)
+    reg = MetricsRegistry()
+    t = reg.timing("span.eval")
+    t.observe(0.25)
+    t.observe(0.75)
+    lines = reg.to_prometheus().splitlines()
+    assert "# TYPE lgbm_tpu_span_eval_seconds summary" in lines
+    assert "# TYPE lgbm_tpu_span_eval_seconds_min gauge" in lines
+    assert "# TYPE lgbm_tpu_span_eval_seconds_max gauge" in lines
+    assert "lgbm_tpu_span_eval_seconds_min 0.250000" in lines
+    assert "lgbm_tpu_span_eval_seconds_max 0.750000" in lines
+
+
+# ------------------------------------------------------------ threading
+def test_concurrent_observers_lose_nothing():
+    reg = MetricsRegistry()
+    c = reg.counter("hammer.count")
+    t = reg.timing("hammer.time")
+    h = reg.histogram("hammer.hist")
+    N, THREADS = 2000, 8
+
+    def work():
+        for i in range(N):
+            c.inc()
+            t.observe(0.001)
+            h.observe(0.001 * (1 + (i % 7)))
+
+    threads = [threading.Thread(target=work) for _ in range(THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert c.value == N * THREADS
+    assert t.count == N * THREADS
+    assert t.total == pytest.approx(0.001 * N * THREADS)
+    assert h.count == N * THREADS == sum(h.counts)

@@ -303,8 +303,8 @@ def test_batcher_coalesces_concurrent_requests():
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)
     inner = rt.predict
-    rt.predict = lambda Xq, raw_score=False: (
-        time.sleep(0.03), inner(Xq, raw_score=raw_score))[1]
+    rt.predict = lambda Xq, raw_score=False, clock=None: (
+        time.sleep(0.03), inner(Xq, raw_score=raw_score, clock=clock))[1]
     before = telemetry.REGISTRY.counter("serve.batches").value
     with MicroBatcher(rt, max_wait_ms=50.0) as b:
         reqs = [b.submit(X[i * 4:(i + 1) * 4]) for i in range(12)]
@@ -329,8 +329,8 @@ def test_batcher_sheds_on_full_queue():
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)
     inner = rt.predict
-    rt.predict = lambda Xq, raw_score=False: (
-        time.sleep(0.2), inner(Xq, raw_score=raw_score))[1]
+    rt.predict = lambda Xq, raw_score=False, clock=None: (
+        time.sleep(0.2), inner(Xq, raw_score=raw_score, clock=clock))[1]
     shed = 0
     with MicroBatcher(rt, max_wait_ms=0.0, queue_depth=1) as b:
         b.submit(X[:2])
@@ -346,8 +346,8 @@ def test_batcher_deadline_shedding():
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)
     inner = rt.predict
-    rt.predict = lambda Xq, raw_score=False: (
-        time.sleep(0.05), inner(Xq, raw_score=raw_score))[1]
+    rt.predict = lambda Xq, raw_score=False, clock=None: (
+        time.sleep(0.05), inner(Xq, raw_score=raw_score, clock=clock))[1]
     before = telemetry.REGISTRY.counter("serve.shed").value
     with MicroBatcher(rt, max_wait_ms=0.0, deadline_ms=5.0) as b:
         reqs = [b.submit(X[:4]) for _ in range(5)]

@@ -1,0 +1,270 @@
+"""Request-scoped serving observability (ISSUE 8 tentpole parts 2+3).
+
+The load-bearing claims:
+
+* PROPAGATION — an inbound `X-Request-Id` tags the request's trace,
+  echoes back as header AND body field on every /predict response, and
+  is findable at `/debug/requests`; absent the header a generated id
+  round-trips the same way.
+* ACCOUNTING — a completed trace's stage deltas (queue_wait → coalesce
+  → stage_copy → dispatch → d2h → convert → finish) sum to its e2e
+  latency within 5% (the acceptance criterion), and the per-rung
+  `serve.stage.*` histograms export real `_bucket{le=...}` series.
+* RECORDER — the ring is bounded (oldest evicted), tail-sampled (every
+  shed / error / host-walk / slow trace kept, healthy traffic 1-in-N),
+  and `serve_trace=false` disables it without touching predictions.
+* SHEDS — per-cause counters split `serve.shed.queue_full` from
+  `serve.shed.deadline`, and the queue-depth gauge tracks submits.
+* SENTINEL — `telemetry diff` exits 1 when a server-side p99 regresses
+  (both the bench `serving.server.<rung>` block and the registry's
+  `serve.stage.*` percentile paths).
+* PARITY — predictions are byte-identical with tracing on and off.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import (MicroBatcher, ServingClient,
+                                  ServingOverloadError, ServingRuntime)
+from lightgbm_tpu.serving.http import make_server
+from lightgbm_tpu.telemetry.request_trace import (RequestTrace,
+                                                  ServeRecorder)
+from lightgbm_tpu.telemetry.diff import main as diff_main
+
+pytestmark = pytest.mark.quick
+
+
+def _golden(name):
+    bst = Booster(model_file=f"tests/data/golden_{name}.model.txt")
+    X, _ = make_case_data(GOLDEN_CASES[name])
+    return bst, X
+
+
+def _serve(client):
+    srv = make_server(client, "127.0.0.1", 0)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f"http://127.0.0.1:{srv.server_address[1]}"
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers=dict({"Content-Type": "application/json"},
+                     **(headers or {})))
+    resp = urllib.request.urlopen(req, timeout=60)
+    return resp, json.loads(resp.read())
+
+
+def _get(url):
+    return json.loads(urllib.request.urlopen(url, timeout=30).read())
+
+
+# ------------------------------------------------------- HTTP round trip
+def test_http_trace_round_trip_and_stage_accounting():
+    bst, X = _golden("binary")
+    # slow_ms=0: every completed request is "slow", so the ring records
+    # the ok trace this test needs to inspect
+    client = ServingClient(bst, params={"serve_warmup": False,
+                                        "serve_trace_slow_ms": 0.0})
+    telemetry.SERVE_RECORDER.clear()
+    srv, base = _serve(client)
+    try:
+        resp, body = _post(f"{base}/predict",
+                           {"rows": X[:256].tolist(), "raw_score": True},
+                           headers={"X-Request-Id": "trace-test-1"})
+        assert resp.headers["X-Request-Id"] == "trace-test-1"
+        assert body["request_id"] == "trace-test-1"
+        assert np.array_equal(np.asarray(body["predictions"]),
+                              bst.predict(X[:256], raw_score=True))
+
+        dbg = _get(f"{base}/debug/requests")
+        assert dbg["enabled"] and dbg["seen"] >= 1
+        tr = next(t for t in dbg["requests"] if t["id"] == "trace-test-1")
+        assert tr["status"] == "ok" and tr["rows"] == 256
+        assert tr["rung"] in ("device_sum", "slot_path", "host_walk")
+        # the acceptance criterion: stages partition the e2e timeline
+        stage_sum = sum(tr["stages_ms"].values())
+        assert stage_sum == pytest.approx(tr["e2e_ms"], rel=0.05), \
+            f"stages {tr['stages_ms']} sum {stage_sum} vs {tr['e2e_ms']}"
+        assert tr["stages_ms"].get("dispatch", 0) > 0
+
+        # server-side histograms made it to /metrics as classic buckets
+        metrics = urllib.request.urlopen(
+            f"{base}/metrics", timeout=30).read().decode()
+        assert "lgbm_tpu_serve_stage_e2e_seconds_bucket{" in metrics
+        assert 'le="+Inf"' in metrics
+        # and /healthz carries the merged percentile block
+        hz = _get(f"{base}/healthz")
+        assert hz["latency_ms"]["count"] >= 1
+        assert hz["latency_ms"]["p99_ms"] > 0
+
+        # ?n= limit honored
+        assert len(_get(f"{base}/debug/requests?n=1")["requests"]) == 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        client.close()
+
+
+def test_http_generates_request_id_when_absent():
+    bst, X = _golden("binary")
+    client = ServingClient(bst, params={"serve_warmup": False})
+    srv, base = _serve(client)
+    try:
+        resp, body = _post(f"{base}/predict", {"rows": X[:4].tolist()})
+        rid = body["request_id"]
+        assert rid and resp.headers["X-Request-Id"] == rid
+        assert len(rid) == 16 and int(rid, 16) >= 0   # uuid4 hex prefix
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        client.close()
+
+
+# ------------------------------------------------------ recorder (unit)
+def _mk_trace(status="ok", rung="device_sum", e2e_ms=1.0, rid=None):
+    tr = RequestTrace(request_id=rid, model="m", rows=4)
+    tr.rung = rung
+    tr.add_stage("dispatch", e2e_ms / 1e3)
+    tr.finish(status, None if status == "ok" else status)
+    tr.t0 = tr.t_end - e2e_ms / 1e3        # pin e2e for the keep rules
+    return tr
+
+
+def test_ring_bounded_newest_kept():
+    rec = ServeRecorder(capacity=4, slow_ms=0.0)       # keep everything
+    for i in range(10):
+        assert rec.record(_mk_trace(rid=f"r{i}"))
+    snap = rec.snapshot()
+    assert snap["seen"] == 10 and snap["recorded"] == 10
+    assert [t["id"] for t in snap["requests"]] == \
+        ["r9", "r8", "r7", "r6"]                       # newest first
+
+
+def test_tail_sampling_rules():
+    rec = ServeRecorder(capacity=100, slow_ms=50.0, sample_every=5)
+    # healthy fast traffic: only the deterministic 1-in-5 survives
+    kept = sum(rec.record(_mk_trace(e2e_ms=1.0)) for _ in range(10))
+    assert kept == 2
+    # the tail is never sampled away
+    assert rec.record(_mk_trace(status="shed_queue_full", e2e_ms=0.1))
+    assert rec.record(_mk_trace(status="error", e2e_ms=0.1))
+    assert rec.record(_mk_trace(rung="host_walk", e2e_ms=0.1))
+    assert rec.record(_mk_trace(e2e_ms=60.0))          # over slow_ms
+    statuses = [t["status"] for t in rec.snapshot()["requests"]]
+    assert "shed_queue_full" in statuses and "error" in statuses
+
+
+def test_recorder_disabled_is_inert():
+    rec = ServeRecorder(enabled=False, slow_ms=0.0)
+    assert not rec.record(_mk_trace())
+    assert rec.snapshot()["requests"] == []
+    rec.configure(enabled=True)
+    assert rec.record(_mk_trace())
+
+
+# ------------------------------------------------- sheds + queue depth
+def test_shed_cause_counters_and_queue_depth_gauge():
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    inner = rt.predict
+    rt.predict = lambda Xq, raw_score=False, clock=None: (
+        time.sleep(0.2), inner(Xq, raw_score=raw_score, clock=clock))[1]
+    qf = telemetry.REGISTRY.counter("serve.shed.queue_full")
+    agg = telemetry.REGISTRY.counter("serve.shed")
+    before_qf, before_agg = qf.value, agg.value
+    with MicroBatcher(rt, max_wait_ms=0.0, queue_depth=1) as b:
+        b.submit(X[:2])
+        shed = 0
+        for _ in range(20):
+            try:
+                b.submit(X[:2])
+            except ServingOverloadError:
+                shed += 1
+        depth = telemetry.REGISTRY.gauge("serve.queue_depth").value
+    assert shed >= 1
+    assert qf.value - before_qf == shed      # cause split matches
+    assert agg.value - before_agg >= shed    # aggregate keeps counting
+    assert depth >= 0
+
+
+def test_deadline_shed_counted_by_cause_and_traced():
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst)
+    inner = rt.predict
+    rt.predict = lambda Xq, raw_score=False, clock=None: (
+        time.sleep(0.05), inner(Xq, raw_score=raw_score, clock=clock))[1]
+    dl = telemetry.REGISTRY.counter("serve.shed.deadline")
+    before = dl.value
+    telemetry.SERVE_RECORDER.configure(enabled=True)
+    telemetry.SERVE_RECORDER.clear()
+    with MicroBatcher(rt, max_wait_ms=0.0, deadline_ms=5.0) as b:
+        reqs = [b.submit(X[:4]) for _ in range(5)]
+        shed = 0
+        for r in reqs:
+            try:
+                r.wait(30)
+            except ServingOverloadError:
+                shed += 1
+    assert shed >= 1 and dl.value - before == shed
+    # shed traces are tail, always recorded
+    statuses = [t["status"] for t in
+                telemetry.SERVE_RECORDER.snapshot()["requests"]]
+    assert statuses.count("shed_deadline") == shed
+
+
+# -------------------------------------------------------------- parity
+def test_byte_identical_with_tracing_on_and_off():
+    bst, X = _golden("multiclass")
+    want = bst.predict(X[:257], raw_score=True)
+    outs = {}
+    for flag in (True, False):
+        c = ServingClient(bst, params={"serve_warmup": False,
+                                       "serve_trace": flag})
+        try:
+            outs[flag] = c.predict(X[:257], raw_score=True)
+        finally:
+            c.close()
+    assert np.array_equal(outs[True], want)
+    assert outs[True].tobytes() == outs[False].tobytes()
+
+
+# ------------------------------------------------------------ sentinel
+def _write(tmp_path, name, obj):
+    p = tmp_path / name
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_diff_fails_on_doctored_bench_server_p99(tmp_path):
+    base = {"serving": {"server": {"device_sum":
+            {"count": 50, "p50_ms": 2.0, "p99_ms": 5.0}}}}
+    cur = json.loads(json.dumps(base))
+    cur["serving"]["server"]["device_sum"]["p99_ms"] = 500.0
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cur)
+    assert diff_main([a, a]) == 0
+    assert diff_main([a, b]) == 1                      # plain: fails
+    assert diff_main([a, b, "--warn-timings"]) == 0    # CI fallback: warns
+
+
+def test_diff_fails_on_doctored_stage_histogram_p99(tmp_path):
+    key = "serve.stage.e2e{rung=device_sum}"
+    base = {"metrics": {"histograms": {key: {
+        "count": 100, "sum_s": 0.5, "max_s": 0.02,
+        "p50_s": 0.004, "p90_s": 0.008, "p99_s": 0.012,
+        "p999_s": 0.015}}}}
+    cur = json.loads(json.dumps(base))
+    cur["metrics"]["histograms"][key]["p99_s"] = 1.2
+    cur["metrics"]["histograms"][key]["count"] = 400   # load: ignored
+    a = _write(tmp_path, "a.json", base)
+    b = _write(tmp_path, "b.json", cur)
+    assert diff_main([a, a]) == 0
+    assert diff_main([a, b]) == 1

@@ -338,8 +338,8 @@ class TestMetricsRegistry:
             "pkg = types.ModuleType('tel')\n"
             "pkg.__path__ = ['lightgbm_tpu/telemetry']\n"
             "sys.modules['tel'] = pkg\n"
-            "for mod in ('metrics', 'sinks', 'spans', 'report', "
-            "'recorder', 'diff'):\n"
+            "for mod in ('metrics', 'sinks', 'spans', 'request_trace', "
+            "'report', 'recorder', 'diff'):\n"
             "    spec = importlib.util.spec_from_file_location(\n"
             "        'tel.' + mod, 'lightgbm_tpu/telemetry/' + mod + '.py')\n"
             "    m = importlib.util.module_from_spec(spec)\n"

@@ -553,6 +553,18 @@ def _run_worker() -> None:
     # only the python bookkeeping (and the summary lands in BENCH JSON)
     params = {"objective": "binary", "verbosity": -1,
               "flight_recorder": True, **BENCH_CONFIG}
+    if os.environ.get("BENCH_EXTERNAL_MEMORY"):
+        # BENCH_EXTERNAL_MEMORY=<budget_mb> (or "1" for the default
+        # budget): run the same measurement through the spilled shard
+        # store — the datastore.* gauges ride the @telemetry snapshot, so
+        # the emitted JSON shows spill volume, shard count and the
+        # prefetch residency watermark next to rounds/sec
+        budget = os.environ["BENCH_EXTERNAL_MEMORY"]
+        params["external_memory"] = True
+        if budget not in ("1", "true"):
+            params["datastore_budget_mb"] = float(budget)
+        _log(f"external-memory mode: budget="
+             f"{params.get('datastore_budget_mb', 'default')} MB")
     t0 = time.time()
     ds = lgb.Dataset(X, label=y)
     bst = Booster(params=params, train_set=ds)

@@ -172,6 +172,10 @@ class Dataset:
         self.num_total_bin: int = 0
         self.efb = None                        # BundleSpec (utils/efb.py)
         self.bundle_data: Optional[np.ndarray] = None  # [N, G] when bundled
+        # external-memory spill (datastore/): when set, the on-disk shard
+        # store is the canonical binned form and bin_data/bundle_data are
+        # freed — the booster streams shards to assemble its device matrix
+        self.datastore = None
         self._feature_names: Optional[List[str]] = None
         self._num_data: Optional[int] = None
         self._num_feature: Optional[int] = None
@@ -326,6 +330,81 @@ class Dataset:
             self.bundle_data = build_bundled(self.bin_data, self.efb)
         self._set_all_fields()
         self._handle_constructed = True
+        # external-memory spill comes AFTER EFB: bundling needs the dense
+        # matrix, and spilling both payloads keeps spilled models
+        # byte-identical to in-memory ones, bundling included.  Valid
+        # sets (reference != None) stay resident — they are only
+        # traversed, never histogrammed.
+        if cfg.external_memory and self.reference is None and \
+                self.bin_data is not None:
+            self._spill_to_datastore(cfg)
+
+    # ------------------------------------------------- external-memory spill
+    def _new_datastore_dir(self, cfg: Config) -> str:
+        """A fresh directory for this dataset's shards: a unique subdir of
+        `datastore_dir` when given (the user owns its lifetime), else a
+        process-temp dir removed at interpreter exit."""
+        import tempfile
+        if cfg.datastore_dir:
+            import os
+            os.makedirs(cfg.datastore_dir, exist_ok=True)
+            return tempfile.mkdtemp(prefix="dstore-", dir=cfg.datastore_dir)
+        import atexit
+        import shutil
+        d = tempfile.mkdtemp(prefix="lgbm-tpu-dstore-")
+        atexit.register(shutil.rmtree, d, ignore_errors=True)
+        return d
+
+    def _datastore_shard_rows(self, cfg: Config, n: int, row_bytes: int) -> int:
+        from .datastore import auto_shard_rows
+        if int(cfg.datastore_shard_rows) > 0:
+            return int(cfg.datastore_shard_rows)
+        return auto_shard_rows(n, row_bytes, cfg.datastore_budget_mb,
+                               cfg.datastore_prefetch)
+
+    def _record_spill_telemetry(self) -> None:
+        from . import telemetry
+        telemetry.REGISTRY.gauge("datastore.spill_bytes").set(
+            self.datastore.total_bytes())
+        telemetry.REGISTRY.gauge("datastore.shards").set(
+            self.datastore.n_shards)
+
+    def _spill_to_datastore(self, cfg: Config) -> None:
+        """Move the constructed bin (+ bundle/label/weight) matrices into
+        an on-disk shard store and free the host copies; the store is the
+        canonical binned form from here on."""
+        from .datastore import ShardWriter
+        bins = np.asarray(self.bin_data)
+        n, f = bins.shape
+        bundle = np.asarray(self.bundle_data) \
+            if self.bundle_data is not None else None
+        g = bundle.shape[1] if bundle is not None else 0
+        row_bytes = (f + g) * bins.dtype.itemsize + \
+            4 * ((self._label_arr is not None) +
+                 (self._weight_arr is not None))
+        shard_rows = self._datastore_shard_rows(cfg, n, row_bytes)
+        w = ShardWriter(self._new_datastore_dir(cfg), n_features=f,
+                        dtype=bins.dtype, shard_rows=shard_rows,
+                        bundle_cols=g,
+                        has_label=self._label_arr is not None,
+                        has_weight=self._weight_arr is not None,
+                        meta={"num_total_bin": int(self.num_total_bin)})
+        for lo in range(0, n, shard_rows):
+            hi = min(lo + shard_rows, n)
+            w.append(bins[lo:hi],
+                     bundle=bundle[lo:hi] if bundle is not None else None,
+                     label=(self._label_arr[lo:hi]
+                            if self._label_arr is not None else None),
+                     weight=(self._weight_arr[lo:hi]
+                             if self._weight_arr is not None else None))
+        self.datastore = w.finalize()
+        self._record_spill_telemetry()
+        log.info(f"external memory: spilled {n} rows x {f} features to "
+                 f"{self.datastore.n_shards} shards "
+                 f"({self.datastore.total_bytes() >> 20} MB) in "
+                 f"{self.datastore.dirpath}")
+        self.bin_data = None
+        self.bundle_data = None
 
     def _fit_bin_mappers(self, raw: np.ndarray, cfg: Config) -> List[BinMapper]:
         n, f = raw.shape
@@ -467,6 +546,25 @@ class Dataset:
 
         max_nb = max((m.num_bin for m in self.bin_mappers), default=1)
         dtype = np.uint8 if max_nb <= 256 else np.uint16
+        if self.label is None:
+            self.label = np.concatenate(labels)
+        if self.weight is None and weights:
+            self.weight = np.concatenate(weights)
+        if self.group is None and group_ids:
+            self.group = group_ids_to_sizes(np.concatenate(group_ids))
+
+        if cfg.external_memory:
+            # external memory requested: bin each chunk straight into the
+            # shard writer — the dense [N, F] matrix never materializes,
+            # so peak RSS stays O(shard + sample), not O(N·F)
+            self._stream_pass2_datastore(cfg, chunks, StreamReader,
+                                         chunk_rows, drop, n, f, dtype)
+            log.info(f"two_round streaming ingest: {n} rows x {f} "
+                     f"features spilled to {self.datastore.n_shards} "
+                     f"shards without materializing the bin matrix")
+            self._finish_datastore_construct(cfg)
+            return True
+
         self.bin_data = np.empty((n, f), dtype=dtype)
         pos = 0
         for chunk in chunks(StreamReader(self.data,
@@ -483,18 +581,65 @@ class Dataset:
             raise LightGBMError(
                 f"file changed between streaming passes ({pos} vs {n} "
                 f"rows)")
-        if self.label is None:
-            self.label = np.concatenate(labels)
-        if self.weight is None and weights:
-            self.weight = np.concatenate(weights)
-        if self.group is None and group_ids:
-            self.group = group_ids_to_sizes(np.concatenate(group_ids))
         log.info(f"two_round streaming ingest: {n} rows x {f} features "
                  f"binned without materializing the raw matrix")
         self._finish_dense_construct(cfg)
         # self.data stays the (tiny) path string — raw values were never
         # materialized, so there is nothing to free
         return True
+
+    def _stream_pass2_datastore(self, cfg: Config, chunks, reader_cls,
+                                chunk_rows: int, drop, n: int, f: int,
+                                dtype) -> None:
+        """Streaming pass 2 for external memory: chunk → bins → shard
+        writer.  Labels/weights were collected in pass 1 (they are O(N)
+        f32 and stay resident either way); their slices ride along so the
+        store is self-contained."""
+        lab = _to_1d_float(self.label, "label", np.float32) \
+            if self.label is not None else None
+        wt = _to_1d_float(self.weight, "weight", np.float32) \
+            if self.weight is not None else None
+        from .datastore import ShardWriter
+        row_bytes = f * np.dtype(dtype).itemsize + \
+            4 * ((lab is not None) + (wt is not None))
+        shard_rows = self._datastore_shard_rows(cfg, n, row_bytes)
+        w = ShardWriter(self._new_datastore_dir(cfg), n_features=f,
+                        dtype=dtype, shard_rows=shard_rows,
+                        has_label=lab is not None,
+                        has_weight=wt is not None)
+        pos = 0
+        for chunk in chunks(reader_cls(self.data, chunk_rows=chunk_rows)):
+            xc = np.delete(chunk, drop, axis=1)
+            if pos + len(xc) > n:
+                raise LightGBMError(
+                    f"file changed between streaming passes (> {n} rows)")
+            block = np.empty((len(xc), f), dtype=dtype)
+            for j, m in enumerate(self.bin_mappers):
+                block[:, j] = m.values_to_bins(xc[:, j]).astype(dtype)
+            w.append(block,
+                     label=lab[pos:pos + len(xc)] if lab is not None
+                     else None,
+                     weight=wt[pos:pos + len(xc)] if wt is not None
+                     else None)
+            pos += len(xc)
+        if pos != n:
+            raise LightGBMError(
+                f"file changed between streaming passes ({pos} vs {n} "
+                f"rows)")
+        self.datastore = w.finalize()
+        self._record_spill_telemetry()
+
+    def _finish_datastore_construct(self, cfg: Config) -> None:
+        """Construct tail for the streamed-to-disk path: no dense matrix
+        exists, so EFB (which scans it for conflicts) is skipped."""
+        self.num_total_bin = sum(m.num_bin for m in self.bin_mappers)
+        if cfg.enable_bundle:
+            log.info("EFB disabled for streamed external-memory ingest "
+                     "(bundling needs the dense bin matrix, which this "
+                     "path never materializes)")
+        self.efb = None
+        self._set_all_fields()
+        self._handle_constructed = True
 
     # ----------------------------------------------------- sparse construct
     def _construct_sparse(self, cfg: Config) -> None:
@@ -507,6 +652,10 @@ class Dataset:
         applies, dense [N, F] bins otherwise)."""
         from .utils.efb import (build_bundled_sparse, find_bundles_sparse,
                                 materialize_dense_bins)
+        if cfg.external_memory:
+            log.warning("external_memory is not supported for sparse "
+                        "input (the EFB-bundled sparse form is already "
+                        "compact); training in-memory")
         n, f = (int(s) for s in self.data.shape)
         self._num_data, self._num_feature = n, f
         self._feature_names = _feature_names_from(
@@ -619,6 +768,10 @@ class Dataset:
         binned form when the EFB path skipped it."""
         if self.bin_data is not None:
             return np.asarray(self.bin_data)
+        if self.datastore is not None:
+            # escape hatch: O(N·F) host memory, only for paths that truly
+            # need the full matrix at once (DART traversal, add_features)
+            return self.datastore.read_all_rows("bins")
         if self.sparse_binned is None:
             raise LightGBMError("Dataset has no binned data (not "
                                 "constructed?)")
@@ -632,6 +785,23 @@ class Dataset:
         self.bin_mappers = ref.bin_mappers
         if ref.bin_data is not None:
             self.bin_data = np.asarray(ref.bin_data)[idx]
+        elif ref.datastore is not None:
+            # spilled parent (cv folds, Dataset.subset, bagging subsets):
+            # gather only the selected rows, skipping shards none of whose
+            # rows were sampled — the bytes that never leave disk are the
+            # out-of-core win GOSS/bagging promises (ROADMAP item 2)
+            self.bin_data, saved, skipped = \
+                ref.datastore.gather_rows(idx, "bins")
+            if "bundle" in ref.datastore.payloads:
+                self.bundle_data = \
+                    ref.datastore.gather_rows(idx, "bundle")[0]
+            from . import telemetry
+            telemetry.REGISTRY.counter("datastore.h2d_bytes_saved")\
+                .inc(int(saved))
+            if skipped:
+                log.info(f"datastore subset: skipped {skipped}/"
+                         f"{ref.datastore.n_shards} shards "
+                         f"({saved >> 10} KB never read)")
         else:
             # sparse-EFB parent: subset the sparse binned form (row slice
             # on the CSR view), keep bin_data unmaterialized
@@ -832,6 +1002,12 @@ class Dataset:
         return self
 
     def _savez(self, fh) -> None:
+        if self.bin_data is None and self.datastore is not None:
+            raise LightGBMError(
+                "save_binary is not supported for external-memory "
+                "(spilled) Datasets — the datastore directory at "
+                f"'{self.datastore.dirpath}' already is the reloadable "
+                "on-disk form (pass datastore_dir to keep it)")
         if self.bin_data is not None:
             payload = {"bin_data": np.asarray(self.bin_data)}
         else:  # sparse-EFB dataset: persist the binned CSC triplet

@@ -73,6 +73,11 @@ class _DeviceData:
         # `bins_fm` materializes lazily if a traversal path (DART drop,
         # per-tree valid scoring on train bins) actually needs it.
         self.efb = getattr(ds, "efb", None)
+        # external-memory: the spilled shard store replaces the in-host
+        # matrices — bins_fm/bundle_fm assemble lazily by STREAMING shards
+        # to the device (datastore/assemble.py), not via a full host copy
+        self._store = getattr(ds, "datastore", None)
+        self._for_train = for_train
         self._bins_fm = None
         if ds.bin_data is not None:
             bins = np.asarray(ds.bin_data)
@@ -80,8 +85,8 @@ class _DeviceData:
         # raw values retained for linear-tree leaf fits / scoring
         self.raw_ref = ds.data if ds.data is not None else None
         self._raw2d: Optional[np.ndarray] = None
-        self.bundle_fm = None
-        if self.efb is not None and for_train:
+        self._bundle_fm = None
+        if self.efb is not None and for_train and self._store is None:
             bd = ds.bundle_data
             if bd is None:  # e.g. train continuation on a referenced Dataset
                 if ds.bin_data is not None:
@@ -92,7 +97,7 @@ class _DeviceData:
                     from .utils.efb import build_bundled_sparse
                     bd = ds.bundle_data = build_bundled_sparse(
                         ds.sparse_binned, self.efb, ds.bin_mappers)
-            self.bundle_fm = jnp.asarray(
+            self._bundle_fm = jnp.asarray(
                 np.ascontiguousarray(np.asarray(bd).T))
         mappers = ds.bin_mappers
         self.feat_nb = jnp.asarray(
@@ -124,15 +129,41 @@ class _DeviceData:
         self.query_boundaries = ds._query_boundaries
 
     @property
+    def datastore_pending(self) -> bool:
+        """True while a spilled dataset's training matrix has not been
+        assembled on device yet — the booster defers that first assembly
+        into the train.chunk span so the per-shard spans nest there."""
+        needs_bundle = self.efb is not None and self._for_train
+        pending = self._bundle_fm is None if needs_bundle \
+            else self._bins_fm is None
+        return self._store is not None and pending
+
+    def _assemble_from_store(self, payload: str):
+        from .datastore.assemble import assemble_feature_major
+        depth = Config(self._ds.params or {}).datastore_prefetch
+        return assemble_feature_major(self._store, payload=payload,
+                                      prefetch_depth=depth)
+
+    @property
     def bins_fm(self):
         if self._bins_fm is None:
-            log.warning("materializing the dense [N, F] bin matrix from a "
-                        "sparse dataset for tree traversal — avoid DART / "
-                        "train-set traversal paths on sparse-EFB data if "
-                        "memory-bound")
-            dense = self._ds._dense_bin_matrix()
-            self._bins_fm = jnp.asarray(np.ascontiguousarray(dense.T))
+            if self._store is not None:
+                self._bins_fm = self._assemble_from_store("bins")
+            else:
+                log.warning("materializing the dense [N, F] bin matrix "
+                            "from a sparse dataset for tree traversal — "
+                            "avoid DART / train-set traversal paths on "
+                            "sparse-EFB data if memory-bound")
+                dense = self._ds._dense_bin_matrix()
+                self._bins_fm = jnp.asarray(np.ascontiguousarray(dense.T))
         return self._bins_fm
+
+    @property
+    def bundle_fm(self):
+        if self._bundle_fm is None and self.efb is not None \
+                and self._for_train and self._store is not None:
+            self._bundle_fm = self._assemble_from_store("bundle")
+        return self._bundle_fm
 
     def get_raw(self) -> np.ndarray:
         """Raw feature matrix (linear trees only; requires the Dataset to
@@ -299,7 +330,12 @@ class Booster:
                      # construct(), or train and predict would drop
                      # different columns from the same file
                      "weight_column", "group_column", "ignore_column",
-                     "two_round")}}
+                     "two_round",
+                     # external-memory spill config must reach construct()
+                     # — that is where the shard store is written
+                     "external_memory", "datastore_dir",
+                     "datastore_shard_rows", "datastore_budget_mb",
+                     "datastore_prefetch")}}
         self.train_set = train_set
         self._dd = _DeviceData(train_set)
         self.objective_: Optional[ObjectiveFunction] = \
@@ -970,13 +1006,22 @@ class Booster:
         # quiet resolution via the shared topology resolver — warnings
         # fire once, after the cache check
         kind, shards, n_dev, dcn, use_2level, _ = self._learner_topology()
-        # EFB: training reads the bundled matrix (see _DeviceData)
-        train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
         if kind == "serial":
+            # external-memory sets keep _train_bins unresolved here: the
+            # first train.chunk span assembles it (_ensure_train_bins), so
+            # the per-shard H2D spans land inside the pipeline window
             self._mesh = None
-            self._train_bins = train_src
+            self._train_bins = None if self._dd.datastore_pending else (
+                self._dd.bundle_fm if bundled else self._dd.bins_fm)
             self._learner_cache_key = None
             return
+        if self._dd.datastore_pending:
+            log.warning(f"tree_learner={kind} with external_memory "
+                        "assembles the full device matrix before placing "
+                        "it on the mesh (streamed distributed training is "
+                        "not implemented yet)")
+        # EFB: training reads the bundled matrix (see _DeviceData)
+        train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
         # reset_parameter (lr schedules) calls this every iteration — reuse
         # the compiled grower and placed bins when nothing changed
         wave = self._grow_policy == "wave"
@@ -1024,6 +1069,16 @@ class Booster:
         self._learner_cache_key = key
         log.info(f"tree_learner={kind}: training sharded over "
                  f"{shards} device(s)")
+
+    def _ensure_train_bins(self) -> None:
+        """Resolve a lazily-deferred training matrix (external-memory
+        serial path).  Called inside the surrounding train.chunk span so
+        the one-time shard-streaming assembly shows up as nested
+        train.shard spans; later calls are no-ops."""
+        if self._train_bins is not None or getattr(self, "_dd", None) is None:
+            return
+        self._train_bins = self._dd.bundle_fm \
+            if self._dd.efb is not None else self._dd.bins_fm
 
     def _zero_score(self, dd: _DeviceData) -> jax.Array:
         K = self.num_tree_per_iteration
@@ -1147,6 +1202,7 @@ class Booster:
                 "Cannot train without a train set (was it freed by "
                 "free_dataset()?); prediction and model IO remain "
                 "available")
+        self._ensure_train_bins()
         if getattr(self, "_scores_stale", False):
             # set_leaf_output mutated the model — cached scores are wrong
             self._rebuild_train_scores()
@@ -1680,6 +1736,7 @@ class Booster:
         telemetry.REGISTRY.gauge("train.pipeline.depth").set(
             self._pipeline_depth())
         with telemetry.span("train.chunk", rounds=spec.chunk, fused=True):
+            self._ensure_train_bins()
             with telemetry.span("compile_warmup", kind="bulk_trainer") \
                     if not warm else telemetry.NOOP, self._nan_check_ctx():
                 score, vfinal, stacked, v_iter, t_iter = trainer(

@@ -1,0 +1,21 @@
+"""External-memory datastore: sharded on-disk binned datasets.
+
+`ShardWriter` spills a binned Dataset (or a streaming two-round ingest)
+into checksummed row shards; `ShardStore` mmap-reads them back;
+`ShardPrefetcher` overlaps disk reads with device work; `assemble`
+(imported lazily — it needs jax) streams shards into the feature-major
+device matrix the grower trains on.  See docs/EXTERNAL_MEMORY.md.
+
+Everything exported here is importable without jax.
+"""
+from .format import (FORMAT_NAME, FORMAT_VERSION, MANIFEST_NAME, PAYLOADS,
+                     read_manifest)
+from .prefetch import ShardPrefetcher
+from .store import PIPELINE_SLACK_BLOCKS, ShardStore, ShardWriter, \
+    auto_shard_rows
+
+__all__ = [
+    "FORMAT_NAME", "FORMAT_VERSION", "MANIFEST_NAME", "PAYLOADS",
+    "PIPELINE_SLACK_BLOCKS", "ShardPrefetcher", "ShardStore", "ShardWriter",
+    "auto_shard_rows", "read_manifest",
+]

@@ -1,0 +1,125 @@
+"""On-disk format for the external-memory shard store.
+
+Layout of a datastore directory (one constructed Dataset spilled to
+disk — see docs/EXTERNAL_MEMORY.md for the design):
+
+    manifest.json            versioned index + checksums (this module)
+    shard-00000.bins         [F, rows] C-order uint8/uint16 bin codes
+    shard-00000.bundle       [G, rows] EFB-bundled codes (optional)
+    shard-00000.label        [rows] float32 (optional)
+    shard-00000.weight       [rows] float32 (optional)
+    shard-00001.bins         ...
+
+Every payload file carries a crc32 + byte count in the manifest, and the
+manifest itself embeds a self-checksum (`manifest_crc32` over the
+canonical JSON dump of the other fields), so a truncated write, a bit
+flip, or a file swapped between runs is a hard, EARLY error — never
+silently-garbage bin codes feeding the grower (the reference's binary
+dataset files carry no integrity check at all; out-of-core shards live
+on disks we do not control, so ours must).
+
+STDLIB + numpy only, importable without jax: the jax-free import matrix
+(tests/test_telemetry.py) loads this module by file path in a process
+that must never touch a backend.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any, Dict
+
+try:  # real package: the user-facing error type
+    from ..utils.log import LightGBMError
+except ImportError:  # file-path load in a jax-free synthetic package
+    class LightGBMError(RuntimeError):
+        pass
+
+#: bump when the on-disk layout changes; readers reject other versions
+FORMAT_VERSION = 1
+FORMAT_NAME = "lightgbm-tpu-datastore"
+MANIFEST_NAME = "manifest.json"
+
+#: payloads a shard may carry, in canonical order
+PAYLOADS = ("bins", "bundle", "label", "weight")
+
+
+def shard_filename(index: int, payload: str) -> str:
+    return f"shard-{index:05d}.{payload}"
+
+
+def crc32_bytes(buf) -> int:
+    """crc32 of a bytes-like object (memoryview/mmap accepted)."""
+    return zlib.crc32(buf) & 0xFFFFFFFF
+
+
+def _canonical_dump(manifest: Dict[str, Any]) -> bytes:
+    body = {k: v for k, v in manifest.items() if k != "manifest_crc32"}
+    return json.dumps(body, sort_keys=True, separators=(",", ":")).encode()
+
+
+def write_manifest(dirpath: str, manifest: Dict[str, Any]) -> str:
+    """Write the manifest atomically (tmp + rename) with its embedded
+    self-checksum stamped."""
+    manifest = dict(manifest)
+    manifest["format"] = FORMAT_NAME
+    manifest["version"] = FORMAT_VERSION
+    manifest["manifest_crc32"] = crc32_bytes(_canonical_dump(manifest))
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(dirpath: str) -> Dict[str, Any]:
+    """Load + validate a manifest; every failure is a LightGBMError with
+    the offending path in the message."""
+    path = os.path.join(dirpath, MANIFEST_NAME)
+    try:
+        with open(path) as fh:
+            manifest = json.load(fh)
+    except OSError as e:
+        raise LightGBMError(f"datastore manifest unreadable: {path} ({e})")
+    except ValueError as e:
+        raise LightGBMError(f"datastore manifest corrupt (bad JSON): "
+                            f"{path} ({e})")
+    if not isinstance(manifest, dict) or \
+            manifest.get("format") != FORMAT_NAME:
+        raise LightGBMError(f"not a lightgbm_tpu datastore manifest: {path}")
+    if manifest.get("version") != FORMAT_VERSION:
+        raise LightGBMError(
+            f"datastore format version {manifest.get('version')} is not "
+            f"supported (this build reads version {FORMAT_VERSION}): {path}")
+    want = manifest.get("manifest_crc32")
+    got = crc32_bytes(_canonical_dump(manifest))
+    if want != got:
+        raise LightGBMError(
+            f"datastore manifest checksum mismatch (stored {want}, "
+            f"computed {got}) — the manifest was modified or truncated: "
+            f"{path}")
+    for key in ("dtype", "n_rows", "n_features", "shard_rows", "shards",
+                "payloads"):
+        if key not in manifest:
+            raise LightGBMError(
+                f"datastore manifest missing required field '{key}': {path}")
+    return manifest
+
+
+def verify_payload(dirpath: str, shard_index: int, payload: str,
+                   entry: Dict[str, Any], buf) -> None:
+    """Check one payload file's byte count + crc32 against its manifest
+    entry; `buf` is the already-mapped bytes-like content."""
+    name = shard_filename(shard_index, payload)
+    if len(buf) != int(entry["nbytes"]):
+        raise LightGBMError(
+            f"datastore shard truncated: {os.path.join(dirpath, name)} has "
+            f"{len(buf)} bytes, manifest says {entry['nbytes']}")
+    crc = crc32_bytes(buf)
+    if crc != int(entry["crc32"]):
+        raise LightGBMError(
+            f"datastore shard checksum mismatch: "
+            f"{os.path.join(dirpath, name)} (stored {entry['crc32']}, "
+            f"computed {crc}) — the file changed since it was written")

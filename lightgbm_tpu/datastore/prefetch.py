@@ -1,0 +1,123 @@
+"""Bounded double-buffered shard prefetcher.
+
+A background thread reads shard k+1 from disk (mmap + checksum + copy
+out of the page cache) while the consumer copies shard k to the device —
+the same overlap idea as the PR-4 dispatch/harvest pipeline, applied to
+the host->device side of assembly.  The queue is bounded at
+`depth` blocks, so host residency is capped at depth + 2 blocks (one in
+the producer's hands while the queue is full, one in the consumer's) —
+`store.auto_shard_rows` sizes shards from exactly that bound.
+
+Counters are injected as plain callables (`on_hit` / `on_stall`) so this
+module stays import-free of the telemetry package and loads in the
+jax-free import matrix: a *hit* means the next block was already waiting
+when the consumer asked (the prefetch overlap worked); a *stall* means
+the consumer had to wait on the disk read (depth or shard size too
+small, or the device side is faster than the disk).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+try:
+    from ..utils.log import LightGBMError
+except ImportError:  # file-path load in a jax-free synthetic package
+    class LightGBMError(RuntimeError):
+        pass
+
+_DONE = object()
+
+
+class ShardPrefetcher:
+    """Iterate (shard index, row0, block) with a bounded read-ahead."""
+
+    def __init__(self, store, payload: str = "bins", depth: int = 2,
+                 plan: Optional[List[Tuple[int, np.ndarray]]] = None,
+                 on_hit: Optional[Callable[[], None]] = None,
+                 on_stall: Optional[Callable[[], None]] = None):
+        self.store = store
+        self.payload = payload
+        self.depth = max(1, int(depth))
+        #: (shard, shard-relative row selection or None) in read order
+        self.plan: List[Tuple[int, Optional[np.ndarray]]] = (
+            [(k, None) for k in range(store.n_shards)]
+            if plan is None else list(plan))
+        self._on_hit = on_hit or (lambda: None)
+        self._on_stall = on_stall or (lambda: None)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._err: Optional[BaseException] = None
+        self._resident = 0
+        self.peak_resident_bytes = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._produce, daemon=True,
+                                        name="lgbm-tpu-datastore-prefetch")
+        self._thread.start()
+
+    # ------------------------------------------------------------ producer
+    def _track(self, delta: int) -> None:
+        with self._lock:
+            self._resident += delta
+            if self._resident > self.peak_resident_bytes:
+                self.peak_resident_bytes = self._resident
+
+    def _produce(self) -> None:
+        try:
+            for k, rel in self.plan:
+                if self._stop.is_set():
+                    return
+                block = self.store.load_shard(k, self.payload)
+                if rel is not None:
+                    block = block[:, rel]
+                # copy out of the memmap so the resident-bytes accounting
+                # is real host memory, not page-cache-backed views whose
+                # lifetime the budget could not bound
+                block = np.ascontiguousarray(block)
+                self._track(block.nbytes)
+                self._q.put((k, self.store.row0_of(k), block))
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        while not self._stop.is_set():  # sentinel must always land
+            try:
+                self._q.put(_DONE, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # ------------------------------------------------------------ consumer
+    def __iter__(self):
+        try:
+            while True:
+                was_empty = self._q.empty()
+                item = self._q.get()
+                if item is _DONE:
+                    break
+                # hit/stall counted per BLOCK (the sentinel pop is free):
+                # an empty queue at ask time means the consumer waited on
+                # the disk read instead of overlapping it
+                (self._on_stall if was_empty else self._on_hit)()
+                k, row0, block = item
+                yield k, row0, block
+                self._track(-block.nbytes)
+        finally:
+            self.close()
+        if self._err is not None:
+            err = self._err
+            if isinstance(err, LightGBMError) or \
+                    type(err).__name__ == "LightGBMError":
+                raise err
+            raise LightGBMError(f"datastore prefetch failed: {err!r}")
+
+    def close(self) -> None:
+        """Stop the reader and drain the queue (idempotent)."""
+        self._stop.set()
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=5.0)

@@ -41,7 +41,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
         telemetry.TRACER.attach_jsonl(str(sink))
     # the root telemetry span: Booster construction (dataset.bin), the
     # boosting loop (train.chunk / compile_warmup / eval) all nest inside
-    with telemetry.span("train.loop", num_boost_round=num_boost_round):
+    with telemetry.span("train.loop", num_boost_round=num_boost_round,
+                        external_memory=bool(
+                            (params or {}).get("external_memory", False))):
         booster = _train_impl(params, train_set, num_boost_round,
                               valid_sets, valid_names, feval, init_model,
                               keep_training_booster, callbacks)

@@ -136,6 +136,17 @@ RULES: List[Tuple[str, str, str]] = [
     ("kernel.speedup_*", "down_is_bad", "timing"),
     ("kernel.*_ms", "up_is_bad", "timing"),
     ("kernel.*", "ignore", "counter"),
+    # external-memory datastore: prefetch stalls growing means the
+    # read-ahead stopped hiding disk latency (timing class — thread
+    # scheduling makes the exact count jittery); hits, spill volume and
+    # shard count are workload bookkeeping; the resident watermark is a
+    # budget signal but inherits the same scheduling jitter
+    ("*datastore.prefetch.stall", "up_is_bad", "timing"),
+    ("*datastore.prefetch.hit", "ignore", "counter"),
+    ("*datastore.spill_bytes", "ignore", "counter"),
+    ("*datastore.shards", "ignore", "counter"),
+    ("*datastore.h2d_bytes_saved", "ignore", "counter"),
+    ("*datastore.peak_resident_mb", "up_is_bad", "timing"),
     # wall-clock spans — higher is worse, timing class
     ("*total_s", "up_is_bad", "timing"),
     ("*mean_s", "up_is_bad", "timing"),

@@ -110,6 +110,11 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "feature_pre_filter": (True, "bool", ()),
     "pre_partition": (False, "bool", ("is_pre_partition",)),
     "two_round": (False, "bool", ("two_round_loading", "use_two_round_loading")),
+    "external_memory": (False, "bool", ("use_external_memory",)),
+    "datastore_dir": ("", "str", ()),
+    "datastore_shard_rows": (0, "int", ()),
+    "datastore_budget_mb": (64.0, "float", ()),
+    "datastore_prefetch": (2, "int", ()),
     "header": (False, "bool", ("has_header",)),
     "label_column": ("", "str", ("label",)),
     "weight_column": ("", "str", ("weight",)),

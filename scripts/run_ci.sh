@@ -190,6 +190,39 @@ print("[run_ci] device-sum smoke: exact parity, "
       f"{moved} B D2H for 2x300x{K} scores")
 EOF
 
+# external-memory smoke: a dataset ~4x the datastore budget trains via
+# the spilled shard store and must be byte-identical to the in-memory
+# model, with the prefetch pipeline's host residency inside the budget
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import REGISTRY
+
+rng = np.random.default_rng(9)
+n, f = 20000, 52                      # ~0.99 MB of uint8 bins
+X = rng.standard_normal((n, f))
+y = (X[:, 0] - X[:, 3] + 0.1 * rng.standard_normal(n) > 0).astype(float)
+budget_mb = 0.25
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 20}
+mem = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=4)
+ext = lgb.train({**params, "external_memory": True,
+                 "datastore_budget_mb": budget_mb},
+                lgb.Dataset(X, label=y), num_boost_round=4)
+strip = lambda s: "\n".join(l for l in s.splitlines()
+                            if not l.startswith("["))
+assert strip(mem.model_to_string()) == strip(ext.model_to_string()), \
+    "spilled model != in-memory model"
+g = REGISTRY.snapshot()["gauges"]
+assert g["datastore.spill_bytes"] >= 4 * budget_mb * (1 << 20), g
+assert g["datastore.shards"] >= 4, g
+assert g["datastore.peak_resident_mb"] <= budget_mb, \
+    f"prefetch held {g['datastore.peak_resident_mb']} MB > {budget_mb} MB"
+print(f"[run_ci] external-memory smoke: byte parity over "
+      f"{int(g['datastore.shards'])} shards, peak resident "
+      f"{g['datastore.peak_resident_mb']} MB <= {budget_mb} MB budget")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

@@ -62,6 +62,14 @@ def build_snapshot(rounds: int, rel_tol: float,
                     num_boost_round=rounds,
                     valid_sets=[lgb.Dataset(Xe, label=ye)],
                     valid_names=["holdout"])
+    # external-memory segment: a short spilled training run so the
+    # baseline carries the datastore.* names.  Fixed shard size (not the
+    # budget heuristic) keeps shard/spill counts machine-independent;
+    # prefetch hit/stall and the resident watermark stay scheduling-
+    # dependent and are ignore/timing-class in diff.RULES
+    lgb.train({**params, "flight_recorder": False,
+               "external_memory": True, "datastore_shard_rows": 512},
+              lgb.Dataset(X, label=y), num_boost_round=4)
     return {
         "backend": jax.devices()[0].platform,
         "sentinel": {"rel_tol": float(bst.config.telemetry_diff_rel_tol),

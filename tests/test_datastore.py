@@ -1,0 +1,220 @@
+"""External-memory datastore suite (PR 9).
+
+The contract under test: spilling the binned dataset to on-disk shards
+and streaming it back must be INVISIBLE in the trained model — byte
+identity with in-memory training across every golden family — while
+host residency stays inside `datastore_budget_mb` and corruption is a
+hard error, never silent garbage.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LightGBMError
+from lightgbm_tpu.telemetry import REGISTRY
+
+from golden_common import GOLDEN_CASES, make_case_data, model_fingerprint
+
+EXT = {"external_memory": True, "datastore_shard_rows": 256}
+
+
+def _strip_params(model_str: str) -> str:
+    """Model text minus the `[param: value]` echo — the external-memory
+    knobs legitimately appear there; everything else must match."""
+    return "\n".join(l for l in model_str.splitlines()
+                     if not l.startswith("["))
+
+
+def _train_pair(params, X, y, rounds, ext_extra=None):
+    mem = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                    num_boost_round=rounds)
+    ext = lgb.train({**params, **EXT, **(ext_extra or {})},
+                    lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return mem, ext
+
+
+# ------------------------------------------------------------ byte identity
+@pytest.mark.parametrize("name", list(GOLDEN_CASES))
+def test_golden_family_byte_identity(name):
+    case = GOLDEN_CASES[name]
+    X, y = make_case_data(case)
+    params = dict(case["params"])
+    if case.get("categorical"):
+        params["categorical_feature"] = case["categorical"]
+    mem, ext = _train_pair(params, X, y, case["rounds"])
+    assert _strip_params(mem.model_to_string()) == \
+        _strip_params(ext.model_to_string())
+    assert model_fingerprint(mem, X) == model_fingerprint(ext, X)
+    assert np.array_equal(mem.predict(X), ext.predict(X))
+
+
+@pytest.mark.quick
+def test_bagging_byte_identity():
+    # bagging takes the mask path (not GOSS's weight path) — both must
+    # survive the spill round-trip bit-for-bit
+    X, y = make_case_data(GOLDEN_CASES["binary"])
+    params = {**GOLDEN_CASES["binary"]["params"], "bagging_fraction": 0.7,
+              "bagging_freq": 1, "bagging_seed": 7}
+    mem, ext = _train_pair(params, X, y, 8)
+    assert np.array_equal(mem.predict(X), ext.predict(X))
+    assert _strip_params(mem.model_to_string()) == \
+        _strip_params(ext.model_to_string())
+
+
+def test_prefetch_depth_identity():
+    X, y = make_case_data(GOLDEN_CASES["regression_l2"])
+    params = GOLDEN_CASES["regression_l2"]["params"]
+    models = [
+        lgb.train({**params, **EXT, "datastore_prefetch": d},
+                  lgb.Dataset(X, label=y), num_boost_round=5)
+        for d in (1, 4)]
+    assert _strip_params(models[0].model_to_string()) == \
+        _strip_params(models[1].model_to_string())
+
+
+def test_init_model_continuation():
+    X, y = make_case_data(GOLDEN_CASES["binary"])
+    params = GOLDEN_CASES["binary"]["params"]
+
+    def two_stage(extra):
+        p = {**params, **extra}
+        m1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+        return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5,
+                         init_model=m1)
+
+    mem, ext = two_stage({}), two_stage(EXT)
+    assert len(mem.trees) == len(ext.trees)
+    assert _strip_params(mem.model_to_string()) == \
+        _strip_params(ext.model_to_string())
+
+
+# --------------------------------------------------------------- corruption
+def test_manifest_tamper_raises(tmp_path):
+    X, y = make_case_data(GOLDEN_CASES["binary"])
+    ds = lgb.Dataset(X, label=y)
+    ds.params = {**EXT, "datastore_dir": str(tmp_path), "verbosity": -1}
+    ds.construct()
+    d = ds.datastore.dirpath
+    mpath = os.path.join(d, "manifest.json")
+    m = json.load(open(mpath))
+    m["n_rows"] = m["n_rows"] + 1          # stale self-crc now
+    json.dump(m, open(mpath, "w"))
+    from lightgbm_tpu.datastore import ShardStore
+    with pytest.raises(LightGBMError, match="checksum mismatch"):
+        ShardStore.open(d)
+
+
+def test_shard_corruption_fails_training(tmp_path):
+    X, y = make_case_data(GOLDEN_CASES["binary"])
+    params = {**GOLDEN_CASES["binary"]["params"], **EXT,
+              "datastore_dir": str(tmp_path)}
+    ds = lgb.Dataset(X, label=y)
+    ds.params = dict(params)
+    ds.construct()
+    shard = sorted(glob.glob(os.path.join(ds.datastore.dirpath,
+                                          "shard-*.bins")))[2]
+    buf = bytearray(open(shard, "rb").read())
+    buf[17] ^= 0xFF                        # one flipped bit, mid-payload
+    open(shard, "wb").write(bytes(buf))
+    with pytest.raises(LightGBMError, match="checksum mismatch"):
+        lgb.train(params, ds, num_boost_round=2)
+
+
+def test_save_binary_rejected_when_spilled():
+    X, y = make_case_data(GOLDEN_CASES["binary"])
+    ds = lgb.Dataset(X, label=y)
+    ds.params = {**EXT, "verbosity": -1}
+    ds.construct()
+    with pytest.raises(LightGBMError, match="external-memory"):
+        ds.save_binary(os.devnull)
+
+
+# ------------------------------------------------------- GOSS / shard skip
+@pytest.mark.quick
+def test_subset_skips_shards_and_counts_saved_bytes():
+    X, y = make_case_data(GOLDEN_CASES["binary"])
+    ds = lgb.Dataset(X, label=y)
+    ds.params = {**EXT, "verbosity": -1, "enable_bundle": False}
+    ds.construct()
+    # rows 0..399 live in shards 0-1 of 8 (shard_rows=256): the other
+    # six shards must never be read, and their bytes count as saved
+    before = REGISTRY.counter("datastore.h2d_bytes_saved").value
+    sub = ds.subset(np.arange(400))
+    sub.construct()
+    saved = REGISTRY.counter("datastore.h2d_bytes_saved").value - before
+    n, f = X.shape
+    assert saved == (n - 400) * f          # uint8: one byte per cell
+    assert np.array_equal(sub.bin_data,
+                          ds.datastore.read_all_rows("bins")[:400])
+    assert np.array_equal(sub.get_label(), y[:400].astype(np.float32))
+
+
+# ----------------------------------------------------- budget / acceptance
+def test_budget_bounded_training_end_to_end(tmp_path):
+    """The ISSUE acceptance case: dataset >= 4x datastore_budget_mb
+    trains with bounded host residency, byte-identical to in-memory, and
+    the prefetch overlap shows up as train.shard spans inside the
+    train.chunk window."""
+    rng = np.random.default_rng(9)
+    n, f = 20000, 52
+    X = rng.standard_normal((n, f))
+    y = (X[:, 0] - X[:, 3] + 0.1 * rng.standard_normal(n) > 0)\
+        .astype(np.float64)
+    budget_mb = 0.25                       # bins are ~0.99 MB >= 4x this
+    sink = str(tmp_path / "spans.jsonl")
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 20}
+    mem = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                    num_boost_round=4)
+    ext = lgb.train({**params, "external_memory": True,
+                     "datastore_budget_mb": budget_mb,
+                     "telemetry_sink": sink},
+                    lgb.Dataset(X, label=y), num_boost_round=4)
+    assert _strip_params(mem.model_to_string()) == \
+        _strip_params(ext.model_to_string())
+    snap = REGISTRY.snapshot()
+    assert snap["gauges"]["datastore.spill_bytes"] >= \
+        4 * budget_mb * (1 << 20)
+    assert snap["gauges"]["datastore.shards"] >= 4
+    # the budget gauge IS the acceptance bound: the prefetch pipeline
+    # never held more than datastore_budget_mb of shard blocks
+    assert snap["gauges"]["datastore.peak_resident_mb"] <= budget_mb
+    spans = [json.loads(l) for l in open(sink)
+             if '"ev": "span"' in l or '"ev":"span"' in l]
+    shard_spans = [s for s in spans if s.get("name") == "train.shard"]
+    assert len(shard_spans) == snap["gauges"]["datastore.shards"]
+    assert all(s.get("parent") == "train.chunk" for s in shard_spans)
+
+
+# ------------------------------------------------------- streaming ingest
+def test_streaming_ingest_spills_without_dense_matrix(tmp_path):
+    rng = np.random.default_rng(3)
+    n, f = 5000, 6
+    X = rng.standard_normal((n, f))
+    y = (X[:, 0] > 0).astype(np.float64)
+    path = str(tmp_path / "train.csv")
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.9g")
+
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "two_round": True, "label_column": 0}
+    ext = {**params, **EXT, "datastore_dir": str(tmp_path / "store")}
+    ds = lgb.Dataset(path)
+    ds.params = dict(ext)
+    ds.construct()
+    # the fix under test: external-memory streamed ingest must never
+    # materialize the dense [N, F] bin matrix on the host
+    assert ds.bin_data is None
+    assert ds.datastore is not None and ds.datastore.n_shards > 1
+    assert ds.datastore.n_rows == n
+
+    # n < bin_construct_sample_cnt: both passes see every row, so the
+    # streamed-external model must match the streamed in-memory one
+    m_ext = lgb.train(ext, lgb.Dataset(path), num_boost_round=5)
+    m_mem = lgb.train({**params, "enable_bundle": False},
+                      lgb.Dataset(path), num_boost_round=5)
+    assert _strip_params(m_ext.model_to_string()) == \
+        _strip_params(m_mem.model_to_string())

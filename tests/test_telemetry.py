@@ -345,6 +345,20 @@ class TestMetricsRegistry:
             "    m = importlib.util.module_from_spec(spec)\n"
             "    sys.modules['tel.' + mod] = m\n"
             "    spec.loader.exec_module(m)\n"
+            # the datastore package is jax-free too (assemble.py defers
+            # its jax import into the function body) — store.py's
+            # `from . import format` needs format loaded first
+            "dpkg = types.ModuleType('dstore')\n"
+            "dpkg.__path__ = ['lightgbm_tpu/datastore']\n"
+            "sys.modules['dstore'] = dpkg\n"
+            "for mod in ('format', 'store', 'prefetch', 'assemble'):\n"
+            "    spec = importlib.util.spec_from_file_location(\n"
+            "        'dstore.' + mod, 'lightgbm_tpu/datastore/' + mod "
+            "+ '.py')\n"
+            "    m = importlib.util.module_from_spec(spec)\n"
+            "    sys.modules['dstore.' + mod] = m\n"
+            "    spec.loader.exec_module(m)\n"
+            "    setattr(dpkg, mod, m)\n"
             "assert 'jax' not in sys.modules, 'jax leaked'\n"
             "rec = sys.modules['tel.recorder']\n"
             "assert rec.sample_memory('t') in (None,)  # no-jax fallback\n"

@@ -717,6 +717,65 @@ def _run_worker() -> None:
             slot = _rung_bench("off", rung_rows, rung_iters)
             slot.pop("active")
             blk["slot_path"] = slot
+
+            # sharded serving plane (serving/sharded.py): same closed
+            # loop with one pinned replica per visible device, requests
+            # wide enough to stripe (> max_batch_rows).  diff.py treats
+            # replicas as down-is-bad and stripe_imbalance as
+            # up-is-bad, so a mesh that silently shrinks or a scheduler
+            # that stops balancing fails the gate
+            import jax as _jax
+            if len(_jax.devices()) > 1:
+                # one max_batch_rows chunk per replica, so a single
+                # closed-loop request stripes the whole mesh (the
+                # batcher hands oversized requests through whole); the
+                # steady-state call below compiles every replica, so
+                # the full bucket-ladder warmup is skipped
+                c = ServingClient(bst, params={
+                    "serve_max_wait_ms": 0.0, "serve_shard_devices": 0,
+                    "serve_warmup": False})
+                rt = c.registry.get().runtime
+                sh_rows = int(os.environ.get(
+                    "BENCH_SERVE_SHARD_ROWS",
+                    rt.max_batch_rows * rt.num_replicas))
+                Xr = X_eval
+                if len(Xr) < sh_rows:
+                    Xr = np.tile(Xr, (-(-sh_rows // max(len(Xr), 1)), 1))
+                Xr = np.ascontiguousarray(Xr[:sh_rows], np.float64)
+                rows0 = [telemetry.REGISTRY.counter(
+                            f"serve.replica.{i}.rows").value
+                         for i in range(rt.num_replicas)]
+                c.predict(Xr, raw_score=True)      # steady state
+                slat = []
+                t_sall = time.time()
+                for _ in range(rung_iters):
+                    t0 = time.perf_counter()
+                    c.predict(Xr, raw_score=True)
+                    slat.append(time.perf_counter() - t0)
+                stotal = time.time() - t_sall
+                routed = [telemetry.REGISTRY.counter(
+                             f"serve.replica.{i}.rows").value - rows0[i]
+                          for i in range(rt.num_replicas)]
+                c.close()
+                slat_ms = np.sort(np.asarray(slat)) * 1e3
+                mean_r = sum(routed) / max(len(routed), 1)
+                sh = {
+                    "rows_per_request": sh_rows, "requests": rung_iters,
+                    "replicas": rt.num_replicas,
+                    "p50_ms": round(float(np.percentile(slat_ms, 50)), 3),
+                    "p99_ms": round(float(np.percentile(slat_ms, 99)), 3),
+                    "rows_per_sec": round(
+                        sh_rows * rung_iters / stotal, 1),
+                    "rows_per_sec_per_replica": round(
+                        sh_rows * rung_iters / stotal
+                        / max(rt.num_replicas, 1), 1),
+                    "stripe_imbalance": round(
+                        max(routed) / mean_r, 4) if mean_r > 0 else 1.0}
+                blk["sharded"] = sh
+                _log(f"sharded serving: {sh['replicas']} replicas, "
+                     f"{sh['rows_per_sec']:,.0f} rows/s total "
+                     f"({sh['rows_per_sec_per_replica']:,.0f}/replica), "
+                     f"stripe imbalance {sh['stripe_imbalance']}")
             print("@serving " + json.dumps(blk, separators=(",", ":")),
                   flush=True)
             _log(f"serving rungs @{rung_rows} rows: device_sum "

@@ -44,6 +44,18 @@ _JAX_ROOTS = ("jax", "jax.numpy", "jax.lax", "jax.experimental",
               "jax.experimental.pallas", "jax.experimental.shard_map",
               "numpy", "functools")
 
+# repo-local compat shims that re-export jax transforms under the same
+# terminal names (mesh/compat.py `shard_map`): members resolve exactly
+# like the native jax ones, so a function passed to the compat-wrapped
+# shard_map is still device code
+_COMPAT_ROOTS = ("lightgbm_tpu.mesh", "lightgbm_tpu.mesh.compat")
+
+
+def _jaxish_module(mod: Optional[str]) -> bool:
+    if not mod:
+        return False
+    return mod == "jax" or mod.startswith("jax.") or mod in _COMPAT_ROOTS
+
 # callee terminal name -> positions of function-valued arguments
 _DEVICE_WRAPPERS: Dict[str, Tuple[object, ...]] = {
     "jit": (0,), "vmap": (0,), "pmap": (0,), "grad": (0,),
@@ -221,15 +233,15 @@ class ModuleContext:
         parts = dn.split(".")
         if len(parts) == 1:
             fi = self.from_imports.get(parts[0])
-            if fi and (fi[0] == "jax" or fi[0].startswith("jax.")):
+            if fi and _jaxish_module(fi[0]):
                 return fi[1]
             return None
         base = parts[0]
         mod = self.module_aliases.get(base)
-        if mod == "jax" or (mod or "").startswith("jax."):
+        if _jaxish_module(mod):
             return parts[-1]
         fi = self.from_imports.get(base)
-        if fi and (fi[0] == "jax" or fi[0].startswith("jax.")):
+        if fi and _jaxish_module(fi[0]):
             return parts[-1]
         return None
 

@@ -129,6 +129,12 @@ class _DeviceData:
         self.query_boundaries = ds._query_boundaries
 
     @property
+    def store(self):
+        """The spilled shard store backing this dataset (None when
+        in-memory) — the streamed mesh placement reads it directly."""
+        return self._store
+
+    @property
     def datastore_pending(self) -> bool:
         """True while a spilled dataset's training matrix has not been
         assembled on device yet — the booster defers that first assembly
@@ -756,10 +762,25 @@ class Booster:
             n_dev = len(jax.devices())
         except RuntimeError:
             n_dev = 1
-        shards = cfg.num_machines if (cfg.num_machines or 0) > 1 else n_dev
-        shards = min(shards, n_dev)
-        dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
-        use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
+        dims = None
+        if cfg.mesh_shape:
+            from .mesh.topology import parse_mesh_shape
+            dims = parse_mesh_shape(cfg.mesh_shape)
+        if dims is not None:
+            # explicit topology wins over num_machines/tpu_dcn_slices;
+            # an over-subscription fails loudly in get_mesh* at build
+            # time rather than being silently clamped here
+            shards = 1
+            for d in dims:
+                shards *= d
+            dcn = dims[0] if len(dims) == 2 else 1
+            use_2level = len(dims) == 2
+        else:
+            shards = cfg.num_machines if (cfg.num_machines or 0) > 1 \
+                else n_dev
+            shards = min(shards, n_dev)
+            dcn = max(int(cfg.tpu_dcn_slices or 1), 1)
+            use_2level = dcn > 1 and shards % dcn == 0 and shards // dcn > 1
         kind = resolve_tree_learner(name, bundled=bundled,
                                     two_level=use_2level, quiet=True)
         s_last = shards // dcn if use_2level else shards
@@ -1015,13 +1036,6 @@ class Booster:
                 self._dd.bundle_fm if bundled else self._dd.bins_fm)
             self._learner_cache_key = None
             return
-        if self._dd.datastore_pending:
-            log.warning(f"tree_learner={kind} with external_memory "
-                        "assembles the full device matrix before placing "
-                        "it on the mesh (streamed distributed training is "
-                        "not implemented yet)")
-        # EFB: training reads the bundled matrix (see _DeviceData)
-        train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
         # reset_parameter (lr schedules) calls this every iteration — reuse
         # the compiled grower and placed bins when nothing changed
         wave = self._grow_policy == "wave"
@@ -1044,25 +1058,47 @@ class Booster:
             log.warning(f"tree_learner={kind} requested but only one device "
                         "is visible; using the serial learner")
             self._mesh = None
-            self._train_bins = train_src
+            # external-memory: defer the assembly into the first
+            # train.chunk span, exactly like the serial early-return
+            self._train_bins = None if self._dd.datastore_pending else (
+                self._dd.bundle_fm if bundled else self._dd.bins_fm)
             self._learner_cache_key = key
             return
-        from .parallel import get_mesh
+        from .mesh import get_mesh, get_mesh_2level
         from .parallel.learner import make_distributed_grower, \
             place_training_data
         if use_2level:
             # 2-level mesh: heavy histogram traffic rides the ICI axis,
             # slices exchange only reduced blocks over DCN (SURVEY §2.7.5)
-            from .parallel.mesh import get_mesh_2level
             self._mesh = get_mesh_2level(dcn, shards // dcn)
         else:
             self._mesh = get_mesh(shards)
         # the wave policy now runs data_rs too, so its feature axis is
         # block-padded exactly like the strict data learner's
-        self._train_bins = place_training_data(
-            np.asarray(train_src), self._mesh, kind,
-            pad_features=(kind in ("data", "feature")
-                          and self._dd.efb is None))
+        pad_features = (kind in ("data", "feature")
+                        and self._dd.efb is None)
+        if self._dd.datastore_pending and kind != "feature":
+            # external-memory: stream disk shards straight to the device
+            # that owns their rows (mesh/placement.py) — the host never
+            # assembles the full matrix, peak residency is one device
+            # slice + the prefetch window
+            from .mesh.placement import place_from_datastore
+            self._train_bins = place_from_datastore(
+                self._dd.store, self._mesh, kind,
+                payload="bundle" if bundled else "bins",
+                pad_features=pad_features,
+                prefetch_depth=cfg.datastore_prefetch)
+        else:
+            if self._dd.datastore_pending:
+                log.warning("tree_learner=feature with external_memory "
+                            "assembles the full device matrix before "
+                            "replicating it on the mesh (features are "
+                            "copied to every shard)")
+            # EFB: training reads the bundled matrix (see _DeviceData)
+            train_src = self._dd.bundle_fm if bundled else self._dd.bins_fm
+            self._train_bins = place_training_data(
+                np.asarray(train_src), self._mesh, kind,
+                pad_features=pad_features)
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
             self._dd.num_feature, self._dd.num_data, wave=wave)

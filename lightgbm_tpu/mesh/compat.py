@@ -1,0 +1,57 @@
+"""Version-spanning JAX sharding compat layer.
+
+The repo pins jax 0.4.37, where ``shard_map`` still lives at
+``jax.experimental.shard_map.shard_map`` and spells its
+replication-check kwarg ``check_rep``; newer releases promote it to
+``jax.shard_map`` with the kwarg renamed ``check_vma``.  Every call
+site in the tree routes through this module so the code can use the
+modern spelling (`shard_map(f, mesh=..., in_specs=..., out_specs=...,
+check_vma=...)`) and run unchanged on either side of the drift —
+the pre-compat call sites raised ``AttributeError: module 'jax' has no
+attribute 'shard_map'`` before a single collective could run.
+
+Also re-exports the stable sharding names (``Mesh``, ``NamedSharding``,
+``PartitionSpec``) so mesh-aware modules have one import root to drift
+behind if those ever move too.
+
+graft-lint note: ``analysis/engine.py`` resolves members of this module
+exactly like the native jax transforms, so functions passed to the
+compat ``shard_map`` are still recognised as device code.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: F401
+
+__all__ = ["shard_map", "Mesh", "NamedSharding", "PartitionSpec",
+           "SHARD_MAP_IS_NATIVE"]
+
+
+def _resolve() -> tuple:
+    fn = getattr(jax, "shard_map", None)
+    if callable(fn):
+        return fn, True
+    from jax.experimental import shard_map as _sm
+    return _sm.shard_map, False
+
+
+_SHARD_MAP, SHARD_MAP_IS_NATIVE = _resolve()
+
+
+def shard_map(f: Callable, *, mesh: Any, in_specs: Any, out_specs: Any,
+              check_vma: bool = True, **kwargs):
+    """``jax.shard_map`` with the modern keyword surface on any jax.
+
+    On the pinned 0.4.37 the call falls back to
+    ``jax.experimental.shard_map.shard_map`` and ``check_vma`` is
+    translated to the old ``check_rep`` spelling (same semantics:
+    whether to verify per-output replication annotations).
+    """
+    if SHARD_MAP_IS_NATIVE:
+        return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_vma=check_vma,
+                          **kwargs)
+    return _SHARD_MAP(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma, **kwargs)

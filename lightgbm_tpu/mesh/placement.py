@@ -1,0 +1,155 @@
+"""Per-device placement: padding math, accounting, streamed sharding.
+
+Three jobs, shared by training and serving:
+
+ - the canonical mesh-divisible padding helpers (`padded_feature_count`
+   / `padded_row_count`) — every placement and every grower must agree
+   on these or shard shapes drift;
+ - per-device placement accounting (`record_placement`): one
+   ``parallel.dev{id}.placed_bytes`` gauge per device holding a shard
+   of a mesh-resident array, read back by the flight recorder's memory
+   watermarks when ``memory_stats()`` is unavailable (CPU fallback);
+ - streamed sharded placement (`place_from_datastore`): external-memory
+   datasets go from disk shards straight to their owning device through
+   the PR-9 bounded prefetcher, so the host never materializes the full
+   matrix — peak host residency is one device slice + the prefetch
+   window, instead of the whole ``[F, N]`` array.
+
+Collective-labeled spans (`collective_span`) give replication /
+placement traffic a uniform ``mesh.collective.*`` prefix in the
+telemetry timeline, mirroring how the in-jit collectives are labeled
+with ``jax.named_scope`` (parallel.grow_sharded).
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..utils.log import LightGBMError
+from .compat import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["padded_feature_count", "padded_row_count",
+           "record_placement", "collective_span", "place_from_datastore"]
+
+
+def padded_feature_count(num_feature: int, shards: int) -> int:
+    return -(-num_feature // shards) * shards
+
+
+def padded_row_count(num_data: int, shards: int) -> int:
+    return -(-num_data // shards) * shards
+
+
+def record_placement(placed, prefix: str = "parallel") -> None:
+    """Per-device attribution of one mesh-resident array: a
+    ``{prefix}.dev{id}.placed_bytes`` gauge per addressable shard."""
+    from ..telemetry import REGISTRY
+    for shard in placed.addressable_shards:
+        REGISTRY.gauge(
+            f"{prefix}.dev{shard.device.id}.placed_bytes").set(
+                shard.data.nbytes)
+
+
+def collective_span(name: str, **attrs):
+    """Host-side span labeling mesh traffic: ``mesh.collective.<name>``.
+
+    In-jit collectives are labeled via ``jax.named_scope`` instead (they
+    trace into the compiled program); this wrapper is for the host-driven
+    phases — placement, replication, gather — so both sides of the mesh
+    runtime share one searchable prefix."""
+    from ..telemetry import span
+    return span(f"mesh.collective.{name}", **attrs)
+
+
+def place_from_datastore(store, mesh: Mesh, kind: str,
+                         payload: str = "bins",
+                         pad_features: bool = True,
+                         prefetch_depth: int = 2):
+    """Stream datastore shards straight into per-device row blocks.
+
+    The sharded equivalent of ``datastore.assemble.assemble_feature_
+    major`` + ``parallel.learner.place_training_data`` without the
+    intermediate full matrix: shards arrive in row order through the
+    bounded prefetcher, each is copied into the (zero-padded) host
+    staging block of the device that owns those rows, and each completed
+    block is committed to its device.  The final array is identical —
+    shape, padding, NamedSharding — to the assemble-then-place route, so
+    the unchanged grower produces byte-identical models.
+
+    Rows shard over the whole mesh (1-D ``("data",)`` or 2-level
+    ``("dcn", "ici")``); ``kind="feature"`` replicates rows and must use
+    the assemble path instead.
+    """
+    from ..datastore.prefetch import ShardPrefetcher
+    from .. import telemetry
+
+    if kind == "feature":
+        raise LightGBMError(
+            "place_from_datastore shards rows; the feature-parallel "
+            "learner replicates them (use the assemble path)")
+    axes = tuple(mesh.axis_names)
+    S_last = int(mesh.shape[axes[-1]])
+    S_total = 1
+    for a in axes:
+        S_total *= int(mesh.shape[a])
+    f = store.payload_cols(payload)
+    if f <= 0:
+        raise LightGBMError(
+            f"datastore has no '{payload}' payload to place")
+    n = store.n_rows
+    dtype = np.uint16 if store.dtype == "uint16" else np.uint8
+    f_pad = padded_feature_count(f, S_last) if pad_features else f
+    n_pad = padded_row_count(n, S_total)
+    rows_per = n_pad // S_total
+    devs = list(mesh.devices.flat)
+
+    hit = telemetry.REGISTRY.counter("datastore.prefetch.hit")
+    stall = telemetry.REGISTRY.counter("datastore.prefetch.stall")
+    pf = ShardPrefetcher(store, payload=payload, depth=prefetch_depth,
+                         on_hit=lambda: hit.inc(),
+                         on_stall=lambda: stall.inc())
+    it = iter(pf)
+    cur = None  # carried (row0, block) straddling a device boundary
+    bufs = []
+    with collective_span("place", kind=kind, rows=n, cols=f,
+                         shards=S_total, payload=payload):
+        try:
+            for d_i, dev in enumerate(devs):
+                lo, hi = d_i * rows_per, (d_i + 1) * rows_per
+                host = np.zeros((f_pad, rows_per), dtype=dtype)
+                filled = lo
+                while filled < hi:
+                    if cur is None:
+                        try:
+                            _, row0, block = next(it)
+                        except StopIteration:
+                            break       # tail padding rows stay zero
+                        cur = (row0, np.asarray(block))
+                    row0, block = cur
+                    rk = int(block.shape[-1])
+                    a, b = max(row0, filled), min(row0 + rk, hi)
+                    if b <= a:
+                        raise LightGBMError(
+                            "datastore shards are not in ascending row "
+                            f"order (shard rows [{row0}, {row0 + rk}) "
+                            f"vs device fill cursor {filled})")
+                    host[:f, a - lo:b - lo] = block[:, a - row0:b - row0]
+                    filled = b
+                    if row0 + rk <= hi:
+                        cur = None      # fully consumed
+                    else:
+                        break           # remainder owned by next device
+                with telemetry.span("mesh.place.device",
+                                    device=int(dev.id), rows=rows_per):
+                    # each staging block is committed then never mutated,
+                    # so a zero-copy device_put alias is safe
+                    bufs.append(jax.device_put(host, dev))
+        finally:
+            pf.close()
+            peak_mb = pf.peak_resident_bytes / (1024.0 * 1024.0)
+            telemetry.REGISTRY.gauge("datastore.peak_resident_mb").set(
+                round(peak_mb, 3))
+    placed = jax.make_array_from_single_device_arrays(
+        (f_pad, n_pad), NamedSharding(mesh, P(None, axes)), bufs)
+    record_placement(placed)
+    return placed

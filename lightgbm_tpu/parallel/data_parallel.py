@@ -25,8 +25,9 @@ from typing import Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..mesh.compat import Mesh, NamedSharding, PartitionSpec as P, \
+    shard_map
 from ..ops.grow import DeviceTree, GrowerSpec, make_grower
 
 Array = jax.Array
@@ -107,7 +108,7 @@ def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
         internal_cnt=P(), leaf_value=P(), leaf_g=P(), leaf_h=P(),
         leaf_cnt=P(), leaf_id=P(axis))
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(None, axis),
                   P(None), P(None)),

@@ -35,8 +35,11 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..mesh.compat import Mesh, NamedSharding, PartitionSpec as P, \
+    shard_map
+from ..mesh.placement import padded_feature_count, padded_row_count, \
+    record_placement
 from ..ops.grow import DeviceTree, GrowerSpec, make_grower
 from ..utils import log
 
@@ -145,8 +148,8 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         leaf_id=row_sp)
     in_specs = (P(None, axes) if mode != "feature" else P(None, None),
                 row_sp, row_sp, row_sp, P(None), P(None))
-    sharded = jax.shard_map(grow, mesh=mesh, in_specs=in_specs,
-                            out_specs=tree_specs, check_vma=False)
+    sharded = shard_map(grow, mesh=mesh, in_specs=in_specs,
+                        out_specs=tree_specs, check_vma=False)
 
     def padded(bins_fm, grad, hess, sw, feat, allowed):
         # named scopes label the XProf timeline: padding vs the SPMD body
@@ -177,14 +180,6 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
     return jax.jit(padded)
 
 
-def padded_feature_count(num_feature: int, shards: int) -> int:
-    return -(-num_feature // shards) * shards
-
-
-def padded_row_count(num_data: int, shards: int) -> int:
-    return -(-num_data // shards) * shards
-
-
 def place_training_data(bins_fm, mesh: Mesh, kind: str,
                         pad_features: bool = True):
     """Pad the bin matrix to mesh-divisible shape and place it: rows
@@ -194,7 +189,7 @@ def place_training_data(bins_fm, mesh: Mesh, kind: str,
     `pad_features` only for the block strategies (data_rs/feature) —
     voting and bundled-data keep the original column count."""
     import numpy as np
-    from ..telemetry import REGISTRY, TRACER, span
+    from ..telemetry import TRACER, span
     axes = tuple(mesh.axis_names)
     S_last = int(mesh.shape[axes[-1]])
     S_total = 1
@@ -213,12 +208,5 @@ def place_training_data(bins_fm, mesh: Mesh, kind: str,
         placed = jax.device_put(bins_fm, NamedSharding(mesh, sp))
         if TRACER.active:
             placed.block_until_ready()  # span measures the real transfer
-            # per-device attribution of the one big resident array: the
-            # flight recorder's memory watermarks read these back when
-            # device memory_stats() is unavailable (CPU fallback)
-            for shard in placed.addressable_shards:
-                dev = shard.device
-                REGISTRY.gauge(
-                    f"parallel.dev{dev.id}.placed_bytes").set(
-                        shard.data.nbytes)
+            record_placement(placed)
         return placed

@@ -1,75 +1,12 @@
-"""Mesh construction and multi-host initialization.
-
-ref parity: `Network::Init` + `Linkers::Construct` (src/network/network.cpp,
-linkers_socket.cpp) and the Dask machines/ports bootstrap
-(python-package/lightgbm/dask.py).  On TPU all of it is:
-`jax.distributed.initialize()` (multi-host) + one `Mesh` over the devices;
-XLA routes collectives over ICI within a slice and DCN across slices.
+"""Thin re-export shim — mesh construction moved to the shared mesh
+runtime (``lightgbm_tpu/mesh/topology.py``) so training and serving sit
+on one topology layer.  Kept so pre-existing ``parallel.mesh`` imports
+(tests, notebooks, downstream users) keep working.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from ..mesh.topology import (build_mesh, describe, get_mesh,  # noqa: F401
+                             get_mesh_2level, init, parse_mesh_shape)
 
-import jax
-import numpy as np
-from jax.sharding import Mesh
-
-from ..utils import log
-
-_initialized = False
-
-
-def init(coordinator_address: Optional[str] = None,
-         num_processes: Optional[int] = None,
-         process_id: Optional[int] = None) -> None:
-    """Multi-host bring-up (replaces machines/machine_list_file/port config;
-    ref: Config network params + LGBM_NetworkInit).  Single-host callers can
-    skip this entirely."""
-    global _initialized
-    if _initialized:
-        return
-    if coordinator_address is not None or num_processes is not None:
-        jax.distributed.initialize(coordinator_address=coordinator_address,
-                                   num_processes=num_processes,
-                                   process_id=process_id)
-    _initialized = True
-    log.info(f"parallel.init: {jax.process_count()} process(es), "
-             f"{len(jax.devices())} device(s)")
-
-
-def get_mesh(num_shards: int = 0, axis: str = "data",
-             devices: Optional[Sequence] = None) -> Mesh:
-    """Build a 1-D data mesh over `num_shards` devices (0 = all visible)."""
-    devs = list(devices) if devices is not None else jax.devices()
-    if num_shards and num_shards > 0:
-        if num_shards > len(devs):
-            raise ValueError(
-                f"num_shards={num_shards} exceeds visible devices "
-                f"({len(devs)})")
-        devs = devs[:num_shards]
-    return Mesh(np.array(devs), (axis,))
-
-
-def get_mesh_2level(n_dcn: int, n_ici: int = 0,
-                    devices: Optional[Sequence] = None) -> Mesh:
-    """2-level ("dcn", "ici") mesh for multi-slice training.
-
-    The data-parallel grower reduce-scatters histograms over the fast
-    "ici" axis (within a slice) and allreduces the summed blocks over
-    "dcn" (across slices) — the layout SURVEY §2.7.5 prescribes so heavy
-    traffic rides ICI, not the datacenter network.  With
-    `jax.distributed.initialize` (see `init`), devices enumerate
-    slice-major, so reshaping [n_dcn, n_ici] aligns axis 1 with real ICI
-    neighbours."""
-    devs = list(devices) if devices is not None else jax.devices()
-    if n_ici <= 0:
-        if len(devs) % n_dcn:
-            raise ValueError(f"{len(devs)} devices not divisible by "
-                             f"n_dcn={n_dcn}")
-        n_ici = len(devs) // n_dcn
-    need = n_dcn * n_ici
-    if need > len(devs):
-        raise ValueError(f"mesh {n_dcn}x{n_ici} exceeds visible devices "
-                         f"({len(devs)})")
-    return Mesh(np.array(devs[:need]).reshape(n_dcn, n_ici),
-                ("dcn", "ici"))
+__all__ = ["build_mesh", "describe", "get_mesh", "get_mesh_2level",
+           "init", "parse_mesh_shape"]

@@ -12,6 +12,9 @@ Four layers (docs/SERVING.md):
                 device errors.
   registry.py — `ModelRegistry`: multi-model, warm-up-on-load, atomic
                 hot-swap.
+  sharded.py  — `ShardedServingRuntime` (PR 10): per-device runtime
+                replicas striped by a least-outstanding-work scheduler;
+                selected with `serve_shard_devices` (0 = all devices).
   client.py / http.py — frontends: in-process `ServingClient` and the
                 stdlib HTTP endpoint (`python -m lightgbm_tpu serve`)
                 with /predict, /healthz, /metrics, /debug/requests.
@@ -27,9 +30,11 @@ from .batcher import (MicroBatcher, ServingClosedError,
 from .client import ServingClient
 from .registry import ModelRegistry, ServingModel
 from .runtime import DEFAULT_MAX_BATCH_ROWS, ServingRuntime, bucket_rows
+from .sharded import ShardedServingRuntime, resolve_shard_devices
 
 __all__ = [
     "DEFAULT_MAX_BATCH_ROWS", "MicroBatcher", "ModelRegistry",
     "ServingClient", "ServingClosedError", "ServingModel",
-    "ServingOverloadError", "ServingRuntime", "bucket_rows",
+    "ServingOverloadError", "ServingRuntime", "ShardedServingRuntime",
+    "bucket_rows", "resolve_shard_devices",
 ]

@@ -34,6 +34,7 @@ from ..utils.config import Config
 from ..utils.log import LightGBMError
 from .batcher import MicroBatcher
 from .runtime import ServingRuntime
+from .sharded import ShardedServingRuntime
 
 
 class ServingModel:
@@ -99,10 +100,19 @@ class ModelRegistry:
         booster = model if isinstance(model, Booster) \
             else Booster(model_file=str(model))
         cfg = self._config
+        shard_devices = int(cfg.serve_shard_devices)
         with telemetry.span("serve.load", model=name):
-            runtime = ServingRuntime(
-                booster, max_batch_rows=cfg.serve_max_batch_rows,
-                name=name, device_sum=cfg.serve_device_sum)
+            if shard_devices != 1:
+                # replicated sharded plane: one pinned runtime per mesh
+                # device, striped by least-outstanding-work (sharded.py)
+                runtime = ShardedServingRuntime(
+                    booster, shard_devices=shard_devices,
+                    max_batch_rows=cfg.serve_max_batch_rows,
+                    name=name, device_sum=cfg.serve_device_sum)
+            else:
+                runtime = ServingRuntime(
+                    booster, max_batch_rows=cfg.serve_max_batch_rows,
+                    name=name, device_sum=cfg.serve_device_sum)
             self._admit(name, runtime)
             if cfg.serve_warmup if warmup is None else warmup:
                 runtime.warmup()
@@ -132,6 +142,10 @@ class ModelRegistry:
         budget = int(self._config.serve_vram_budget_mb * (1 << 20))
         if budget <= 0:
             return
+        # the budget is PER DEVICE; a sharded runtime spreads its
+        # byte-identical copies over num_replicas devices, so the
+        # process-wide ceiling scales with the replica count
+        budget *= getattr(runtime, "num_replicas", 1)
         need = runtime.device_bytes()
         with self._lock:
             others = [e for n, e in self._models.items() if n != name]

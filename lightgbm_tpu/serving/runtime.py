@@ -96,7 +96,8 @@ class ServingRuntime:
                  start_iteration: int = 0,
                  num_iteration: Optional[int] = None,
                  name: str = "default",
-                 device_sum: str = "auto"):
+                 device_sum: str = "auto",
+                 device=None):
         self._booster = booster
         self.name = name
         self.max_batch_rows = max(int(max_batch_rows), 1)
@@ -105,6 +106,11 @@ class ServingRuntime:
         self._device_sum_mode = str(device_sum).lower()
         self._device_sum_ok = False
         self.demoted = False
+        #: pin every device array (export planes + staged inputs) to one
+        #: device — the sharded serving plane builds one pinned runtime
+        #: per mesh device (serving/sharded.py).  None = default device,
+        #: the pre-existing behavior.
+        self.device = device
         self._refresh_lock = threading.Lock()
         self._staging_lock = threading.Lock()
         self._staging: Dict = {}
@@ -119,10 +125,35 @@ class ServingRuntime:
         costs one dict lookup).  Re-runs the device-sum parity probe
         against the new export and re-promotes a demoted runtime."""
         with self._refresh_lock:
-            self._export = self._booster.export_predict_arrays(
-                self._start, self._num)
+            self._export = self._pin_export(
+                self._booster.export_predict_arrays(self._start,
+                                                    self._num))
             self.demoted = False
             self._device_sum_ok = self._device_sum_enable(self._export)
+
+    def _pin_export(self, ex: Dict) -> Dict:
+        """Copy the export's device arrays onto this runtime's pinned
+        device (replication H2D/D2D traffic, one `mesh.collective.
+        replicate` span per refresh).  The booster's shared export cache
+        is left untouched — same copy-not-mutate discipline as
+        `demote()` — so co-resident replicas and unpinned runtimes keep
+        their own placement."""
+        if self.device is None:
+            return ex
+        from ..mesh.placement import collective_span
+        with collective_span("replicate", model=self.name,
+                             device=int(self.device.id)):
+            ex = dict(ex)
+            st = ex.get("stacked")
+            if st:
+                ex["stacked"] = {
+                    k: jax.device_put(v, self.device)
+                    if isinstance(v, jax.Array) else v
+                    for k, v in st.items()}
+            for k in ("value_hi", "value_lo"):
+                if ex.get(k) is not None:
+                    ex[k] = jax.device_put(ex[k], self.device)
+        return ex
 
     def stale(self) -> bool:
         """Has the booster mutated since the last refresh()?"""
@@ -512,6 +543,8 @@ class ServingRuntime:
             with np.errstate(over="ignore"):
                 buf[:n] = Xc
             buf[n:] = 0.0
+            if self.device is not None:
+                return jnp.array(buf, device=self.device)
             return jnp.array(buf)
 
     def _convert(self, raw: np.ndarray) -> np.ndarray:

@@ -104,6 +104,24 @@ RULES: List[Tuple[str, str, str]] = [
     ("*serving.device_sum.p50_ms", "up_is_bad", "timing"),
     ("*serving.device_sum.p99_ms", "up_is_bad", "timing"),
     ("*serving.slot_path.*", "ignore", "timing"),
+    # sharded serving plane (PR 10): replica latency percentiles are
+    # wall-clock; the replica count shrinking means the mesh silently
+    # lost devices (fail hard); stripe imbalance growing means the
+    # least-outstanding-work scheduler stopped balancing (fail hard).
+    # Per-replica rows/rung/outstanding series are load-dependent
+    # bookkeeping
+    ("*serve.replica.*.p50_s", "up_is_bad", "timing"),
+    ("*serve.replica.*.p90_s", "up_is_bad", "timing"),
+    ("*serve.replica.*.p99_s", "up_is_bad", "timing"),
+    ("*serve.replica.*.p999_s", "up_is_bad", "timing"),
+    ("*serve.replica.*", "ignore", "counter"),
+    ("gauges.serve.replicas", "down_is_bad", "counter"),
+    ("*serving.sharded.replicas", "down_is_bad", "counter"),
+    ("*stripe_imbalance", "up_is_bad", "counter"),
+    ("*serving.sharded.p50_ms", "up_is_bad", "timing"),
+    ("*serving.sharded.p99_ms", "up_is_bad", "timing"),
+    ("*serving.sharded.rows_per_sec*", "down_is_bad", "timing"),
+    ("*serving.sharded.*", "ignore", "counter"),
     # server-side per-rung latency histograms (ISSUE 8): the
     # `serve.stage.e2e{rung=...}` percentile paths in a registry
     # snapshot, and the bench `serving.server.<rung>` block next to the

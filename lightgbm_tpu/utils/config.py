@@ -282,10 +282,21 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # deterministic 1-in-N sampling of healthy requests, so the ring
     # shows what normal looks like next to the tail
     "serve_trace_sample": (64, "int", ()),
+    # sharded serving (serving/sharded.py): replicate the exported model
+    # onto this many mesh devices and stripe flushed micro-batches over
+    # the replicas with a least-outstanding-work scheduler.  0 = all
+    # visible devices, 1 = the single-device runtime (default)
+    "serve_shard_devices": (1, "int", ("shard_devices",)),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),
     "tpu_num_shards": (0, "int", ()),        # 0 = all visible devices
+    # explicit mesh topology for the distributed learners, overriding
+    # num_machines/tpu_num_shards/tpu_dcn_slices: "N" builds a 1-D data
+    # mesh over N devices, "DxI" a 2-level ("dcn", "ici") mesh
+    # (mesh/topology.py parse_mesh_shape).  Empty/"auto" = derive from
+    # the other params
+    "mesh_shape": ("", "str", ()),
     # debug mode: enable jax_debug_nans so any NaN/Inf produced inside the
     # jitted training step raises FloatingPointError at the offending op
     # (our analog of the reference's USE_SANITIZER builds,

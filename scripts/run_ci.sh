@@ -223,6 +223,84 @@ print(f"[run_ci] external-memory smoke: byte parity over "
       f"{g['datastore.peak_resident_mb']} MB <= {budget_mb} MB budget")
 EOF
 
+# mesh smoke (PR 10): distributed training + sharded serving on the
+# virtual 8-device mesh.  One data-parallel training round must be
+# byte-identical to the serial learner (one round pins the psum
+# ordering; multi-round score accumulation is covered with tolerances
+# in tests/test_distributed.py), and a sharded-serving /predict over
+# all 8 replicas must return bytes identical to the single-device
+# runtime and to booster.predict.  The per-family / wedge / budget
+# matrix lives in tests/test_sharded_serving.py
+XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+python - <<'EOF'
+import json
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import jax
+
+import lightgbm_tpu as lgb
+
+assert len(jax.devices()) == 8, jax.devices()
+
+# --- data-parallel training round vs serial, byte-identical
+rng = np.random.RandomState(7)
+X = rng.randn(2048, 6)
+y = (X[:, 0] - 0.5 * X[:, 1] + 0.3 * rng.randn(2048) > 0).astype(float)
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 20}
+strip = lambda s: "\n".join(l for l in s.splitlines()
+                            if not l.startswith("["))
+ser = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=1)
+dp = lgb.train({**params, "tree_learner": "data", "num_machines": 8},
+               lgb.Dataset(X, label=y), num_boost_round=1)
+assert strip(ser.model_to_string()) == strip(dp.model_to_string()), \
+    "data-parallel round != serial round"
+print("[run_ci] mesh smoke: 8-shard data-parallel round == serial "
+      "(byte-identical)")
+
+# --- sharded serving /predict parity over all 8 replicas
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import ServingClient, ServingRuntime
+from lightgbm_tpu.serving.http import make_server
+from lightgbm_tpu import telemetry
+
+bst = Booster(model_file="tests/data/golden_multiclass.model.txt")
+Xg, _ = make_case_data(GOLDEN_CASES["multiclass"])
+single = ServingRuntime(bst, max_batch_rows=64, name="ci.1dev")
+client = ServingClient(bst, params={"serve_warmup": False,
+                                    "serve_shard_devices": 0,
+                                    "serve_max_batch_rows": 64})
+rt = client.registry.get().runtime
+assert rt.num_replicas == 8, rt.num_replicas
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+body = json.dumps({"rows": Xg.tolist()}).encode()
+req = urllib.request.Request(f"http://127.0.0.1:{port}/predict",
+                             data=body,
+                             headers={"Content-Type": "application/json"})
+resp = json.loads(urllib.request.urlopen(req, timeout=120).read())
+got = np.asarray(resp["predictions"], np.float64)
+want = bst.predict(Xg)
+assert got.shape == want.shape and np.array_equal(got, want), \
+    "sharded /predict != booster.predict"
+assert np.array_equal(got, single.predict(Xg)), \
+    "sharded /predict != single-device runtime"
+used = sum(1 for i in range(8)
+           if telemetry.REGISTRY.counter(f"serve.replica.{i}.rows").value)
+assert used >= 2, f"striping engaged only {used} replica(s)"
+srv.shutdown()
+srv.server_close()
+client.close()
+print(f"[run_ci] mesh smoke: sharded /predict byte-identical over "
+      f"{used} striped replicas")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

@@ -70,6 +70,17 @@ def build_snapshot(rounds: int, rel_tol: float,
     lgb.train({**params, "flight_recorder": False,
                "external_memory": True, "datastore_shard_rows": 512},
               lgb.Dataset(X, label=y), num_boost_round=4)
+    # sharded serving segment: one pinned replica per visible device
+    # (1 on the CPU CI box) so the baseline carries the
+    # serve.replicas / serve.replica.<i>.* / stripe-imbalance names
+    # the PR-10 sentinel rules watch.  One predict keeps every counter
+    # deterministic; the latency histograms are timing-class anyway
+    from lightgbm_tpu.serving import ServingClient
+    client = ServingClient(bst, params={"serve_max_wait_ms": 0.0,
+                                        "serve_shard_devices": 0})
+    client.predict(np.ascontiguousarray(Xe, dtype=np.float64),
+                   raw_score=True)
+    client.close()
     return {
         "backend": jax.devices()[0].platform,
         "sentinel": {"rel_tol": float(bst.config.telemetry_diff_rel_tol),

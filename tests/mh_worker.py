@@ -17,9 +17,13 @@ def main() -> None:
     port = sys.argv[3]
     outdir = sys.argv[4]
 
+    # the repo's own multi-host bring-up (mesh runtime): enables the CPU
+    # gloo collectives this jax needs for cross-process programs, then
+    # jax.distributed.initialize
+    from lightgbm_tpu.mesh import init
+    init(coordinator_address=f"127.0.0.1:{port}",
+         num_processes=nproc, process_id=pid)
     import jax
-    jax.distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
-                               num_processes=nproc, process_id=pid)
     import numpy as np
 
     import __graft_entry__ as g

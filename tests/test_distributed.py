@@ -37,11 +37,18 @@ def _feat_of(mappers, f):
         mono=jnp.zeros(f, jnp.int32))
 
 
+# Tiering: every test here passes on the virtual 8-device mesh, but the
+# full-parity trainings compile large shard_map programs (~2.5 min for
+# the file on a shared CPU box).  Tier-1 (-m 'not slow') keeps one fast
+# representative per distributed surface (grower parity, public-API data
+# learner, dcn mesh, fused chunks); the heavyweight parity variants run
+# in `scripts/run_ci.sh full`.
 class TestShardedGrower:
     def test_eight_devices_available(self):
         assert len(jax.devices()) == 8
 
-    @pytest.mark.parametrize("shards", [2, 8])
+    @pytest.mark.parametrize(
+        "shards", [2, pytest.param(8, marks=pytest.mark.slow)])
     def test_sharded_matches_single(self, shards):
         X, y = make_data()
         ds = lgb.Dataset(X, label=y)
@@ -91,6 +98,7 @@ class TestShardedGrower:
         np.testing.assert_allclose(np.asarray(new_score), expected,
                                    rtol=2e-4, atol=2e-6)
 
+    @pytest.mark.slow
     def test_multi_iteration_sharded_training(self):
         X, y = make_data(1600)
         ds = lgb.Dataset(X, label=y)
@@ -116,6 +124,7 @@ class TestShardedGrower:
                            + (1 - y) * np.log(1 - p + 1e-9))
         assert logloss < 0.45  # learned something across 8 shards
 
+    @pytest.mark.slow
     def test_public_api_tree_learner_parity(self):
         """`lgb.train({"tree_learner": ...})` must actually shard and grow
         the same trees as the serial learner (ref: the reference's
@@ -143,6 +152,7 @@ class TestShardedGrower:
             np.testing.assert_allclose(dist.predict(X, raw_score=True),
                                        preds_ref, rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_wave_data_rs_parity(self):
         """The wave policy composes with tree_learner=data's production
         reduce-scatter mode (VERDICT r3 #3): block-scattered multi-leaf
@@ -171,6 +181,7 @@ class TestShardedGrower:
                                    serial.predict(X, raw_score=True),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_wave_data_rs_with_cegb_and_ic_parity(self):
         """r5: CEGB penalties + interaction constraints must survive the
         distributed wave grower's block split search (penalty/mask
@@ -211,6 +222,7 @@ class TestShardedGrower:
                                    serial.predict(X, raw_score=True),
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_wave_data_rs_forced_splits_parity(self, tmp_path):
         """r5: forced splits under the distributed wave grower — the
         forced feature lives on ONE shard's block; its shard proposes
@@ -267,6 +279,7 @@ class TestShardedGrower:
                                    bp.predict(X, raw_score=True),
                                    rtol=1e-5, atol=1e-7)
 
+    @pytest.mark.slow
     def test_voting_elects_subset_when_features_exceed_2k(self):
         """Real PV-Tree path: with top_k < F/2, only elected features'
         histograms are reduced — the model must still learn and only use
@@ -315,6 +328,7 @@ class TestShardedGrower:
         assert bst._mesh is not None
         assert bst._mesh.shape["data"] == 2
 
+    @pytest.mark.slow
     def test_fractional_weights_not_squared(self):
         """Row weights must enter the histogram exactly once (g·w, h·w, w) —
         a rank-weighted run must match an unsharded grower given the same

@@ -1,0 +1,207 @@
+"""Sharded serving plane (serving/sharded.py) on the virtual 8-device mesh.
+
+The load-bearing claims (PR 10 acceptance criteria):
+
+* BYTE-identity — every replica runs the unchanged 3-rung ladder, so a
+  striped `ShardedServingRuntime.predict` must equal the single-device
+  `ServingRuntime` (and `booster.predict`) bit-for-bit on every golden
+  family with >= 2 replicas.
+* DETERMINISTIC striping — the least-outstanding-work assignment is
+  computed before dispatch from a snapshot of the outstanding vector
+  (ties to the lowest replica index), so quiesced replicas route the
+  same input to the same stripes every time.
+* WEDGE isolation — a device error on one replica degrades only that
+  replica (its own ladder falls back, counted per replica); the other
+  replicas keep serving their rung, and the merged bytes stay exact.
+* BUDGET — `serve_vram_budget_mb` is per device: the registry ceiling
+  scales by the replica count, and a model whose per-replica export
+  exceeds the per-device budget is rejected with models kept serving.
+"""
+import numpy as np
+import pytest
+
+import jax
+
+import lightgbm_tpu as lgb
+import lightgbm_tpu.serving.runtime as srt
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import (ModelRegistry, ServingRuntime,
+                                  ShardedServingRuntime,
+                                  resolve_shard_devices)
+
+# quick-tier smoke: one representative per claim (full file runs in
+# tier-1 / `run_ci.sh full`)
+quick = pytest.mark.quick
+
+
+def _golden(name):
+    bst = Booster(model_file=f"tests/data/golden_{name}.model.txt")
+    X, _ = make_case_data(GOLDEN_CASES[name])
+    return bst, X
+
+
+@quick
+def test_mesh_has_eight_devices():
+    assert len(jax.devices()) == 8
+
+
+@quick
+def test_resolve_shard_devices():
+    assert len(resolve_shard_devices(0)) == 8
+    assert [d.id for d in resolve_shard_devices(3)] == [0, 1, 2]
+    with pytest.raises(lgb.LightGBMError, match="exceeds visible"):
+        resolve_shard_devices(9)
+
+
+# ---------------------------------------------------- golden byte-parity
+@pytest.mark.parametrize(
+    "name", [pytest.param(n, marks=quick) if n == "multiclass" else n
+             for n in sorted(GOLDEN_CASES)])
+def test_golden_family_replica_byte_parity(name):
+    # small max_batch_rows forces real striping (many chunks over many
+    # replicas); the concatenation must still match the single-device
+    # runtime bit-for-bit — checked for both raw and transformed scores
+    # on the SAME replicated runtimes (replication is the slow part)
+    bst, X = _golden(name)
+    single = ServingRuntime(bst, max_batch_rows=64, name=f"{name}.1dev")
+    shard = ShardedServingRuntime(bst, shard_devices=0, max_batch_rows=64,
+                                  name=name)
+    assert shard.num_replicas == 8
+    for raw in (True, False):
+        want = single.predict(X, raw_score=raw)
+        got = shard.predict(X, raw_score=raw)
+        assert got.dtype == want.dtype and got.shape == want.shape
+        assert np.array_equal(got, want), \
+            f"{name} raw={raw}: sharded != single-device runtime"
+        assert np.array_equal(got, bst.predict(X, raw_score=raw))
+
+
+def test_two_replica_parity_and_ragged_tails():
+    bst, X = _golden("multiclass")
+    shard = ShardedServingRuntime(bst, shard_devices=2, max_batch_rows=32)
+    assert shard.num_replicas == 2
+    for n in (1, 3, 33, 100, len(X)):
+        assert np.array_equal(shard.predict(X[:n]), bst.predict(X[:n]))
+
+
+# ------------------------------------------------ deterministic striping
+@quick
+def test_striping_is_deterministic_when_quiesced():
+    bst, _ = _golden("binary")
+    shard = ShardedServingRuntime(bst, shard_devices=4, max_batch_rows=16)
+    chunks = [(i * 16, (i + 1) * 16) for i in range(6)]
+    a1 = shard._assign(chunks)
+    # greedy least-outstanding with lowest-index ties: first 4 chunks
+    # land one per idle replica, then the load is equal again
+    assert a1 == [0, 1, 2, 3, 0, 1]
+    for (lo, hi), i in zip(chunks, a1):        # quiesce (stripes done)
+        shard._outstanding[i] -= hi - lo
+    assert shard._assign(chunks) == a1
+    assert shard._routed == [64, 64, 32, 32]
+
+
+def test_striping_balances_routed_rows_end_to_end():
+    bst, X = _golden("regression_l2")
+    shard = ShardedServingRuntime(bst, shard_devices=8, max_batch_rows=32)
+    n = (len(X) // 256) * 256                  # 8-chunk multiple
+    p1 = shard.predict(X[:n])
+    routed1 = list(shard._routed)
+    assert routed1 == [n // 8] * 8             # perfectly balanced
+    p2 = shard.predict(X[:n])                  # quiesced: same stripes
+    assert list(shard._routed) == [n // 4] * 8
+    assert np.array_equal(p1, p2)
+    imb = telemetry.REGISTRY.gauge(
+        "serving.sharded.stripe_imbalance").value
+    assert imb == 1.0
+
+
+# --------------------------------------------------------- wedge isolation
+@quick
+def test_one_wedged_replica_degrades_only_itself(monkeypatch):
+    # wedge BOTH device programs, but only for arrays committed to
+    # device 1: that replica must walk the host rung (still exact) while
+    # every other replica keeps its device-sum rung
+    bst, X = _golden("binary")
+    shard = ShardedServingRuntime(bst, shard_devices=4, max_batch_rows=32)
+    assert all(r.device_sum_active for r in shard.replicas)
+    wedged = shard.devices[1].id
+    real_exact, real_leaf = srt._EXACT_JIT, srt._LEAF_JIT
+
+    def exact(arrays, Xd, **kw):
+        if next(iter(Xd.devices())).id == wedged:
+            raise RuntimeError("device wedged")
+        return real_exact(arrays, Xd, **kw)
+
+    def leaf(arrays, Xd, **kw):
+        if next(iter(Xd.devices())).id == wedged:
+            raise RuntimeError("device wedged")
+        return real_leaf(arrays, Xd, **kw)
+
+    monkeypatch.setattr(srt, "_EXACT_JIT", exact)
+    monkeypatch.setattr(srt, "_LEAF_JIT", leaf)
+    hw = [telemetry.REGISTRY.counter(f"serve.replica.{i}.host_walk").value
+          for i in range(4)]
+    ds = [telemetry.REGISTRY.counter(f"serve.replica.{i}.device_sum").value
+          for i in range(4)]
+    clock = telemetry.StageClock()
+    got = shard.predict(X[:128], clock=clock)      # one chunk per replica
+    assert np.array_equal(got, bst.predict(X[:128]))
+    hw2 = [telemetry.REGISTRY.counter(
+               f"serve.replica.{i}.host_walk").value for i in range(4)]
+    ds2 = [telemetry.REGISTRY.counter(
+               f"serve.replica.{i}.device_sum").value for i in range(4)]
+    assert [b - a for a, b in zip(hw, hw2)] == [0, 1, 0, 0]
+    assert [b - a for a, b in zip(ds, ds2)] == [1, 0, 1, 1]
+    # the merged clock surfaces the most degraded rung of the request
+    assert clock.rung == "host_walk"
+
+
+# ------------------------------------------------------------ budgeting
+def test_per_device_budget_scales_with_replicas():
+    bst, X = _golden("binary")
+    per_replica = ServingRuntime(bst, name="budget.probe").device_bytes()
+    # fits per device, so it must fit 8x replicated even though the
+    # TOTAL device bytes exceed the per-device budget by ~8x
+    budget_mb = (per_replica + 4096) / (1 << 20)
+    reg = ModelRegistry({"serve_shard_devices": 0, "serve_warmup": False,
+                         "serve_vram_budget_mb": budget_mb})
+    try:
+        entry = reg.load("m", bst)
+        assert entry.runtime.num_replicas == 8
+        assert entry.runtime.device_bytes() > budget_mb * (1 << 20)
+        assert np.array_equal(reg.predict(X[:16], model="m"),
+                              bst.predict(X[:16]))
+    finally:
+        reg.close()
+
+
+@quick
+def test_replication_overflowing_per_device_budget_is_rejected():
+    bst, _ = _golden("binary")
+    per_replica = ServingRuntime(bst, name="budget.probe2").device_bytes()
+    reg = ModelRegistry({"serve_shard_devices": 0, "serve_warmup": False,
+                         "serve_vram_budget_mb":
+                             per_replica * 0.5 / (1 << 20)})
+    try:
+        with pytest.raises(lgb.LightGBMError, match="keep serving"):
+            reg.load("m", bst)
+        assert reg.names() == []
+    finally:
+        reg.close()
+
+
+def test_registry_builds_sharded_runtime_and_serves():
+    bst, X = _golden("goss_bagging")
+    reg = ModelRegistry({"serve_shard_devices": 3, "serve_warmup": False,
+                         "serve_max_wait_ms": 0.0})
+    try:
+        entry = reg.load("g", bst)
+        assert isinstance(entry.runtime, ShardedServingRuntime)
+        assert entry.runtime.num_replicas == 3
+        assert np.array_equal(reg.predict(X, model="g"), bst.predict(X))
+        st = reg.status()
+        assert st["models"] == ["g"] and st["demoted"] == []
+    finally:
+        reg.close()

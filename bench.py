@@ -776,6 +776,82 @@ def _run_worker() -> None:
                      f"{sh['rows_per_sec']:,.0f} rows/s total "
                      f"({sh['rows_per_sec_per_replica']:,.0f}/replica), "
                      f"stripe imbalance {sh['stripe_imbalance']}")
+            # continuous-training fleet: closed-loop predict latency
+            # through a live gated hot-swap vs steady state — the
+            # serving cost of staying fresh (append -> retrain -> gate
+            # -> build-then-swap), measured from the caller's side.
+            # Guarded separately: a fleet failure must not lose the
+            # serving numbers above
+            try:
+                import shutil
+                import tempfile
+                import threading
+                from lightgbm_tpu.datastore.store import ShardStore
+                from lightgbm_tpu.fleet import (TrainerDaemon,
+                                                create_fleet_store)
+                fn = int(os.environ.get("BENCH_FLEET_ROWS", 4096))
+                Xf = np.ascontiguousarray(X[:fn], np.float64)
+                yf = np.asarray(y[:fn], np.float32)
+                fparams = {"objective": "binary", "num_leaves": 31,
+                           "verbosity": -1}
+                fb = lgb.train(fparams, lgb.Dataset(Xf, label=yf),
+                               num_boost_round=8)
+                fdir = tempfile.mkdtemp(prefix="bench_fleet_")
+                create_fleet_store(fdir, Xf, yf, shard_rows=2048)
+                fc = ServingClient(fb, params={"serve_max_wait_ms": 0.0,
+                                               "serve_warmup": False})
+                daemon = TrainerDaemon(
+                    fdir, fc.registry, fb, train_params=fparams,
+                    params={"fleet_retrain_rows": fn // 2,
+                            "fleet_rounds": 4,
+                            "fleet_shadow_rows": 1024})
+                Xq = np.ascontiguousarray(X_eval[:256], np.float64)
+                fc.predict(Xq, raw_score=True)     # steady state
+                lat0 = []
+                for _ in range(30):
+                    t0 = time.perf_counter()
+                    fc.predict(Xq, raw_score=True)
+                    lat0.append(time.perf_counter() - t0)
+                lat_sw, stop_h = [], threading.Event()
+
+                def _hammer():
+                    while not stop_h.is_set():
+                        t0 = time.perf_counter()
+                        fc.predict(Xq, raw_score=True)
+                        lat_sw.append(time.perf_counter() - t0)
+
+                th = threading.Thread(target=_hammer)
+                th.start()
+                ShardStore.open(fdir).append_rows(
+                    Xf[:fn // 2], label=yf[:fn // 2])
+                t_sw = time.perf_counter()
+                daemon.step()
+                swap_s = time.perf_counter() - t_sw
+                stop_h.set()
+                th.join()
+                daemon.stop()
+                fc.close()
+                shutil.rmtree(fdir, ignore_errors=True)
+                l0 = np.sort(np.asarray(lat0)) * 1e3
+                ls = np.sort(np.asarray(lat_sw)) * 1e3
+                fl = {"swaps": daemon.swaps, "rejects": daemon.rejects,
+                      "append_to_swap_s": round(swap_s, 3),
+                      "steady_p50_ms": round(
+                          float(np.percentile(l0, 50)), 3),
+                      "steady_p99_ms": round(
+                          float(np.percentile(l0, 99)), 3),
+                      "swap_window_p99_ms": round(
+                          float(np.percentile(ls, 99)), 3)
+                          if len(ls) else None,
+                      "requests_during_swap": len(ls)}
+                blk["fleet"] = fl
+                _log(f"fleet bench: append->swap {fl['append_to_swap_s']}"
+                     f" s ({fl['swaps']} swap, {fl['rejects']} reject), "
+                     f"p99 {fl['steady_p99_ms']} ms steady -> "
+                     f"{fl['swap_window_p99_ms']} ms through the swap "
+                     f"({fl['requests_during_swap']} reqs)")
+            except Exception as e:
+                _log(f"fleet bench failed: {e}")
             print("@serving " + json.dumps(blk, separators=(",", ":")),
                   flush=True)
             _log(f"serving rungs @{rung_rows} rows: device_sum "

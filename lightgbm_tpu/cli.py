@@ -227,7 +227,9 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu lint [--format json|text]"
               " [--update-baseline]\n"
               "       python -m lightgbm_tpu serve model=<model_file>"
-              " [serve_port=...] [serve_trace=...]",
+              " [serve_port=...] [serve_trace=...]\n"
+              "       python -m lightgbm_tpu fleet model=<model_file>"
+              " store=<datastore_dir> [fleet_retrain_rows=...]",
               file=sys.stderr)
         return 0
     if argv[0] == "serve":
@@ -235,6 +237,11 @@ def run(argv: List[str]) -> int:
         # server over the micro-batched device runtime
         from .serving.http import main as serve_main
         return serve_main(argv[1:])
+    if argv[0] == "fleet":
+        # continuous-training fleet (fleet/daemon.py): HTTP serving +
+        # the datastore-tailing trainer daemon in one process
+        from .fleet.daemon import main as fleet_main
+        return fleet_main(argv[1:])
     if argv[0] == "telemetry-report":
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main

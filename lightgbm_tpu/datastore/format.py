@@ -60,10 +60,18 @@ def _canonical_dump(manifest: Dict[str, Any]) -> bytes:
 
 def write_manifest(dirpath: str, manifest: Dict[str, Any]) -> str:
     """Write the manifest atomically (tmp + rename) with its embedded
-    self-checksum stamped."""
+    self-checksum stamped.
+
+    `generation` is the append-epoch counter (fleet tailing): a freshly
+    finalized store is generation 0 and every `ShardStore.append_rows`
+    rewrite bumps it, so a tailing reader can tell "the store grew"
+    apart from "the manifest was re-read unchanged" without diffing the
+    shard list.  Stores written before the field existed read as
+    generation 0."""
     manifest = dict(manifest)
     manifest["format"] = FORMAT_NAME
     manifest["version"] = FORMAT_VERSION
+    manifest.setdefault("generation", 0)
     manifest["manifest_crc32"] = crc32_bytes(_canonical_dump(manifest))
     path = os.path.join(dirpath, MANIFEST_NAME)
     tmp = path + ".tmp"

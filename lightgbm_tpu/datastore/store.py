@@ -176,6 +176,9 @@ class ShardStore:
         self.n_features = int(manifest["n_features"])
         self.bundle_cols = int(manifest.get("bundle_cols", 0))
         self.shard_rows = int(manifest["shard_rows"])
+        #: append-epoch counter: bumped by every `append_rows` manifest
+        #: rewrite (pre-append stores read as 0)
+        self.generation = int(manifest.get("generation", 0))
         self.payloads: Tuple[str, ...] = tuple(manifest["payloads"])
         self.shards: List[Dict[str, Any]] = manifest["shards"]
         self.meta: Dict[str, Any] = manifest.get("meta", {})
@@ -206,6 +209,81 @@ class ShardStore:
         names = [payload] if payload else list(self.payloads)
         return sum(int(s["files"][p]["nbytes"])
                    for s in self.shards for p in names)
+
+    # ---------------------------------------------------------- appending
+    def append_rows(self, bins: np.ndarray,
+                    bundle: Optional[np.ndarray] = None,
+                    label: Optional[np.ndarray] = None,
+                    weight: Optional[np.ndarray] = None) -> int:
+        """Grow the store: write a row-major block as NEW tail shards and
+        atomically rewrite the manifest with `generation` bumped.
+
+        The growable surface the continuous-training fleet tails
+        (fleet/daemon.py).  Tamper rules are preserved end to end: every
+        new payload file gets its own crc32 + byte count entry, the
+        rewritten manifest re-stamps its self-checksum, and the rewrite
+        is tmp+rename atomic — a tailing reader re-opening the manifest
+        sees either the whole old generation or the whole new one, never
+        a torn index.  Existing shard files are never touched (the
+        previous tail shard may stay partial — per-shard row counts are
+        authoritative), so readers holding the old manifest keep
+        verifying cleanly.  Returns the new generation number.
+        """
+        blocks = {"bins": np.asarray(bins, dtype=self.dtype)}
+        rows = blocks["bins"].shape[0]
+        if blocks["bins"].ndim != 2 or \
+                blocks["bins"].shape[1] != self.n_features:
+            raise LightGBMError(
+                f"datastore append_rows: bins block "
+                f"{blocks['bins'].shape} does not match "
+                f"n_features={self.n_features}")
+        if rows == 0:
+            raise LightGBMError("datastore append_rows: empty block")
+        for name, arr in (("bundle", bundle), ("label", label),
+                          ("weight", weight)):
+            if name in self.payloads:
+                if arr is None or len(arr) != rows:
+                    raise LightGBMError(
+                        f"datastore append_rows: payload '{name}' missing "
+                        f"or misaligned "
+                        f"({None if arr is None else len(arr)} vs {rows} "
+                        f"rows)")
+                dt = _VEC_DTYPES.get(name, self.dtype)
+                blocks[name] = np.asarray(arr, dtype=dt)
+        new_entries: List[Dict[str, Any]] = []
+        pos = 0
+        while pos < rows:
+            take = min(self.shard_rows, rows - pos)
+            index = len(self.shards) + len(new_entries)
+            entry: Dict[str, Any] = {"row0": self.n_rows + pos,
+                                     "rows": take, "files": {}}
+            for payload in self.payloads:
+                block = blocks[payload][pos:pos + take]
+                if payload in ("bins", "bundle"):
+                    block = np.ascontiguousarray(block.T)  # -> [F|G, rows]
+                else:
+                    block = np.ascontiguousarray(block)
+                raw = block.tobytes()
+                name = _fmt.shard_filename(index, payload)
+                with open(os.path.join(self.dirpath, name), "wb") as fh:
+                    fh.write(raw)
+                entry["files"][payload] = {"crc32": _fmt.crc32_bytes(raw),
+                                           "nbytes": len(raw)}
+            new_entries.append(entry)
+            pos += take
+        manifest = dict(self.manifest)
+        manifest["shards"] = list(self.shards) + new_entries
+        manifest["n_rows"] = self.n_rows + rows
+        manifest["generation"] = self.generation + 1
+        _fmt.write_manifest(self.dirpath, manifest)
+        # re-read through the validator so this handle's view is exactly
+        # what any fresh reader sees (and the rewrite round-trips)
+        fresh = _fmt.read_manifest(self.dirpath)
+        self.manifest = fresh
+        self.n_rows = int(fresh["n_rows"])
+        self.generation = int(fresh["generation"])
+        self.shards = fresh["shards"]
+        return self.generation
 
     # ------------------------------------------------------------ reading
     def load_shard(self, k: int, payload: str = "bins") -> np.ndarray:

@@ -261,7 +261,26 @@ def _continue_from(booster: Booster, init_booster: Booster) -> None:
 
     if init_booster.num_model_per_iteration() != booster.num_tree_per_iteration:
         raise LightGBMError("init_model has different num_tree_per_iteration")
-    booster.trees = list(init_booster.trees)
+    n_feat = booster.train_set.num_feature()
+    for t in init_booster.trees:
+        ni = max(t.num_leaves - 1, 0)
+        if ni and int(np.max(t.split_feature[:ni])) >= n_feat:
+            raise LightGBMError(
+                "init_model splits on feature "
+                f"{int(np.max(t.split_feature[:ni]))} but the training set "
+                f"has only {n_feat} features")
+    # shallow-copy each frozen tree with private threshold_bin storage:
+    # the continuation's bin-level thresholds must be re-derived from THIS
+    # training set's mappers, but the init booster may still be serving —
+    # recompute_threshold_bins writes threshold_bin in place, so sharing
+    # the array would rewrite the live model's bins under its readers
+    # (and leave them wrong if the candidate is later rejected).  All
+    # other planes stay shared: frozen trees are read-only from here on.
+    booster.trees = []
+    for t in init_booster.trees:
+        t2 = copy.copy(t)
+        t2.threshold_bin = np.array(t.threshold_bin, copy=True)
+        booster.trees.append(t2)
     booster.cur_iter = init_booster.current_iteration()
     booster._boost_from_average_done = True  # bias lives in loaded tree 0
     K = booster.num_tree_per_iteration

@@ -1,0 +1,31 @@
+"""Continuous-training fleet: the online loop at production traffic.
+
+Three organs close training and serving into one process (ROADMAP
+item 5):
+
+  - `daemon`  — `TrainerDaemon` tails an append-only `ShardStore`
+    (`append_rows` + manifest generation bumps) and continues the live
+    booster via `init_model` every `fleet_retrain_rows` new rows.
+  - `shadow`  — `ShadowGate` scores each candidate against the live
+    model (frozen-prefix byte parity, holdout metric, sampled-traffic
+    shift) before the registry hot-swap; `TrafficSampler` feeds it from
+    the registry's sampler hook.
+  - `tenancy` — `TenantRegistry` runs tens of named models with
+    per-model SLO classes and admission control; `ReplicaAutoscaler`
+    resizes sharded replica sets from the `serve.replica.*` latency
+    histograms and the stripe-imbalance gauge.
+
+CLI: `python -m lightgbm_tpu fleet model=<file> store=<dir> ...`
+(docs/FLEET.md walks the whole lifecycle).
+"""
+from .daemon import TrainerDaemon, create_fleet_store
+from .shadow import GateVerdict, ShadowGate, TrafficSampler
+from .tenancy import (ReplicaAutoscaler, SLOClass, Tenant, TenantRegistry,
+                      parse_slo_classes)
+
+__all__ = [
+    "TrainerDaemon", "create_fleet_store",
+    "ShadowGate", "GateVerdict", "TrafficSampler",
+    "TenantRegistry", "Tenant", "SLOClass", "parse_slo_classes",
+    "ReplicaAutoscaler",
+]

@@ -1,0 +1,258 @@
+"""Trainer daemon: tail the datastore, continue the booster, gated swap.
+
+The online loop (ROADMAP item 5) that closes training and serving into
+one process:
+
+    store = create_fleet_store(dir, X0, y0)        # raw rows + labels
+    registry.load("default", live_booster)         # serving as usual
+    daemon = TrainerDaemon(dir, registry, live_booster,
+                           train_params={...}, params={...})
+    daemon.start()                                 # or step() in tests
+    ... producers call store.append_rows(X, y) ...
+
+Every poll the daemon re-opens the manifest (atomic rewrite means it
+always sees a whole generation — see `ShardStore.append_rows`).  Once
+`fleet_retrain_rows` NEW rows have landed it materializes the grown
+store, continues the live booster via `init_model` for `fleet_rounds`
+more rounds (`engine._continue_from` copies the live trees verbatim, so
+the frozen prefix is byte-identical by construction), and hands the
+candidate to the `ShadowGate`.  Only a passing candidate reaches
+`ModelRegistry.load` — the existing build-then-swap path, so serving
+never blips: every in-flight request completes on whichever model
+version was live at its dispatch.  A rejected candidate leaves the live
+model serving and still advances the tail mark (no hot-spin retraining
+the same rejected window).
+
+The fleet store holds RAW feature values (float64), not bin codes:
+every continuation re-bins the grown matrix with its own mappers, and
+tree thresholds are raw-value anyway — prefix byte-identity survives
+re-binning because the frozen trees are copied, never re-derived.
+
+CLI: `python -m lightgbm_tpu fleet model=<file> store=<dir>
+[name=default] [serve_port=...] [fleet_* ...]` — serves the model over
+the stdlib HTTP frontend while the daemon tails the store in the same
+process; `fleet_max_retrains=N` bounds the run (CI smokes).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..basic import Dataset
+from ..booster import Booster
+from ..datastore.store import ShardStore, ShardWriter
+from ..engine import train as engine_train
+from ..utils import log
+from ..utils.config import Config, canonical_param_name
+from ..utils.log import LightGBMError
+from .shadow import ShadowGate, TrafficSampler
+
+
+def create_fleet_store(dirpath: str, X, y, shard_rows: int = 4096,
+                       weight=None) -> ShardStore:
+    """Create an append-only fleet store: raw float64 feature rows +
+    float32 labels (meta marks the matrix payload as raw values, not
+    bin codes), ready for `append_rows` tailing."""
+    X = np.ascontiguousarray(np.asarray(X, dtype=np.float64))
+    if X.ndim != 2:
+        raise LightGBMError("create_fleet_store: X must be 2-D")
+    writer = ShardWriter(dirpath, n_features=X.shape[1], dtype=np.float64,
+                         shard_rows=shard_rows, has_label=True,
+                         has_weight=weight is not None,
+                         meta={"kind": "raw"})
+    writer.append(X, label=np.asarray(y, dtype=np.float32), weight=weight)
+    return writer.finalize()
+
+
+class TrainerDaemon:
+    """Tails one fleet store and keeps one registry entry continuously
+    trained.  `step()` is the synchronous unit (one manifest poll, at
+    most one retrain) — tests and the CLI loop both drive it; `start()`
+    wraps it in a polling thread."""
+
+    def __init__(self, store_dir: str, registry, booster: Booster, *,
+                 name: str = "default",
+                 train_params: Optional[Dict] = None,
+                 params=None):
+        self._config = params if isinstance(params, Config) \
+            else Config(dict(params or {}))
+        self.store_dir = store_dir
+        self.registry = registry
+        self.name = name
+        self._live = booster
+        # continuation params: strip iteration-count aliases so
+        # fleet_rounds (not a leftover num_iterations) sets the
+        # per-continuation round count
+        self._train_params = {
+            k: v for k, v in dict(train_params or {}).items()
+            if canonical_param_name(k) != "num_iterations"}
+        self._train_params.setdefault("verbosity", -1)
+        self.gate = ShadowGate(self._config)
+        self.sampler = TrafficSampler(self._config.fleet_sample_ring)
+        if registry is not None:
+            registry.attach_sampler(name, self.sampler)
+        store = ShardStore.open(store_dir)
+        #: rows the live model has already trained through — the tail
+        #: mark; only rows beyond it count toward fleet_retrain_rows
+        self.trained_rows = store.n_rows
+        self.generation = store.generation
+        self.retrains = 0
+        self.swaps = 0
+        self.rejects = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        telemetry.REGISTRY.gauge("fleet.rows_seen").set(store.n_rows)
+
+    @property
+    def live_booster(self) -> Booster:
+        return self._live
+
+    # ---------------------------------------------------------- the loop
+    def step(self) -> bool:
+        """One poll: re-open the manifest; when >= fleet_retrain_rows
+        new rows have landed, retrain + gate + (maybe) swap.  Returns
+        True when a retrain was attempted."""
+        store = ShardStore.open(self.store_dir)
+        telemetry.REGISTRY.gauge("fleet.rows_seen").set(store.n_rows)
+        self.generation = store.generation
+        if store.n_rows - self.trained_rows < \
+                int(self._config.fleet_retrain_rows):
+            return False
+        self._retrain(store)
+        return True
+
+    def _retrain(self, store: ShardStore) -> None:
+        cfg = self._config
+        with telemetry.span("fleet.retrain", model=self.name,
+                            rows=store.n_rows,
+                            generation=store.generation):
+            X = store.read_all_rows("bins")
+            y = store.load_vector("label")
+            weight = store.load_vector("weight") \
+                if "weight" in store.payloads else None
+            params = dict(self._train_params)
+            train_set = Dataset(X, label=y, weight=weight,
+                                params=dict(params))
+            candidate = engine_train(params, train_set,
+                                     num_boost_round=int(cfg.fleet_rounds),
+                                     init_model=self._live)
+            k = min(int(cfg.fleet_shadow_rows), len(X))
+            verdict = self.gate.evaluate(
+                self._live, candidate,
+                holdout=(X[len(X) - k:], y[len(y) - k:]),
+                traffic=self.sampler.sample(), model=self.name)
+        self.retrains += 1
+        telemetry.REGISTRY.counter("fleet.retrains").inc()
+        if verdict.passed:
+            if self.registry is not None:
+                # the existing build-then-swap path: the candidate is
+                # exported, admitted, warmed and batched BEFORE the name
+                # flips — serving never sees a cold or half-built model
+                self.registry.load(self.name, candidate)
+            self._live = candidate
+            self.swaps += 1
+            telemetry.REGISTRY.counter("fleet.swap.accepted").inc()
+            log.info(f"fleet: swapped {self.name!r} at "
+                     f"{store.n_rows} rows "
+                     f"({candidate.current_iteration()} iterations)")
+        else:
+            self.rejects += 1
+            telemetry.REGISTRY.counter("fleet.swap.rejected").inc()
+            log.warning(f"fleet: candidate for {self.name!r} rejected "
+                        f"({verdict.reason}); live model keeps serving")
+        # advance the tail mark either way: a rejected window must not
+        # hot-spin retraining the same rows forever
+        self.trained_rows = store.n_rows
+
+    def run(self) -> None:
+        """Poll until stopped or `fleet_max_retrains` is exhausted."""
+        cfg = self._config
+        poll_s = max(float(cfg.fleet_poll_ms), 1.0) / 1000.0
+        max_retrains = int(cfg.fleet_max_retrains)
+        while not self._stop.is_set():
+            try:
+                attempted = self.step()
+            except LightGBMError as e:
+                telemetry.REGISTRY.counter("fleet.poll_errors").inc()
+                log.warning(f"fleet: poll failed ({e}); retrying")
+                attempted = False
+            if max_retrains and self.retrains >= max_retrains:
+                break
+            if not attempted:
+                self._stop.wait(poll_s)
+
+    def start(self) -> "TrainerDaemon":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self.run, name=f"lgbm-tpu-fleet-{self.name}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 60.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=timeout)
+        if self.registry is not None:
+            self.registry.detach_sampler(self.name)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv) -> int:
+    """`python -m lightgbm_tpu fleet model=<file> store=<dir> ...` —
+    HTTP serving + the trainer daemon in one process."""
+    from ..cli import parse_args
+    from ..serving.client import ServingClient
+    from ..serving.http import make_server
+    params = parse_args(list(argv))
+    model_path = params.pop("model", "") or params.get("input_model", "")
+    store_dir = params.pop("store", "")
+    name = params.pop("name", "default")
+    if not model_path or not store_dir:
+        print("usage: python -m lightgbm_tpu fleet model=<model_file> "
+              "store=<datastore_dir> [name=default] [serve_port=...] "
+              "[fleet_retrain_rows=...] [fleet_rounds=...] "
+              "[fleet_max_retrains=...] [fleet_gate_tolerance=...]",
+              file=sys.stderr)
+        return 2
+    config = Config(dict(params))
+    booster = Booster(model_file=model_path)
+    client = ServingClient(booster, params=params, name=name)
+    log.set_verbosity(config.verbosity)
+    daemon = TrainerDaemon(store_dir, client.registry, booster, name=name,
+                           train_params=params, params=config)
+    server = make_server(client, config.serve_host, config.serve_port)
+    host, port = server.server_address[:2]
+    http_thread = threading.Thread(target=server.serve_forever,
+                                   name="lgbm-tpu-fleet-http", daemon=True)
+    http_thread.start()
+    log.info(f"fleet: serving {name!r} on http://{host}:{port}, tailing "
+             f"{store_dir} (retrain every "
+             f"{config.fleet_retrain_rows} rows)")
+    try:
+        daemon.run()  # returns when fleet_max_retrains is exhausted
+    except KeyboardInterrupt:
+        log.info("fleet: shutting down")
+    finally:
+        daemon.stop()
+        server.shutdown()
+        server.server_close()
+        client.close()
+    print(json.dumps({"fleet": name, "retrains": daemon.retrains,
+                      "swaps": daemon.swaps, "rejects": daemon.rejects,
+                      "rows": daemon.trained_rows,
+                      "generation": daemon.generation}))
+    return 0

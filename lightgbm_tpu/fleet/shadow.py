@@ -1,0 +1,210 @@
+"""Shadow-scoring gate: candidate vs live model before a hot-swap.
+
+The continuous-training loop (fleet/daemon.py) never swaps a candidate
+into the serving registry on faith.  `ShadowGate.evaluate` runs the
+candidate through three independent checks, strictest first:
+
+  1. **frozen-prefix byte parity** — a continued booster carries the
+     live model's trees verbatim (`engine._continue_from` copies them),
+     so every live tree's `Tree.to_string` section must byte-match the
+     candidate's tree at the same index.  Any divergence — a corrupted
+     leaf plane, a truncated copy, a candidate trained from the wrong
+     init model — is a hard reject: the swap would change answers for
+     traffic the live model already serves.
+  2. **holdout metric gate** — both models score the newest datastore
+     rows (the tail the candidate just trained through); the
+     candidate's loss may exceed the live model's by at most
+     `fleet_gate_tolerance` (relative).  The metric is squared error
+     against the labels on CONVERTED predictions: objective-agnostic
+     (probabilities and raw regression outputs both score), monotone in
+     quality for every objective this repo trains.
+  3. **traffic-shift gate** — both models score rows sampled from live
+     traffic (`TrafficSampler`, fed by the registry's sampler hook);
+     the relative mean-|delta| between their predictions must stay
+     within `fleet_gate_max_shift`.  New trees legitimately move
+     predictions, so this is a seat-belt against a candidate that
+     answers a different question, not a byte-parity check.
+
+Verdicts are recorded to telemetry either way: `fleet.gate.pass` /
+`fleet.gate.fail` counters, the `fleet.gate.latency` timing (how long
+the gate itself held the swap), and a `fleet.gate` event carrying the
+reason — the audit trail for "why did/didn't model N go live".
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from .. import telemetry
+from ..utils.config import Config
+
+
+class TrafficSampler:
+    """Bounded reservoir of recently-served feature rows.
+
+    Attached to a `ModelRegistry` via `attach_sampler`, it copies rows
+    out of each request's block (never mutating or retaining the
+    request's own array) into a fixed-capacity ring — oldest rows
+    overwritten round-robin, so the reservoir tracks the RECENT traffic
+    distribution the shadow gate should score against.  Deterministic:
+    no sampling randomness, so gate verdicts are reproducible from the
+    same traffic sequence.
+    """
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = max(int(capacity), 1)
+        self._lock = threading.Lock()
+        self._rows: list = []
+        self._seen = 0
+        self._width: Optional[int] = None
+
+    def __call__(self, X) -> None:
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.size == 0:
+            return
+        with self._lock:
+            if self._width is None:
+                self._width = X.shape[1]
+            elif X.shape[1] != self._width:
+                # mixed-width traffic (another model's rows) — skip;
+                # the gate needs a rectangular sample matrix
+                return
+            for row in X:
+                if len(self._rows) < self.capacity:
+                    self._rows.append(np.array(row))
+                else:
+                    self._rows[self._seen % self.capacity] = np.array(row)
+                self._seen += 1
+        telemetry.REGISTRY.gauge("fleet.sample_rows").set(len(self._rows))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    @property
+    def seen(self) -> int:
+        return self._seen
+
+    def sample(self) -> Optional[np.ndarray]:
+        """Snapshot of the reservoir as one [n, F] matrix (row copies),
+        or None while empty."""
+        with self._lock:
+            if not self._rows:
+                return None
+            return np.stack(self._rows)
+
+
+class GateVerdict:
+    """Outcome of one shadow evaluation: `passed`, the failing check's
+    `reason` (empty on pass), and the per-check measurements."""
+
+    def __init__(self, passed: bool, reason: str = "",
+                 checks: Optional[Dict] = None):
+        self.passed = bool(passed)
+        self.reason = reason
+        self.checks: Dict = checks or {}
+
+    def __bool__(self) -> bool:
+        return self.passed
+
+    def __repr__(self) -> str:
+        state = "PASS" if self.passed else f"REJECT({self.reason})"
+        return f"GateVerdict({state}, checks={sorted(self.checks)})"
+
+
+def _loss(pred: np.ndarray, y: np.ndarray) -> float:
+    """Objective-agnostic gate metric: mean squared error of converted
+    predictions against labels (probabilities vs 0/1 labels IS the
+    Brier score; regression outputs score directly)."""
+    p = np.asarray(pred, dtype=np.float64)
+    if p.ndim > 1:  # multiclass [n, K]: score the label-class column
+        idx = np.asarray(y, dtype=np.int64)
+        picked = p[np.arange(len(idx)), np.clip(idx, 0, p.shape[1] - 1)]
+        return float(np.mean((1.0 - picked) ** 2))
+    return float(np.mean((p - np.asarray(y, dtype=np.float64)) ** 2))
+
+
+class ShadowGate:
+    """Scores a candidate booster against the live one; see module doc."""
+
+    def __init__(self, params=None):
+        cfg = params if isinstance(params, Config) \
+            else Config(dict(params or {}))
+        self.tolerance = float(cfg.fleet_gate_tolerance)
+        self.max_shift = float(cfg.fleet_gate_max_shift)
+
+    # ------------------------------------------------------------- checks
+    def _check_prefix(self, live, candidate, checks: Dict) -> str:
+        if candidate.num_model_per_iteration() != \
+                live.num_model_per_iteration():
+            return "num_tree_per_iteration mismatch"
+        n_live = len(live.trees)
+        checks["frozen_trees"] = n_live
+        checks["candidate_trees"] = len(candidate.trees)
+        if len(candidate.trees) <= n_live:
+            return "candidate does not extend the live model"
+        for i in range(n_live):
+            if live.trees[i].to_string(i) != candidate.trees[i].to_string(i):
+                checks["first_divergent_tree"] = i
+                return f"frozen prefix diverges at tree {i}"
+        return ""
+
+    def _check_holdout(self, live, candidate,
+                       holdout: Tuple[np.ndarray, np.ndarray],
+                       checks: Dict) -> str:
+        X, y = holdout
+        if len(X) == 0:
+            return ""
+        live_loss = _loss(live.predict(X), y)
+        cand_loss = _loss(candidate.predict(X), y)
+        checks["holdout_rows"] = int(len(X))
+        checks["live_loss"] = live_loss
+        checks["candidate_loss"] = cand_loss
+        if cand_loss > live_loss * (1.0 + self.tolerance) + 1e-12:
+            return (f"holdout loss regressed: {cand_loss:.6g} vs live "
+                    f"{live_loss:.6g} (tolerance {self.tolerance:g})")
+        return ""
+
+    def _check_traffic(self, live, candidate, traffic: np.ndarray,
+                       checks: Dict) -> str:
+        if traffic is None or len(traffic) == 0 or self.max_shift <= 0:
+            return ""
+        live_p = np.asarray(live.predict(traffic), dtype=np.float64)
+        cand_p = np.asarray(candidate.predict(traffic), dtype=np.float64)
+        scale = float(np.mean(np.abs(live_p))) + 1e-12
+        shift = float(np.mean(np.abs(cand_p - live_p))) / scale
+        checks["traffic_rows"] = int(len(traffic))
+        checks["traffic_shift"] = shift
+        if shift > self.max_shift:
+            return (f"prediction shift {shift:.4g} on sampled traffic "
+                    f"exceeds fleet_gate_max_shift={self.max_shift:g}")
+        return ""
+
+    # ----------------------------------------------------------- evaluate
+    def evaluate(self, live, candidate,
+                 holdout: Optional[Tuple[np.ndarray, np.ndarray]] = None,
+                 traffic: Optional[np.ndarray] = None,
+                 model: str = "default") -> GateVerdict:
+        """Run every check; the first failure is the verdict's reason.
+        Records the gate's own latency (`fleet.gate.latency`) and the
+        verdict counters/event either way."""
+        t0 = time.perf_counter()
+        checks: Dict = {}
+        reason = self._check_prefix(live, candidate, checks)
+        if not reason and holdout is not None:
+            reason = self._check_holdout(live, candidate, holdout, checks)
+        if not reason:
+            reason = self._check_traffic(live, candidate, traffic, checks)
+        dur = time.perf_counter() - t0
+        telemetry.REGISTRY.timing("fleet.gate.latency").observe(dur)
+        verdict = GateVerdict(not reason, reason, checks)
+        telemetry.REGISTRY.counter(
+            "fleet.gate.pass" if verdict.passed else "fleet.gate.fail").inc()
+        telemetry.event("fleet.gate", model=model, passed=verdict.passed,
+                        reason=reason[:200], dur_s=round(dur, 6))
+        return verdict

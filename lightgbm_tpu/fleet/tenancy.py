@@ -1,0 +1,320 @@
+"""Multi-tenant registry layer: SLO classes, admission, autoscaling.
+
+Tens of named models share one `ModelRegistry` (one process, one VRAM
+budget, one micro-batching plane).  This layer adds what the registry
+deliberately does not know about: WHO each model serves and what they
+were promised.
+
+**SLO classes** (`fleet_slo_classes`, best class first — e.g.
+`"gold=10,silver=50,bronze=250"`): each tenant registers under a class
+whose value is its p99 latency budget in ms.  Per-tenant e2e latency is
+observed into the `fleet.tenant.e2e{tenant=...}` histogram (the
+rung-labeled `serve.stage.*` histograms are shared across models, so
+tenancy needs its own label axis).
+
+**Admission control**: under queue pressure (the `serve.queue_depth`
+gauge the micro-batcher maintains, as a fraction of
+`serve_queue_depth`), requests from tenants whose OBSERVED p99 exceeds
+their class budget are shed with `ServingOverloadError` before they
+enter the queue — and worse classes shed at proportionally lower
+pressure, so an over-SLO bronze tenant sheds before an over-SLO gold
+one, and a healthy tenant of any class is never admission-shed (the
+batcher's queue-full shed remains the indiscriminate last resort).
+Sheds count into `fleet.shed.slo`.
+
+**Replica autoscaling** (`ReplicaAutoscaler`): driven by the signals
+the sharded serving plane already exports — the
+`serve.replica.<i>.latency` histograms and the
+`serving.sharded.stripe_imbalance` gauge.  Scale UP when the worst
+replica p99 exceeds the tenant's SLO while stripes are balanced (the
+fleet is capacity-bound, not skew-bound — adding a replica helps);
+scale DOWN when p99 sits far under budget.  A resize is a
+`ModelRegistry.load` with a per-load `shard_devices` override: the
+same build-then-swap hot path, so capacity changes never drop a
+request.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+from .. import telemetry
+from ..serving.batcher import ServingOverloadError
+from ..serving.registry import ModelRegistry, ServingModel
+from ..utils.config import Config
+from ..utils.log import LightGBMError
+
+#: a tenant's p99 is only trusted (for shedding / scaling) once its
+#: histogram holds this many observations — cold tenants are healthy
+MIN_OBSERVATIONS = 16
+
+
+class SLOClass:
+    """One latency class: name, p99 budget (ms), rank (0 = best)."""
+
+    __slots__ = ("name", "p99_ms", "rank")
+
+    def __init__(self, name: str, p99_ms: float, rank: int):
+        self.name = name
+        self.p99_ms = float(p99_ms)
+        self.rank = int(rank)
+
+    def __repr__(self) -> str:
+        return f"SLOClass({self.name}, p99<={self.p99_ms:g}ms)"
+
+
+def parse_slo_classes(spec: str) -> Dict[str, SLOClass]:
+    """Parse `fleet_slo_classes` ("gold=10,silver=50,bronze=250", best
+    class first) into an insertion-ordered name -> SLOClass map."""
+    out: Dict[str, SLOClass] = {}
+    for rank, tok in enumerate(t for t in str(spec).split(",") if t.strip()):
+        if "=" not in tok:
+            raise LightGBMError(
+                f"fleet_slo_classes entry {tok.strip()!r} is not "
+                f"name=p99_ms")
+        name, ms = tok.split("=", 1)
+        name = name.strip()
+        try:
+            budget = float(ms)
+        except ValueError:
+            raise LightGBMError(
+                f"fleet_slo_classes budget {ms.strip()!r} for "
+                f"{name!r} is not a number")
+        if budget <= 0:
+            raise LightGBMError(
+                f"fleet_slo_classes budget for {name!r} must be > 0")
+        out[name] = SLOClass(name, budget, rank)
+    if not out:
+        raise LightGBMError("fleet_slo_classes is empty")
+    return out
+
+
+class Tenant:
+    """One named model + its SLO class and latency history."""
+
+    __slots__ = ("name", "slo", "source", "hist")
+
+    def __init__(self, name: str, slo: SLOClass, source):
+        self.name = name
+        self.slo = slo
+        self.source = source  # model path/Booster given at register time
+        self.hist = telemetry.REGISTRY.histogram("fleet.tenant.e2e",
+                                                 tenant=name)
+
+    def observed_p99_ms(self) -> float:
+        return self.hist.quantile(0.99) * 1000.0
+
+    def over_slo(self) -> bool:
+        return self.hist.count >= MIN_OBSERVATIONS and \
+            self.observed_p99_ms() > self.slo.p99_ms
+
+
+class TenantRegistry:
+    """SLO-aware facade over a `ModelRegistry` (owned or wrapped)."""
+
+    def __init__(self, params: Optional[dict] = None,
+                 registry: Optional[ModelRegistry] = None):
+        self._config = params if isinstance(params, Config) \
+            else Config(dict(params or {}))
+        self.registry = registry if registry is not None \
+            else ModelRegistry(dict(params or {}))
+        self._owns_registry = registry is None
+        self._lock = threading.Lock()
+        self._tenants: Dict[str, Tenant] = {}
+        self.classes = parse_slo_classes(self._config.fleet_slo_classes)
+
+    # ---------------------------------------------------------- lifecycle
+    def register(self, name: str, model: Union[str, object],
+                 slo: Optional[str] = None, *,
+                 warmup: Optional[bool] = None,
+                 shard_devices: Optional[int] = None) -> Tenant:
+        """Load `model` under `name` with an SLO class (default: the
+        LAST — most lenient — configured class)."""
+        if slo is None:
+            slo = next(reversed(self.classes))
+        if slo not in self.classes:
+            raise LightGBMError(
+                f"unknown SLO class {slo!r} "
+                f"(configured: {', '.join(self.classes)})")
+        self.registry.load(name, model, warmup=warmup,
+                           shard_devices=shard_devices)
+        tenant = Tenant(name, self.classes[slo], model)
+        with self._lock:
+            self._tenants[name] = tenant
+            telemetry.REGISTRY.gauge("fleet.tenants").set(
+                len(self._tenants))
+        telemetry.event("fleet.tenant.register", tenant=name, slo=slo)
+        return tenant
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._tenants.pop(name, None)
+            telemetry.REGISTRY.gauge("fleet.tenants").set(
+                len(self._tenants))
+        self.registry.unload(name)
+
+    def tenant(self, name: str) -> Tenant:
+        with self._lock:
+            t = self._tenants.get(name)
+        if t is None:
+            raise LightGBMError(
+                f"no tenant {name!r} "
+                f"(registered: {', '.join(sorted(self._tenants)) or 'none'})")
+        return t
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    # ---------------------------------------------------------- admission
+    def _queue_pressure(self) -> float:
+        depth = telemetry.REGISTRY.gauge("serve.queue_depth").value
+        return float(depth) / max(int(self._config.serve_queue_depth), 1)
+
+    def shed_pressure(self, slo: SLOClass) -> float:
+        """Pressure above which an OVER-SLO tenant of this class sheds.
+        Scales down with class rank: with base B and n classes, the
+        best class sheds at B, the worst at B/n — over-SLO tenants of
+        worse classes always shed first as pressure climbs."""
+        base = float(self._config.fleet_admission_pressure)
+        n = len(self.classes)
+        return base * (n - slo.rank) / n
+
+    def _admit(self, tenant: Tenant) -> None:
+        base = float(self._config.fleet_admission_pressure)
+        if base <= 0:
+            return
+        if not tenant.over_slo():
+            return  # healthy tenants are NEVER admission-shed
+        pressure = self._queue_pressure()
+        if pressure >= self.shed_pressure(tenant.slo):
+            telemetry.REGISTRY.counter("fleet.shed.slo").inc()
+            telemetry.event("fleet.shed", tenant=tenant.name,
+                            pressure=round(pressure, 4),
+                            p99_ms=round(tenant.observed_p99_ms(), 3),
+                            slo_ms=tenant.slo.p99_ms)
+            raise ServingOverloadError(
+                f"tenant {tenant.name!r} shed: over SLO "
+                f"(p99 {tenant.observed_p99_ms():.1f}ms > "
+                f"{tenant.slo.p99_ms:g}ms budget) under queue pressure "
+                f"{pressure:.2f}")
+
+    # ------------------------------------------------------------ serving
+    def predict(self, X, tenant: str = "default", raw_score: bool = False,
+                timeout: Optional[float] = None, trace=None):
+        """Admission-controlled predict; successful requests observe
+        into the tenant's e2e histogram."""
+        t = self.tenant(tenant)
+        self._admit(t)
+        t0 = time.perf_counter()
+        out = self.registry.predict(X, model=tenant, raw_score=raw_score,
+                                    timeout=timeout, trace=trace)
+        t.hist.observe(time.perf_counter() - t0)
+        return out
+
+    def status(self) -> Dict:
+        """Per-tenant health block next to the registry's own status."""
+        base = self.registry.status()
+        with self._lock:
+            tenants = dict(self._tenants)
+        base["tenants"] = {
+            n: {"slo": t.slo.name, "slo_p99_ms": t.slo.p99_ms,
+                "observed_p99_ms": round(t.observed_p99_ms(), 3),
+                "requests": t.hist.count,
+                "over_slo": t.over_slo()}
+            for n, t in sorted(tenants.items())}
+        return base
+
+    def close(self) -> None:
+        with self._lock:
+            self._tenants.clear()
+            telemetry.REGISTRY.gauge("fleet.tenants").set(0)
+        if self._owns_registry:
+            self.registry.close()
+
+
+class ReplicaAutoscaler:
+    """Resizes a tenant's sharded replica set from live latency signals.
+
+    `decide` is pure (reads metrics, returns a target or None);
+    `apply` performs the resize through the registry's build-then-swap
+    load with a `shard_devices` override.  Drive it from any cadence —
+    the fleet CLI polls it alongside the trainer daemon; tests call it
+    directly."""
+
+    def __init__(self, tenants: TenantRegistry, params=None):
+        self.tenants = tenants
+        self._config = params if isinstance(params, Config) \
+            else tenants._config if params is None \
+            else Config(dict(params))
+
+    def current_replicas(self, name: str) -> int:
+        entry: ServingModel = self.tenants.registry.get(name)
+        return int(getattr(entry.runtime, "num_replicas", 1))
+
+    def _replica_p99_s(self, n_replicas: int, tenant: Tenant) -> float:
+        """Worst per-replica p99 (the scaling signal); falls back to
+        the tenant's own e2e histogram while the replica histograms are
+        still empty (single-device runtimes record none)."""
+        worst = 0.0
+        seen = 0
+        for i in range(n_replicas):
+            h = telemetry.REGISTRY.histogram(f"serve.replica.{i}.latency")
+            if h.count:
+                seen += h.count
+                worst = max(worst, h.quantile(0.99))
+        if seen >= MIN_OBSERVATIONS:
+            return worst
+        if tenant.hist.count >= MIN_OBSERVATIONS:
+            return tenant.hist.quantile(0.99)
+        return 0.0
+
+    def decide(self, name: str) -> Optional[int]:
+        """Target replica count, or None to hold."""
+        cfg = self._config
+        if not cfg.fleet_autoscale:
+            return None
+        tenant = self.tenants.tenant(name)
+        cur = self.current_replicas(name)
+        import jax
+        visible = len(jax.devices())
+        max_r = int(cfg.fleet_max_replicas) or visible
+        max_r = min(max_r, visible)
+        min_r = max(int(cfg.fleet_min_replicas), 1)
+        p99_s = self._replica_p99_s(cur, tenant)
+        if p99_s <= 0.0:
+            return None  # no signal yet
+        slo_s = tenant.slo.p99_ms / 1000.0
+        imbalance = telemetry.REGISTRY.gauge(
+            "serving.sharded.stripe_imbalance").value or 1.0
+        if p99_s > slo_s and cur < max_r \
+                and imbalance <= float(cfg.fleet_autoscale_imbalance):
+            # capacity-bound (stripes balanced but slow): add a replica.
+            # A skew-bound fleet (imbalance high) would not be helped —
+            # the scheduler, not capacity, is the bottleneck there.
+            return cur + 1
+        if p99_s < slo_s * 0.25 and cur > min_r:
+            return cur - 1
+        return None
+
+    def apply(self, name: str) -> Optional[int]:
+        """Resize `name` to `decide()`'s target via a hot-swap reload.
+        Returns the new replica count, or None when holding."""
+        target = self.decide(name)
+        if target is None:
+            return None
+        cur = self.current_replicas(name)
+        tenant = self.tenants.tenant(name)
+        entry = self.tenants.registry.get(name)
+        # reload from the LIVE booster (the daemon may have hot-swapped
+        # a newer model since register time), falling back to the
+        # registered source
+        model = getattr(entry.runtime, "booster", None) or tenant.source
+        self.tenants.registry.load(name, model, shard_devices=target)
+        telemetry.REGISTRY.counter(
+            "fleet.autoscale.up" if target > cur
+            else "fleet.autoscale.down").inc()
+        telemetry.event("fleet.autoscale", tenant=name,
+                        replicas=target, previous=cur)
+        return target

@@ -20,8 +20,11 @@ correctness.
 
 Staleness: `status()` reports entries whose booster mutated since
 their last export (`ServingRuntime.stale`) — surfaced in `/healthz`
-and the `serve.stale` gauge; with `serve_auto_refresh` the entry
-re-exports itself on the next predict instead.
+and the `serve.stale` gauge; with `serve_auto_refresh` the first
+predict that notices the staleness kicks a BACKGROUND re-export (the
+stale export keeps serving until the refreshed one swaps in) — the
+request thread never pays the export, so p99 stays flat through a
+refresh (tests/test_fleet.py pins this).
 """
 from __future__ import annotations
 
@@ -32,7 +35,7 @@ from typing import Dict, List, Optional, Union
 from .. import telemetry
 from ..utils.config import Config
 from ..utils.log import LightGBMError
-from .batcher import MicroBatcher
+from .batcher import MicroBatcher, ServingClosedError
 from .runtime import ServingRuntime
 from .sharded import ShardedServingRuntime
 
@@ -47,19 +50,50 @@ class ServingModel:
         self.batcher = batcher
         self.auto_refresh = auto_refresh
         self.last_used = time.monotonic()
+        self._refresh_kick = threading.Lock()
+        self._refresh_thread: Optional[threading.Thread] = None
 
     def predict(self, X, raw_score: bool = False,
                 timeout: Optional[float] = None,
                 trace: Optional[telemetry.RequestTrace] = None):
         self.last_used = time.monotonic()
         if self.auto_refresh and self.runtime.stale():
-            telemetry.REGISTRY.counter("serve.auto_refresh").inc()
-            self.runtime.refresh()
+            # OFF the request thread: a re-export costs device uploads +
+            # a parity probe, which must never land in a request's p99.
+            # The stale export keeps serving (bit-exact for the model
+            # version it captured) until the background refresh() swaps
+            # the new export in atomically under the runtime's
+            # refresh lock.
+            self._kick_refresh()
         return self.batcher.predict(X, raw_score=raw_score,
                                     timeout=timeout, trace=trace)
 
+    def _kick_refresh(self) -> None:
+        """Start (at most) one background refresh; callers never wait."""
+        with self._refresh_kick:
+            t = self._refresh_thread
+            if t is not None and t.is_alive():
+                return
+            telemetry.REGISTRY.counter("serve.auto_refresh").inc()
+            t = threading.Thread(
+                target=self._background_refresh,
+                name=f"lgbm-tpu-refresh-{self.name}", daemon=True)
+            self._refresh_thread = t
+            t.start()
+
+    def _background_refresh(self) -> None:
+        try:
+            self.runtime.refresh()
+        except Exception as e:  # a failed refresh must not kill serving
+            telemetry.REGISTRY.counter("serve.auto_refresh_errors").inc()
+            telemetry.event("serve.auto_refresh_error", model=self.name,
+                            error=str(e)[:200])
+
     def close(self) -> None:
         self.batcher.close()
+        t = self._refresh_thread
+        if t is not None and t.is_alive():
+            t.join(timeout=30.0)
 
 
 class ModelRegistry:
@@ -81,7 +115,15 @@ class ModelRegistry:
     def __init__(self, params: Optional[dict] = None):
         self._config = Config(dict(params or {}))
         self._lock = threading.Lock()
+        # serializes the budget decision (_admit) WITH the swap it
+        # admits: a demotion decided from a pre-swap LRU snapshot could
+        # otherwise demote the entry a concurrent load() just made live
+        self._swap_lock = threading.Lock()
         self._models: Dict[str, ServingModel] = {}
+        # per-model traffic sampler hooks (fleet/shadow.py TrafficSampler
+        # attaches here): called with each request's row block, outside
+        # the serving data path — sampling never touches the bytes served
+        self._samplers: Dict[str, object] = {}
         cfg = self._config
         telemetry.SERVE_RECORDER.configure(
             enabled=cfg.serve_trace, capacity=cfg.serve_trace_ring,
@@ -90,17 +132,26 @@ class ModelRegistry:
 
     # -------------------------------------------------------------- load
     def load(self, name: str, model: Union[str, object], *,
-             warmup: Optional[bool] = None) -> ServingModel:
+             warmup: Optional[bool] = None,
+             shard_devices: Optional[int] = None) -> ServingModel:
         """Register `model` (a Booster or a model-file path) under
         `name`, warmed up, replacing any previous holder atomically.
         Raises `LightGBMError` without touching the registry when the
         export would not fit `serve_vram_budget_mb` even after LRU
-        demotion of the other entries."""
+        demotion of the other entries.
+
+        `shard_devices` overrides the config's `serve_shard_devices`
+        for THIS load only — the fleet replica autoscaler resizes a
+        model by reloading it through this same build-then-swap path,
+        so a resize is just another hot-swap: the old replica set keeps
+        serving until the new one is warm.
+        """
         from ..booster import Booster
         booster = model if isinstance(model, Booster) \
             else Booster(model_file=str(model))
         cfg = self._config
-        shard_devices = int(cfg.serve_shard_devices)
+        if shard_devices is None:
+            shard_devices = int(cfg.serve_shard_devices)
         with telemetry.span("serve.load", model=name):
             if shard_devices != 1:
                 # replicated sharded plane: one pinned runtime per mesh
@@ -113,21 +164,26 @@ class ModelRegistry:
                 runtime = ServingRuntime(
                     booster, max_batch_rows=cfg.serve_max_batch_rows,
                     name=name, device_sum=cfg.serve_device_sum)
-            self._admit(name, runtime)
-            if cfg.serve_warmup if warmup is None else warmup:
-                runtime.warmup()
-            batcher = MicroBatcher(
-                runtime, max_batch_rows=cfg.serve_max_batch_rows,
-                max_wait_ms=cfg.serve_max_wait_ms,
-                queue_depth=cfg.serve_queue_depth,
-                deadline_ms=cfg.serve_deadline_ms)
-            entry = ServingModel(name, runtime, batcher,
-                                 auto_refresh=cfg.serve_auto_refresh)
-        with self._lock:
-            old = self._models.get(name)
-            self._models[name] = entry
-            telemetry.REGISTRY.gauge("serve.models").set(
-                len(self._models))
+            # the swap lock spans admit -> swap: the LRU demotion
+            # decision and the swap it admits are one atomic step, so a
+            # concurrent load can neither demote this entry the instant
+            # it becomes live nor admit against a stale snapshot
+            with self._swap_lock:
+                self._admit(name, runtime)
+                if cfg.serve_warmup if warmup is None else warmup:
+                    runtime.warmup()
+                batcher = MicroBatcher(
+                    runtime, max_batch_rows=cfg.serve_max_batch_rows,
+                    max_wait_ms=cfg.serve_max_wait_ms,
+                    queue_depth=cfg.serve_queue_depth,
+                    deadline_ms=cfg.serve_deadline_ms)
+                entry = ServingModel(name, runtime, batcher,
+                                     auto_refresh=cfg.serve_auto_refresh)
+                with self._lock:
+                    old = self._models.get(name)
+                    self._models[name] = entry
+                    telemetry.REGISTRY.gauge("serve.models").set(
+                        len(self._models))
         telemetry.REGISTRY.counter("serve.model_loads").inc()
         self._update_vram_gauge()
         if old is not None:
@@ -137,8 +193,8 @@ class ModelRegistry:
     def _admit(self, name: str, runtime: ServingRuntime) -> None:
         """Budget gate for a new export: demote LRU entries until the
         newcomer fits, else reject it — loaded models keep serving
-        either way.  (Concurrent loads race the check benignly: the
-        budget bounds steady state, not the swap instant.)"""
+        either way.  Caller holds `_swap_lock`, so the decision is
+        taken against the registry state the admitted swap will join."""
         budget = int(self._config.serve_vram_budget_mb * (1 << 20))
         if budget <= 0:
             return
@@ -220,11 +276,44 @@ class ModelRegistry:
             out["latency_ms"] = lat
         return out
 
+    # --------------------------------------------------- traffic sampling
+    def attach_sampler(self, name: str, sampler) -> None:
+        """Attach a per-model traffic sampler (any callable taking the
+        request's row block).  The fleet shadow gate samples live
+        traffic this way; sampling happens before dispatch on a COPY-
+        free read of X, and a sampler exception never fails a request."""
+        with self._lock:
+            self._samplers[name] = sampler
+
+    def detach_sampler(self, name: str) -> None:
+        with self._lock:
+            self._samplers.pop(name, None)
+
     def predict(self, X, model: str = "default", raw_score: bool = False,
                 timeout: Optional[float] = None,
                 trace: Optional[telemetry.RequestTrace] = None):
-        return self.get(model).predict(X, raw_score=raw_score,
-                                       timeout=timeout, trace=trace)
+        sampler = self._samplers.get(model)
+        if sampler is not None:
+            try:
+                sampler(X)
+            except Exception:  # sampling is best-effort observability
+                telemetry.REGISTRY.counter("fleet.sampler_errors").inc()
+        while True:
+            entry = self.get(model)
+            try:
+                return entry.predict(X, raw_score=raw_score,
+                                     timeout=timeout, trace=trace)
+            except ServingClosedError:
+                # a hot-swap closed this entry's batcher between the
+                # name lookup and the dispatch — the successor entry is
+                # already live, so the swap stays invisible to callers.
+                # Re-raise when the name is gone or unchanged (a real
+                # close, not a swap); each retry requires another swap,
+                # so the loop terminates.
+                with self._lock:
+                    cur = self._models.get(model)
+                if cur is None or cur is entry:
+                    raise
 
     # ------------------------------------------------------------- close
     def close(self) -> None:

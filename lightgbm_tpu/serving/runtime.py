@@ -161,6 +161,12 @@ class ServingRuntime:
             self._booster, "_model_version", 0)
 
     @property
+    def booster(self):
+        """The served booster — the fleet autoscaler reloads from the
+        LIVE model when resizing a replica set (fleet/tenancy.py)."""
+        return self._booster
+
+    @property
     def device_sum_active(self) -> bool:
         """Is the device-sum rung serving (probe passed, not off)?"""
         return self._device_sum_ok

@@ -128,6 +128,11 @@ class ShardedServingRuntime:
     def device_sum_active(self) -> bool:
         return self._replicas[0].device_sum_active
 
+    @property
+    def booster(self):
+        """The served booster (same accessor as `ServingRuntime`)."""
+        return self._booster
+
     def num_feature(self) -> int:
         return self._replicas[0].num_feature()
 

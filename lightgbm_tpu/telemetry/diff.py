@@ -82,6 +82,24 @@ RULES: List[Tuple[str, str, str]] = [
     # the span rules below)
     ("*pipeline.depth", "ignore", "counter"),
     ("gauges.train.pipeline.device_idle_s", "up_is_bad", "timing"),
+    # continuous-training fleet (ISSUE 11): a growing rejected-swap
+    # count means candidates stopped clearing the shadow gate (drifted
+    # holdout metric, diverging frozen prefix) — fail hard.  Gate
+    # latency is wall-clock on the scoring path (timing class); the
+    # tenant-count gauge is deployment identity, and the row/retrain/
+    # sample counters are workload bookkeeping.  SLO sheds and the
+    # error counters (sampler hook, daemon poll, background refresh)
+    # fail hard on growth like their serve.* cousins.
+    ("*fleet.swap.rejected", "up_is_bad", "counter"),
+    ("*fleet.gate.latency*", "up_is_bad", "timing"),
+    ("*fleet.gate.fail", "up_is_bad", "counter"),
+    ("gauges.fleet.tenants", "ignore", "counter"),
+    ("*fleet.shed.slo", "up_is_bad", "counter"),
+    ("*fleet.sampler_errors", "up_is_bad", "counter"),
+    ("*fleet.poll_errors", "up_is_bad", "counter"),
+    ("*serve.auto_refresh_errors", "up_is_bad", "counter"),
+    ("*fleet.tenant.*", "ignore", "counter"),
+    ("*fleet.*", "ignore", "counter"),
     # serving: the bench `serving` block's latency percentiles /
     # throughput are wall-clock (timing class, CPU-fallback noise
     # warns); shed growth means overload handling regressed and fails

@@ -287,6 +287,46 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # the replicas with a least-outstanding-work scheduler.  0 = all
     # visible devices, 1 = the single-device runtime (default)
     "serve_shard_devices": (1, "int", ("shard_devices",)),
+    # ---- continuous-training fleet (lightgbm_tpu/fleet/) ----
+    # trainer daemon (fleet/daemon.py): continue the live booster via
+    # init_model once this many NEW rows have landed in the tailed
+    # append-only datastore
+    "fleet_retrain_rows": (1024, "int", ()),
+    # boosting rounds added per continuation
+    "fleet_rounds": (10, "int", ()),
+    # daemon manifest-poll interval (milliseconds)
+    "fleet_poll_ms": (200.0, "float", ()),
+    # hard cap on retrains before the daemon loop exits (CI smokes /
+    # bounded canaries); 0 = run until stopped
+    "fleet_max_retrains": (0, "int", ()),
+    # shadow gate (fleet/shadow.py): candidate holdout loss may exceed
+    # the live model's by at most this relative fraction
+    "fleet_gate_tolerance": (0.05, "float", ()),
+    # shadow gate: relative mean-|delta| prediction shift allowed on
+    # sampled live traffic (0 disables the traffic-shift check)
+    "fleet_gate_max_shift": (0.5, "float", ()),
+    # holdout tail rows (newest datastore rows) scored by the metric gate
+    "fleet_shadow_rows": (512, "int", ()),
+    # live-traffic reservoir capacity (rows) the registry sampler keeps
+    # for the gate's traffic-shift check
+    "fleet_sample_ring": (256, "int", ()),
+    # multi-tenant SLO classes (fleet/tenancy.py), best class first:
+    # "name=p99_ms,..." — a tenant's observed p99 above its class budget
+    # marks it over-SLO for admission control
+    "fleet_slo_classes": ("gold=10,silver=50,bronze=250", "str", ()),
+    # admission control: queue-pressure fraction (serve.queue_depth /
+    # serve_queue_depth) above which over-SLO tenants are shed; worse
+    # classes shed at proportionally lower pressure.  0 disables
+    "fleet_admission_pressure": (0.5, "float", ()),
+    # replica autoscaling for sharded serving, driven by the
+    # serve.replica.*.latency histograms + stripe-imbalance gauge
+    "fleet_autoscale": (False, "bool", ()),
+    "fleet_min_replicas": (1, "int", ()),
+    # 0 = up to all visible devices
+    "fleet_max_replicas": (0, "int", ()),
+    # scale-up only while stripes stay balanced (capacity-bound, not
+    # skew-bound): max/mean cumulative stripe ratio allowed
+    "fleet_autoscale_imbalance": (1.5, "float", ()),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

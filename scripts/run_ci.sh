@@ -301,6 +301,102 @@ print(f"[run_ci] mesh smoke: sharded /predict byte-identical over "
       f"{used} striped replicas")
 EOF
 
+# fleet smoke (ISSUE 11): the continuous-training loop end to end on a
+# golden model — trainer daemon tailing an append-only store behind the
+# HTTP frontend, rows appended, exactly one shadow-gated hot-swap, and
+# a concurrent /predict loop that must see zero errors with every
+# response byte-identical to whichever model version was live at its
+# dispatch.  The full matrix (rejection, tenancy, autoscaling, the
+# swap/demote hammer) lives in tests/test_fleet.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.datastore.store import ShardStore
+from lightgbm_tpu.fleet import TrainerDaemon, create_fleet_store
+from lightgbm_tpu.serving import ServingClient
+from lightgbm_tpu.serving.http import make_server
+from lightgbm_tpu import telemetry
+
+bst = Booster(model_file="tests/data/golden_binary.model.txt")
+X, y = make_case_data(GOLDEN_CASES["binary"])
+store_dir = "/tmp/ci_fleet_store"
+import shutil
+shutil.rmtree(store_dir, ignore_errors=True)
+create_fleet_store(store_dir, X, y, shard_rows=256)
+
+client = ServingClient(bst, params={"serve_warmup": False,
+                                    "serve_max_wait_ms": 0.0})
+daemon = TrainerDaemon(
+    store_dir, client.registry, bst,
+    train_params={"objective": "binary", "num_leaves": 15,
+                  "verbosity": -1},
+    params={"fleet_retrain_rows": 128, "fleet_rounds": 3,
+            "fleet_shadow_rows": 256})
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{port}"
+Xq = np.ascontiguousarray(X[:32])
+body = json.dumps({"rows": Xq.tolist()}).encode()
+
+responses, errors, stop = [], [], threading.Event()
+
+
+def hammer():
+    while not stop.is_set():
+        try:
+            req = urllib.request.Request(
+                f"{base}/predict", data=body,
+                headers={"Content-Type": "application/json"})
+            resp = json.loads(urllib.request.urlopen(req, timeout=60).read())
+            responses.append(
+                np.asarray(resp["predictions"], np.float64).tobytes())
+        except Exception as e:  # noqa: BLE001 — asserted empty below
+            errors.append(e)
+
+
+t = threading.Thread(target=hammer, daemon=True)
+t.start()
+time.sleep(0.3)                                   # traffic pre-swap
+half = len(X) // 2
+ShardStore.open(store_dir).append_rows(
+    X[:half], label=y[:half].astype(np.float32))  # new generation
+assert daemon.step(), "daemon did not retrain on the appended rows"
+time.sleep(0.3)                                   # traffic post-swap
+stop.set()
+t.join(timeout=60)
+srv.shutdown()
+srv.server_close()
+
+assert daemon.swaps == 1 and daemon.rejects == 0, \
+    (daemon.swaps, daemon.rejects)
+live = daemon.live_booster
+assert live is not bst and len(live.trees) > len(bst.trees)
+assert all(bst.trees[i].to_string(i) == live.trees[i].to_string(i)
+           for i in range(len(bst.trees))), "frozen prefix diverged"
+assert not errors, errors[:3]
+# JSON carries float64; predict may emit float32 — widen (exact) to compare
+allowed = {np.asarray(bst.predict(Xq), np.float64).tobytes(),
+           np.asarray(live.predict(Xq), np.float64).tobytes()}
+assert responses and set(responses) <= allowed, \
+    "a /predict response matched NEITHER live model version"
+assert telemetry.REGISTRY.counter("fleet.gate.pass").value >= 1
+daemon.stop()
+client.close()
+shutil.rmtree(store_dir, ignore_errors=True)
+print(f"[run_ci] fleet smoke: 1 gated hot-swap, {len(responses)} "
+      "concurrent /predict responses all byte-consistent, 0 errors")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

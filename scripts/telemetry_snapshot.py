@@ -81,6 +81,42 @@ def build_snapshot(rounds: int, rel_tol: float,
     client.predict(np.ascontiguousarray(Xe, dtype=np.float64),
                    raw_score=True)
     client.close()
+    # fleet segment: one append → retrain → gated hot-swap plus a tenant
+    # predict, so the baseline carries the fleet.* names the PR-11
+    # sentinel rules watch (swap.rejected / gate.fail / shed.slo stay
+    # absent — the up_is_bad rules fire only if a later snapshot grows
+    # them).  Everything is pinned: fixed rows, fixed rounds, step() is
+    # synchronous; fleet timings are timing/ignore-class in diff.RULES
+    import shutil
+    import tempfile
+    from lightgbm_tpu.fleet import TrainerDaemon, TenantRegistry, \
+        create_fleet_store
+    fdir = tempfile.mkdtemp(prefix="fleet_snap_")
+    try:
+        Xf = np.asarray(X[:384], np.float64)
+        yf = np.asarray(y[:384], np.float32)
+        fbst = lgb.train({"objective": "binary", "num_leaves": 7,
+                          "verbosity": -1},
+                         lgb.Dataset(Xf, label=yf), num_boost_round=3)
+        create_fleet_store(fdir, Xf, yf, shard_rows=256)
+        fclient = ServingClient(fbst, params={"serve_max_wait_ms": 0.0,
+                                              "serve_warmup": False})
+        daemon = TrainerDaemon(
+            fdir, fclient.registry, fbst,
+            train_params={"objective": "binary", "num_leaves": 7,
+                          "verbosity": -1},
+            params={"fleet_retrain_rows": 128, "fleet_rounds": 2,
+                    "fleet_shadow_rows": 128})
+        from lightgbm_tpu.datastore.store import ShardStore
+        ShardStore.open(fdir).append_rows(Xf[:192], label=yf[:192])
+        daemon.step()
+        tenants = TenantRegistry(registry=fclient.registry)
+        tenants.register("snapshot", fbst, warmup=False)
+        tenants.predict(np.ascontiguousarray(Xf[:16]), tenant="snapshot")
+        daemon.stop()
+        fclient.close()
+    finally:
+        shutil.rmtree(fdir, ignore_errors=True)
     return {
         "backend": jax.devices()[0].platform,
         "sentinel": {"rel_tol": float(bst.config.telemetry_diff_rel_tol),

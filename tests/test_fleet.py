@@ -1,0 +1,688 @@
+"""Continuous-training fleet: daemon, shadow gate, tenancy (ISSUE 11).
+
+The load-bearing claims:
+
+* APPEND — `ShardStore.append_rows` grows a finalized store in place:
+  new tail shards only, atomic manifest rewrite with a `generation`
+  bump, tamper rules intact on the appended bytes.
+* CONTINUATION — a booster continued via `init_model` over the
+  externally-grown store carries the live model's trees byte-for-byte
+  (`Tree.to_string` parity on the frozen prefix).
+* GATE — the shadow gate rejects a candidate whose frozen prefix
+  diverges (corrupted leaf plane), whose holdout loss regressed, or
+  whose predictions shifted on sampled traffic — and a rejection leaves
+  the live model serving, untouched.
+* SEAMLESS SWAP — while the daemon retrains + hot-swaps, a concurrent
+  predict loop sees zero errors, zero swap-attributable sheds, and
+  every response byte-identical to whichever model version was live at
+  dispatch.
+* OFF-THREAD REFRESH — `serve_auto_refresh` re-exports in the
+  background; the request that notices staleness never pays the export.
+* SWAP vs DEMOTE — concurrent budgeted loads + predicts never demote a
+  just-swapped entry into a spurious failure.
+* TENANCY — SLO classes parse/rank, over-SLO tenants shed before
+  healthy ones (which never admission-shed), and the autoscaler scales
+  on replica-latency signals only when stripes are balanced.
+"""
+import glob
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.datastore.store import ShardStore
+from lightgbm_tpu.engine import train as engine_train
+from lightgbm_tpu.fleet import (GateVerdict, ReplicaAutoscaler, ShadowGate,
+                                TenantRegistry, TrafficSampler,
+                                TrainerDaemon, create_fleet_store,
+                                parse_slo_classes)
+from lightgbm_tpu.serving import ModelRegistry, ServingOverloadError
+from lightgbm_tpu.utils.log import LightGBMError
+
+#: tiny-but-learnable data: keeps every training in this file ~a second
+N0, NF = 384, 5
+TRAIN_PARAMS = {"objective": "binary", "num_leaves": 6,
+                "min_data_in_leaf": 8, "learning_rate": 0.2,
+                "verbosity": -1}
+#: registry knobs for the tests: immediate dispatch, no warmup compiles
+SERVE_PARAMS = {"serve_max_wait_ms": 0.0, "serve_warmup": False}
+
+
+def _data(n=N0, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, NF)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n) > 0) \
+        .astype(np.float64)
+    return np.ascontiguousarray(X), y
+
+
+def _train(X, y, rounds=4, init_model=None, **over):
+    params = dict(TRAIN_PARAMS, **over)
+    return engine_train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=rounds, init_model=init_model)
+
+
+def _prefix_identical(live, candidate):
+    return all(live.trees[i].to_string(i) == candidate.trees[i].to_string(i)
+               for i in range(len(live.trees)))
+
+
+# ------------------------------------------------------------ append_rows
+class TestAppendRows:
+    def test_append_bumps_generation_and_roundtrips(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "store")
+        store = create_fleet_store(d, X, y, shard_rows=128)
+        assert store.generation == 0 and store.n_rows == N0
+        X2, y2 = _data(100, seed=1)
+        gen = store.append_rows(X2, label=y2.astype(np.float32))
+        assert gen == 1
+        assert store.generation == 1 and store.n_rows == N0 + 100
+        # the grown store re-opens to the SAME generation and bytes
+        again = ShardStore.open(d)
+        assert again.generation == 1
+        got = again.read_all_rows("bins")
+        np.testing.assert_array_equal(got[:N0], X)
+        np.testing.assert_array_equal(got[N0:], X2)
+        lab = again.load_vector("label")
+        np.testing.assert_array_equal(lab[N0:], y2.astype(np.float32))
+        # a second append bumps again
+        store.append_rows(X2[:16], label=y2[:16].astype(np.float32))
+        assert ShardStore.open(d).generation == 2
+
+    def test_append_rejects_bad_width(self, tmp_path):
+        X, y = _data(64)
+        store = create_fleet_store(str(tmp_path / "s"), X, y)
+        with pytest.raises(LightGBMError):
+            store.append_rows(np.zeros((4, NF + 1)),
+                              label=np.zeros(4, dtype=np.float32))
+
+    def test_appended_shard_tamper_detected(self, tmp_path):
+        X, y = _data(64)
+        d = str(tmp_path / "s")
+        store = create_fleet_store(d, X, y, shard_rows=64)
+        X2, y2 = _data(64, seed=2)
+        store.append_rows(X2, label=y2.astype(np.float32))
+        newest = max(glob.glob(str(tmp_path / "s" / "*bins*")))
+        blob = bytearray(open(newest, "rb").read())
+        blob[len(blob) // 2] ^= 0xFF
+        open(newest, "wb").write(bytes(blob))
+        with pytest.raises(LightGBMError, match="checksum"):
+            ShardStore.open(d).read_all_rows("bins")
+
+
+# ------------------------------------------- init_model over a grown store
+class TestContinuation:
+    @pytest.mark.parametrize("external_memory", [False, True])
+    def test_frozen_prefix_byte_identical(self, tmp_path, external_memory):
+        X, y = _data()
+        d = str(tmp_path / "store")
+        store = create_fleet_store(d, X, y, shard_rows=128)
+        base = _train(X, y, rounds=4)
+        X2, y2 = _data(160, seed=3)
+        store.append_rows(X2, label=y2.astype(np.float32))
+        grown = ShardStore.open(d)
+        GX = grown.read_all_rows("bins")
+        Gy = grown.load_vector("label")
+        assert len(GX) == N0 + 160
+        cont = _train(GX, Gy, rounds=3, init_model=base,
+                      external_memory=external_memory)
+        assert cont.current_iteration() == 7
+        assert _prefix_identical(base, cont)
+        # and the continuation genuinely extends, not clones
+        assert len(cont.trees) > len(base.trees)
+
+    def test_continuation_does_not_mutate_init_model(self):
+        # the init model may still be serving while the continuation
+        # trains: _continue_from must not rewrite its threshold_bin
+        # planes in place against the grown dataset's mappers
+        X, y = _data()
+        base = _train(X, y, rounds=4)
+        before = [np.array(t.threshold_bin, copy=True) for t in base.trees]
+        X2, y2 = _data(160, seed=3)
+        GX = np.vstack([X, X2])
+        Gy = np.concatenate([y, y2])
+        cont = _train(GX, Gy, rounds=3, init_model=base)
+        for t, tb in zip(base.trees, before):
+            np.testing.assert_array_equal(t.threshold_bin, tb)
+        # the frozen copies still answer byte-identically
+        assert _prefix_identical(base, cont)
+
+    def test_continuation_rejects_narrower_dataset(self):
+        X, y = _data()
+        base = _train(X, y, rounds=4)
+        with pytest.raises(LightGBMError, match="features"):
+            _train(X[:, :1], y, rounds=2, init_model=base)
+
+
+# ------------------------------------------------------------ the sampler
+class TestTrafficSampler:
+    def test_ring_wraps_and_snapshots(self):
+        s = TrafficSampler(capacity=8)
+        assert s.sample() is None
+        for i in range(3):
+            s(np.full((4, 2), float(i)))
+        assert len(s) == 8 and s.seen == 12
+        snap = s.sample()
+        assert snap.shape == (8, 2)
+        # oldest rows were overwritten round-robin: block 0 is gone
+        assert snap.min() >= 1.0
+
+    def test_mixed_width_blocks_skipped(self):
+        s = TrafficSampler(capacity=8)
+        s(np.zeros((2, 3)))
+        s(np.zeros((2, 5)))   # another model's traffic: ignored
+        assert len(s) == 2 and s.sample().shape == (2, 3)
+
+    def test_rows_are_copies(self):
+        s = TrafficSampler(capacity=4)
+        X = np.ones((2, 2))
+        s(X)
+        X[:] = 7.0
+        assert s.sample().max() == 1.0
+
+
+# ---------------------------------------------------------- shadow gating
+class _StubModel:
+    """predict() returns canned values — for the metric checks, which
+    never look at trees."""
+
+    def __init__(self, pred):
+        self._pred = np.asarray(pred, dtype=np.float64)
+
+    def predict(self, X, **kw):
+        return self._pred[:len(X)]
+
+
+class TestShadowGate:
+    def test_accepts_real_continuation(self):
+        X, y = _data()
+        base = _train(X, y, rounds=3)
+        cont = _train(X, y, rounds=2, init_model=base)
+        v = ShadowGate({}).evaluate(base, cont, holdout=(X[-64:], y[-64:]),
+                                    traffic=X[:32])
+        assert v.passed and v.reason == ""
+        assert v.checks["frozen_trees"] == 3
+        assert v.checks["traffic_rows"] == 32
+
+    def test_rejects_non_extension(self):
+        X, y = _data()
+        base = _train(X, y, rounds=3)
+        v = ShadowGate({}).evaluate(base, base)
+        assert not v and "does not extend" in v.reason
+
+    def test_rejects_corrupted_leaf_plane(self):
+        X, y = _data()
+        base = _train(X, y, rounds=3)
+        cont = _train(X, y, rounds=2, init_model=base)
+        # doctor a FROZEN tree's leaf plane — the classic bad-copy bug a
+        # swap must never let through.  Deep-copy first: the continuation
+        # shares the frozen tree OBJECTS with the live model, and the
+        # corruption being gated is a diverged copy, not a shared mutation
+        import copy
+        cont.trees[1] = copy.deepcopy(cont.trees[1])
+        cont.trees[1].leaf_value = cont.trees[1].leaf_value + 0.125
+        v = ShadowGate({}).evaluate(base, cont)
+        assert not v and v.reason == "frozen prefix diverges at tree 1"
+        assert v.checks["first_divergent_tree"] == 1
+
+    def test_holdout_regression_rejects(self):
+        y = np.array([0.0, 1.0, 0.0, 1.0])
+        gate = ShadowGate({"fleet_gate_tolerance": 0.1})
+        live = _StubModel([0.1, 0.9, 0.1, 0.9])      # loss 0.01
+        worse = _StubModel([0.5, 0.5, 0.5, 0.5])     # loss 0.25
+        checks = {}
+        msg = gate._check_holdout(live, worse, (np.zeros((4, 2)), y), checks)
+        assert "holdout loss regressed" in msg
+        assert checks["candidate_loss"] > checks["live_loss"]
+        # within tolerance passes (0.11 preds -> loss 0.0121, 21% over
+        # the live 0.01 — inside a 30% tolerance, outside the 10% above)
+        near = _StubModel([0.11, 0.89, 0.11, 0.89])
+        lax = ShadowGate({"fleet_gate_tolerance": 0.3})
+        assert lax._check_holdout(live, near,
+                                  (np.zeros((4, 2)), y), {}) == ""
+
+    def test_traffic_shift_rejects(self):
+        gate = ShadowGate({"fleet_gate_max_shift": 0.2})
+        live = _StubModel([1.0, 1.0, 1.0, 1.0])
+        drifted = _StubModel([2.0, 2.0, 2.0, 2.0])   # 100% mean shift
+        checks = {}
+        msg = gate._check_traffic(live, drifted, np.zeros((4, 2)), checks)
+        assert "exceeds fleet_gate_max_shift" in msg
+        assert checks["traffic_shift"] == pytest.approx(1.0, rel=1e-6)
+        # empty traffic / disabled shift gate: check is skipped
+        assert gate._check_traffic(live, drifted, None, {}) == ""
+        assert ShadowGate({"fleet_gate_max_shift": 0})._check_traffic(
+            live, drifted, np.zeros((4, 2)), {}) == ""
+
+    def test_verdict_telemetry(self):
+        X, y = _data(128)
+        base = _train(X, y, rounds=2)
+        fails = telemetry.REGISTRY.counter("fleet.gate.fail")
+        before = fails.value
+        assert not ShadowGate({}).evaluate(base, base)
+        assert fails.value == before + 1
+        assert isinstance(GateVerdict(True), GateVerdict)
+
+
+# ----------------------------------------- the daemon: tail, gate, swap
+class TestTrainerDaemon:
+    def test_end_to_end_swap_under_concurrent_load(self, tmp_path):
+        X, y = _data()
+        d = str(tmp_path / "store")
+        create_fleet_store(d, X, y, shard_rows=128)
+        base = _train(X, y, rounds=4)
+        registry = ModelRegistry(dict(SERVE_PARAMS))
+        registry.load("default", base)
+        daemon = TrainerDaemon(
+            d, registry, base, train_params=dict(TRAIN_PARAMS),
+            params={"fleet_retrain_rows": 64, "fleet_rounds": 3,
+                    "fleet_shadow_rows": 128})
+        Xq = np.ascontiguousarray(X[:16])
+        shed0 = telemetry.REGISTRY.counter("serve.shed").value \
+            + telemetry.REGISTRY.counter("fleet.shed.slo").value
+        # no new rows -> no retrain
+        assert daemon.step() is False and daemon.retrains == 0
+
+        responses, errors, stop = [], [], threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    responses.append(
+                        registry.predict(Xq, model="default").tobytes())
+                except Exception as e:   # noqa: BLE001 — the assertion
+                    errors.append(e)
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        try:
+            time.sleep(0.2)              # traffic before the swap
+            X2, y2 = _data(128, seed=5)
+            ShardStore.open(d).append_rows(X2,
+                                           label=y2.astype(np.float32))
+            assert daemon.step() is True
+            time.sleep(0.2)              # traffic after the swap
+        finally:
+            stop.set()
+            t.join(timeout=30)
+        assert daemon.retrains == 1 and daemon.swaps == 1 \
+            and daemon.rejects == 0
+        live = daemon.live_booster
+        assert live is not base and live.current_iteration() == 7
+        assert _prefix_identical(base, live)
+        # the registry serves the NEW model now
+        assert registry.get("default").runtime.booster is live
+        # zero errors, zero swap-attributable sheds, and every response
+        # byte-identical to whichever model version was live at dispatch
+        assert errors == []
+        shed1 = telemetry.REGISTRY.counter("serve.shed").value \
+            + telemetry.REGISTRY.counter("fleet.shed.slo").value
+        assert shed1 == shed0
+        allowed = {base.predict(Xq).tobytes(), live.predict(Xq).tobytes()}
+        assert len(allowed) == 2          # the swap visibly changed bytes
+        assert responses and set(responses) <= allowed
+        assert set(responses) == allowed  # both versions actually served
+        daemon.stop()
+        registry.close()
+
+    def test_rejected_candidate_leaves_live_model_serving(self, tmp_path,
+                                                          monkeypatch):
+        X, y = _data()
+        d = str(tmp_path / "store")
+        create_fleet_store(d, X, y, shard_rows=128)
+        base = _train(X, y, rounds=4)
+        registry = ModelRegistry(dict(SERVE_PARAMS))
+        registry.load("default", base)
+        daemon = TrainerDaemon(
+            d, registry, base, train_params=dict(TRAIN_PARAMS),
+            params={"fleet_retrain_rows": 64, "fleet_rounds": 2})
+
+        import lightgbm_tpu.fleet.daemon as fleet_daemon
+        real_train = fleet_daemon.engine_train
+
+        def doctored_train(*a, **kw):
+            cand = real_train(*a, **kw)
+            # corrupt a frozen tree's leaf plane: the continuation bug
+            # the gate exists to catch (deep copy — the frozen trees are
+            # shared with the live model, which must stay pristine)
+            import copy
+            cand.trees[0] = copy.deepcopy(cand.trees[0])
+            cand.trees[0].leaf_value = cand.trees[0].leaf_value * 1.5
+            return cand
+
+        monkeypatch.setattr(fleet_daemon, "engine_train", doctored_train)
+        rejected = telemetry.REGISTRY.counter("fleet.swap.rejected")
+        before = rejected.value
+        want = registry.predict(np.ascontiguousarray(X[:8])).tobytes()
+        X2, y2 = _data(96, seed=7)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        assert daemon.step() is True
+        assert daemon.rejects == 1 and daemon.swaps == 0
+        assert rejected.value == before + 1
+        # live model untouched: same object registered, same bytes out
+        assert daemon.live_booster is base
+        assert registry.get("default").runtime.booster is base
+        assert registry.predict(
+            np.ascontiguousarray(X[:8])).tobytes() == want
+        # the tail mark advanced: the rejected window is not re-spun
+        assert daemon.step() is False
+        daemon.stop()
+        registry.close()
+
+    def test_max_retrains_bounds_run(self, tmp_path):
+        X, y = _data(128)
+        d = str(tmp_path / "store")
+        create_fleet_store(d, X, y, shard_rows=128)
+        base = _train(X, y, rounds=2)
+        daemon = TrainerDaemon(
+            d, None, base, train_params=dict(TRAIN_PARAMS),
+            params={"fleet_retrain_rows": 32, "fleet_rounds": 1,
+                    "fleet_max_retrains": 1, "fleet_poll_ms": 5})
+        X2, y2 = _data(64, seed=9)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        daemon.start()
+        daemon.join(timeout=60)
+        assert daemon.retrains == 1   # run() exited on its own
+        daemon.stop()
+
+
+# --------------------------------------- background auto-refresh (sat. 1)
+class TestAutoRefreshOffThread:
+    def test_request_thread_never_pays_the_export(self, monkeypatch):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        registry = ModelRegistry(dict(SERVE_PARAMS,
+                                      serve_auto_refresh=True))
+        registry.load("default", bst)
+        entry = registry.get("default")
+        Xq = np.ascontiguousarray(X[:8])
+        registry.predict(Xq)          # pay the jit compile up front
+        real_refresh = entry.runtime.refresh
+
+        def slow_refresh():
+            time.sleep(1.0)           # an export that would wreck p99
+            real_refresh()
+
+        monkeypatch.setattr(entry.runtime, "refresh", slow_refresh)
+        kicks = telemetry.REGISTRY.counter("serve.auto_refresh")
+        before = kicks.value
+        bst._bump_model_version()     # model mutated since export
+        assert entry.runtime.stale()
+        t0 = time.perf_counter()
+        registry.predict(Xq)
+        dt = time.perf_counter() - t0
+        # the request returned long before the 1s refresh could finish:
+        # the export ran OFF the request thread
+        assert dt < 0.5, f"predict took {dt:.3f}s — refresh on thread?"
+        assert kicks.value == before + 1
+        entry._refresh_thread.join(timeout=30)
+        assert not entry.runtime.stale()
+        registry.close()
+
+    def test_refresh_failure_counts_not_raises(self, monkeypatch):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        registry = ModelRegistry(dict(SERVE_PARAMS,
+                                      serve_auto_refresh=True))
+        registry.load("default", bst)
+        entry = registry.get("default")
+        Xq = np.ascontiguousarray(X[:8])
+        registry.predict(Xq)
+        monkeypatch.setattr(
+            entry.runtime, "refresh",
+            lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+        errs = telemetry.REGISTRY.counter("serve.auto_refresh_errors")
+        before = errs.value
+        bst._bump_model_version()
+        out = registry.predict(Xq)    # still serves the stale export
+        assert out.shape == (8,)
+        entry._refresh_thread.join(timeout=30)
+        assert errs.value == before + 1
+        registry.close()
+
+
+# --------------------------------------- swap vs demote hammer (sat. 2)
+class TestSwapDemoteRace:
+    def test_concurrent_loads_and_predicts_under_tight_budget(self):
+        X, y = _data(192)
+        # three DISTINCT models (trained on different data) so byte
+        # divergence between entries is detectable
+        boosters = [_train(*_data(192, seed=i), rounds=2)
+                    for i in range(3)]
+        probe = ModelRegistry(dict(SERVE_PARAMS))
+        probe.load("probe", boosters[0])
+        one = probe.get("probe").runtime.device_bytes()
+        probe.close()
+        # budget fits ~2 entries: every third load demotes the LRU —
+        # the demote/swap contention the swap lock serializes
+        budget_mb = max((2 * one + one // 2) / (1 << 20), 0.001)
+        registry = ModelRegistry(dict(SERVE_PARAMS,
+                                      serve_vram_budget_mb=budget_mb))
+        names = [f"m{i}" for i in range(3)]
+        for n, b in zip(names, boosters):
+            registry.load(n, b)
+        Xq = np.ascontiguousarray(X[:8])
+        want = {n: b.predict(Xq).tobytes()
+                for n, b in zip(names, boosters)}
+        errors = []
+
+        def churn(i):
+            n, b = names[i], boosters[i]
+            try:
+                for _ in range(4):
+                    registry.load(n, b)          # swap (admit + demote)
+                    got = registry.predict(Xq, model=n)
+                    if got.tobytes() != want[n]:
+                        raise AssertionError(f"{n}: bytes diverged")
+            except Exception as e:  # noqa: BLE001 — the assertion
+                errors.append(e)
+
+        threads = [threading.Thread(target=churn, args=(i,), daemon=True)
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert errors == []
+        # every entry still serves, byte-correct (demoted or not)
+        for n in names:
+            assert registry.predict(Xq, model=n).tobytes() == want[n]
+        registry.close()
+
+
+# ----------------------------------------------------- multi-tenant layer
+class TestTenancy:
+    def test_parse_slo_classes(self):
+        classes = parse_slo_classes("gold=10,silver=50,bronze=250")
+        assert list(classes) == ["gold", "silver", "bronze"]
+        assert classes["gold"].rank == 0 and classes["bronze"].rank == 2
+        assert classes["silver"].p99_ms == 50.0
+        for bad in ("", "gold", "gold=abc", "gold=-5", "gold=0"):
+            with pytest.raises(LightGBMError):
+                parse_slo_classes(bad)
+
+    def test_register_default_is_most_lenient(self):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = TenantRegistry(dict(SERVE_PARAMS))
+        try:
+            t = tr.register("svc", bst)
+            assert t.slo.name == "bronze"     # last configured class
+            with pytest.raises(LightGBMError, match="unknown SLO"):
+                tr.register("svc2", bst, slo="platinum")
+            assert tr.names() == ["svc"]
+            assert telemetry.REGISTRY.gauge("fleet.tenants").value == 1
+            st = tr.status()
+            assert st["tenants"]["svc"]["slo"] == "bronze"
+            assert not st["tenants"]["svc"]["over_slo"]
+        finally:
+            tr.close()
+        assert telemetry.REGISTRY.gauge("fleet.tenants").value == 0
+
+    def test_admission_sheds_worse_classes_first(self):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = TenantRegistry(dict(SERVE_PARAMS,
+                                 fleet_admission_pressure=0.5))
+        Xq = np.ascontiguousarray(X[:4])
+        try:
+            gold = tr.register("gold-svc", bst, slo="gold")
+            brz = tr.register("brz-svc", bst, slo="bronze")
+            # shed thresholds scale down with class rank
+            assert tr.shed_pressure(gold.slo) == pytest.approx(0.5)
+            assert tr.shed_pressure(brz.slo) > 0 \
+                and tr.shed_pressure(brz.slo) < tr.shed_pressure(gold.slo)
+            # both tenants way over their p99 budgets
+            for t in (gold, brz):
+                for _ in range(32):
+                    t.hist.observe(1.0)
+            depth_gauge = telemetry.REGISTRY.gauge("serve.queue_depth")
+            sheds = telemetry.REGISTRY.counter("fleet.shed.slo")
+            before = sheds.value
+            # moderate pressure: bronze sheds, gold still admits
+            depth_gauge.set(0.4 * tr._config.serve_queue_depth)
+            with pytest.raises(ServingOverloadError, match="over SLO"):
+                tr.predict(Xq, tenant="brz-svc")
+            assert sheds.value == before + 1
+            depth_gauge.set(0.4 * tr._config.serve_queue_depth)
+            assert tr.predict(Xq, tenant="gold-svc").shape == (4,)
+            # a HEALTHY tenant is never admission-shed, even at 1.0
+            healthy = tr.register("healthy", bst, slo="bronze")
+            depth_gauge.set(tr._config.serve_queue_depth)
+            assert tr.predict(Xq, tenant="healthy").shape == (4,)
+            assert not healthy.over_slo()
+        finally:
+            telemetry.REGISTRY.gauge("serve.queue_depth").set(0)
+            tr.close()
+
+    def test_unknown_tenant_raises(self):
+        tr = TenantRegistry(dict(SERVE_PARAMS))
+        with pytest.raises(LightGBMError, match="no tenant"):
+            tr.predict(np.zeros((1, 2)), tenant="ghost")
+        tr.close()
+
+
+# -------------------------------------------------------- replica scaling
+class TestReplicaAutoscaler:
+    # NOTE: each test registers a UNIQUE tenant name — the per-tenant
+    # `fleet.tenant.e2e{tenant=...}` histogram is process-global, so a
+    # reused name would inherit another test's latency history.
+
+    def _tenants_with_slow_gold(self, bst, name):
+        tr = TenantRegistry(dict(SERVE_PARAMS))
+        t = tr.register(name, bst, slo="gold")      # 10ms budget
+        for _ in range(32):
+            t.hist.observe(1.0)                     # p99 ~1s: way over
+        return tr
+
+    def test_disabled_by_default(self):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = self._tenants_with_slow_gold(bst, "asc-off")
+        try:
+            assert ReplicaAutoscaler(tr).decide("asc-off") is None
+        finally:
+            tr.close()
+
+    def test_scales_up_only_when_stripes_balanced(self, monkeypatch):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = self._tenants_with_slow_gold(bst, "asc-up")
+        imb = telemetry.REGISTRY.gauge("serving.sharded.stripe_imbalance")
+        asc = ReplicaAutoscaler(tr, {"fleet_autoscale": True,
+                                     "fleet_max_replicas": 2,
+                                     "fleet_autoscale_imbalance": 1.5})
+        # pin the latency signal: the global serve.replica.* histograms
+        # may carry other tests' observations
+        monkeypatch.setattr(asc, "_replica_p99_s", lambda n, t: 1.0)
+        try:
+            imb.set(1.0)                 # capacity-bound: add a replica
+            assert asc.decide("asc-up") == 2
+            imb.set(3.0)                 # skew-bound: capacity won't help
+            assert asc.decide("asc-up") is None
+            # replica ceiling respected
+            capped = ReplicaAutoscaler(tr, {"fleet_autoscale": True,
+                                            "fleet_max_replicas": 1})
+            monkeypatch.setattr(capped, "_replica_p99_s",
+                                lambda n, t: 1.0)
+            imb.set(1.0)
+            assert capped.decide("asc-up") is None
+        finally:
+            imb.set(1.0)
+            tr.close()
+
+    def test_replica_p99_falls_back_to_tenant_hist(self):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = self._tenants_with_slow_gold(bst, "asc-sig")
+        asc = ReplicaAutoscaler(tr, {"fleet_autoscale": True})
+        try:
+            # zero replica-histogram coverage for a 0-replica probe:
+            # the tenant's own e2e history is the signal
+            p99 = asc._replica_p99_s(0, tr.tenant("asc-sig"))
+            assert p99 == pytest.approx(tr.tenant("asc-sig")
+                                        .hist.quantile(0.99))
+            assert p99 > 0.5
+        finally:
+            tr.close()
+
+    def test_scales_down_when_far_under_budget(self, monkeypatch):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = TenantRegistry(dict(SERVE_PARAMS))
+        tr.register("asc-down", bst, slo="bronze")  # 250ms budget
+        asc = ReplicaAutoscaler(tr, {"fleet_autoscale": True,
+                                     "fleet_min_replicas": 1})
+        monkeypatch.setattr(asc, "_replica_p99_s",
+                            lambda n, t: 0.001)     # p99 ~1ms: idle fleet
+        try:
+            monkeypatch.setattr(asc, "current_replicas", lambda name: 2)
+            assert asc.decide("asc-down") == 1
+            # at the floor: hold
+            monkeypatch.setattr(asc, "current_replicas", lambda name: 1)
+            assert asc.decide("asc-down") is None
+        finally:
+            tr.close()
+
+    def test_apply_resizes_through_hot_swap(self):
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = self._tenants_with_slow_gold(bst, "asc-apply")
+        imb = telemetry.REGISTRY.gauge("serving.sharded.stripe_imbalance")
+        asc = ReplicaAutoscaler(tr, {"fleet_autoscale": True,
+                                     "fleet_max_replicas": 2,
+                                     "fleet_autoscale_imbalance": 1.5})
+        Xq = np.ascontiguousarray(X[:8])
+        want = bst.predict(Xq).tobytes()
+        try:
+            imb.set(1.0)
+            assert asc.current_replicas("asc-apply") == 1
+            assert asc.apply("asc-apply") == 2
+            assert asc.current_replicas("asc-apply") == 2
+            # the resized replica set serves the same bytes
+            assert tr.predict(Xq, tenant="asc-apply").tobytes() == want
+        finally:
+            imb.set(1.0)
+            tr.close()
+
+
+# --------------------------------------------------- sentinel rule wiring
+class TestFleetSentinelRules:
+    def test_fleet_paths_classified(self):
+        from lightgbm_tpu.telemetry.diff import match_rule
+        assert match_rule("counters.fleet.swap.rejected") == \
+            ("up_is_bad", "counter")
+        assert match_rule("timings.fleet.gate.latency.total_s") == \
+            ("up_is_bad", "timing")
+        assert match_rule("gauges.fleet.tenants") == ("ignore", "counter")
+        assert match_rule("counters.fleet.shed.slo") == \
+            ("up_is_bad", "counter")
+        assert match_rule("counters.serve.auto_refresh_errors") == \
+            ("up_is_bad", "counter")
+        # bookkeeping moves freely
+        assert match_rule("counters.fleet.retrains")[0] == "ignore"
+        assert match_rule("gauges.fleet.rows_seen")[0] == "ignore"

@@ -18,6 +18,7 @@ partitions never leave the device; only the finished tree structure does
 from __future__ import annotations
 
 import copy
+import hashlib
 import io
 import json
 import time
@@ -2380,6 +2381,27 @@ class Booster:
         `_invalidate_pred_caches` (which bumps the version)."""
         return (getattr(self, "_model_version", 0), len(trees),
                 id(trees[0]), id(trees[-1]))
+
+    def model_fingerprint(self) -> str:
+        """Content-addressed model identity: a short sha256 of the
+        serialized model with its `[param: value]` lines stripped, so
+        the same trees hash the same regardless of how the booster was
+        configured or loaded (train vs model_from_string round-trip).
+        The lineage ledger (telemetry/ledger.py) keys every
+        control-plane record on this.  Cached per resolved tree slice
+        (`_tree_slice_key`), so repeated calls on an unchanged model
+        cost a tuple compare, not a re-serialization."""
+        trees = self.trees
+        ck = self._tree_slice_key(trees) if trees else None
+        cached = getattr(self, "_fingerprint_cache", None)
+        if cached is not None and cached[0] == ck:
+            return cached[1]
+        text = self.model_to_string()
+        body = "\n".join(l for l in text.splitlines()
+                         if not l.startswith("["))
+        fp = hashlib.sha256(body.encode()).hexdigest()[:16]
+        self._fingerprint_cache = (ck, fp)
+        return fp
 
     def _flatten_for_native(self, trees: List[Tree]):
         """Per-tree-concatenated contiguous model arrays for the native

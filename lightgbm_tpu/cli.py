@@ -229,7 +229,11 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu serve model=<model_file>"
               " [serve_port=...] [serve_trace=...]\n"
               "       python -m lightgbm_tpu fleet model=<model_file>"
-              " store=<datastore_dir> [fleet_retrain_rows=...]",
+              " store=<datastore_dir> [fleet_retrain_rows=...]\n"
+              "       python -m lightgbm_tpu lineage <events.jsonl>"
+              " [model=default] [n=5] [--json]\n"
+              "       python -m lightgbm_tpu top [url=http://host:port]"
+              " [n=8] [--json]",
               file=sys.stderr)
         return 0
     if argv[0] == "serve":
@@ -242,6 +246,16 @@ def run(argv: List[str]) -> int:
         # the datastore-tailing trainer daemon in one process
         from .fleet.daemon import main as fleet_main
         return fleet_main(argv[1:])
+    if argv[0] == "lineage":
+        # model-lineage report (telemetry/ledger.py): reconstruct the
+        # serving model's ancestry + rejections from a JSONL sink file
+        from .telemetry.ledger import main as lineage_main
+        return lineage_main(argv[1:])
+    if argv[0] == "top":
+        # one-shot fleet ops report (telemetry/ops.py): fetches
+        # /debug/fleet from a running serving process
+        from .telemetry.ops import main as top_main
+        return top_main(argv[1:])
     if argv[0] == "telemetry-report":
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main

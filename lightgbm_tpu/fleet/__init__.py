@@ -19,12 +19,14 @@ CLI: `python -m lightgbm_tpu fleet model=<file> store=<dir> ...`
 (docs/FLEET.md walks the whole lifecycle).
 """
 from .daemon import TrainerDaemon, create_fleet_store
+from .drift import DriftMonitor, psi
 from .shadow import GateVerdict, ShadowGate, TrafficSampler
 from .tenancy import (ReplicaAutoscaler, SLOClass, Tenant, TenantRegistry,
                       parse_slo_classes)
 
 __all__ = [
     "TrainerDaemon", "create_fleet_store",
+    "DriftMonitor", "psi",
     "ShadowGate", "GateVerdict", "TrafficSampler",
     "TenantRegistry", "Tenant", "SLOClass", "parse_slo_classes",
     "ReplicaAutoscaler",

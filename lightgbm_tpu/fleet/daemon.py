@@ -50,6 +50,7 @@ from ..engine import train as engine_train
 from ..utils import log
 from ..utils.config import Config, canonical_param_name
 from ..utils.log import LightGBMError
+from .drift import DriftMonitor
 from .shadow import ShadowGate, TrafficSampler
 
 
@@ -96,6 +97,13 @@ class TrainerDaemon:
         self.sampler = TrafficSampler(self._config.fleet_sample_ring)
         if registry is not None:
             registry.attach_sampler(name, self.sampler)
+        #: feature-drift monitor (fleet/drift.py) — a second sampler on
+        #: the same hook, PSI computed from the poll loop.  Opt-in
+        self.drift: Optional[DriftMonitor] = None
+        if self._config.serve_drift:
+            self.drift = DriftMonitor(booster, self._config, model=name)
+            if registry is not None:
+                registry.attach_sampler(name, self.drift)
         store = ShardStore.open(store_dir)
         #: rows the live model has already trained through — the tail
         #: mark; only rows beyond it count toward fleet_retrain_rows
@@ -107,6 +115,13 @@ class TrainerDaemon:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         telemetry.REGISTRY.gauge("fleet.rows_seen").set(store.n_rows)
+        # lineage: anchor the chain at the model this daemon will
+        # continue from — everything later links back to this record
+        telemetry.LEDGER.configure(self._config.fleet_ledger_ring)
+        telemetry.LEDGER.record(
+            "root", model=name, fingerprint=booster.model_fingerprint(),
+            trees=len(booster.trees), rows=store.n_rows,
+            generation=store.generation)
 
     @property
     def live_booster(self) -> Booster:
@@ -119,7 +134,14 @@ class TrainerDaemon:
         True when a retrain was attempted."""
         store = ShardStore.open(self.store_dir)
         telemetry.REGISTRY.gauge("fleet.rows_seen").set(store.n_rows)
+        if store.generation != self.generation:
+            telemetry.LEDGER.record(
+                "generation", model=self.name,
+                generation=store.generation,
+                previous=self.generation, rows=store.n_rows)
         self.generation = store.generation
+        if self.drift is not None:
+            self.drift.compute()   # off the hot path: poll cadence
         if store.n_rows - self.trained_rows < \
                 int(self._config.fleet_retrain_rows):
             return False
@@ -141,11 +163,26 @@ class TrainerDaemon:
             candidate = engine_train(params, train_set,
                                      num_boost_round=int(cfg.fleet_rounds),
                                      init_model=self._live)
+            parent_fp = self._live.model_fingerprint()
+            cand_fp = candidate.model_fingerprint()
+            telemetry.LEDGER.record(
+                "continuation", model=self.name, candidate=cand_fp,
+                parent=parent_fp, rounds=int(cfg.fleet_rounds),
+                rows=len(X), generation=store.generation)
             k = min(int(cfg.fleet_shadow_rows), len(X))
             verdict = self.gate.evaluate(
                 self._live, candidate,
                 holdout=(X[len(X) - k:], y[len(y) - k:]),
                 traffic=self.sampler.sample(), model=self.name)
+            # the gate record carries the verdict's MEASURED evidence
+            # next to the bounds it was judged against — the "why" the
+            # pass/fail counters cannot answer
+            telemetry.LEDGER.record(
+                "gate", model=self.name, candidate=cand_fp,
+                parent=parent_fp, passed=verdict.passed,
+                reason=verdict.reason[:200], checks=dict(verdict.checks),
+                bounds={"tolerance": self.gate.tolerance,
+                        "max_shift": self.gate.max_shift})
         self.retrains += 1
         telemetry.REGISTRY.counter("fleet.retrains").inc()
         if verdict.passed:
@@ -157,12 +194,22 @@ class TrainerDaemon:
             self._live = candidate
             self.swaps += 1
             telemetry.REGISTRY.counter("fleet.swap.accepted").inc()
+            telemetry.LEDGER.record(
+                "swap", model=self.name, fingerprint=cand_fp,
+                parent=parent_fp, rows=store.n_rows,
+                generation=store.generation)
+            if self.drift is not None:
+                # the buckets must belong to the model now serving
+                self.drift.rebind(candidate)
             log.info(f"fleet: swapped {self.name!r} at "
                      f"{store.n_rows} rows "
                      f"({candidate.current_iteration()} iterations)")
         else:
             self.rejects += 1
             telemetry.REGISTRY.counter("fleet.swap.rejected").inc()
+            telemetry.LEDGER.record(
+                "reject", model=self.name, candidate=cand_fp,
+                parent=parent_fp, reason=verdict.reason[:200])
             log.warning(f"fleet: candidate for {self.name!r} rejected "
                         f"({verdict.reason}); live model keeps serving")
         # advance the tail mark either way: a rejected window must not

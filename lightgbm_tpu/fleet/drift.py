@@ -1,0 +1,224 @@
+"""Feature-drift sketches: is live traffic still the training data?
+
+The failure mode the shadow gate cannot see: every candidate clears
+its holdout (drawn from the same datastore it trained on) while the
+REQUESTS have quietly moved to a region the model never learned.  The
+classic detector is PSI (population stability index) per feature:
+
+    PSI = sum_i (q_i - p_i) * ln(q_i / p_i)
+
+where p is the training distribution over buckets and q the serving
+distribution.  Everything needed is already lying around: the
+booster's `BinMapper`s define the buckets (the exact split-threshold
+quantization the model *sees* — drift across a bin boundary is drift
+that changes predictions; drift within a bin provably cannot), the
+training distribution is a bincount over `train_set.bin_data`, and the
+registry's sampler hook (PR 11) taps request rows off the hot path.
+
+`DriftMonitor` is a second sampler alongside the gate's
+`TrafficSampler`: its `__call__` only copies rows into a bounded ring
+(identical cost profile to the sampler that already runs — the predict
+path gains nothing new, which tests pin by byte-comparing responses
+with `serve_drift` on/off).  All binning/PSI work happens in
+`compute()`, driven from the trainer daemon's poll loop.  Results
+surface three ways: `serve.drift.psi{feature=}` top-k gauges +
+`serve.drift.max_psi` (sentinel-gated), a `drift` ledger record
+(advisory evidence next to the gate verdicts), and the `/debug/fleet`
+drift block.
+
+A file-loaded booster has no `train_set`: the monitor then self-fits
+quantile edges from the FIRST sampled window and uses that window as
+the baseline — drift is reported relative to the traffic observed at
+attach time until a hot-swap `rebind()`s a trained candidate with real
+mappers.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..utils.config import Config
+
+#: quantile-edge count for the self-fit fallback (no BinMapper s)
+FALLBACK_BINS = 16
+
+#: PSI bucket count: adjacent bin codes are merged down to this many
+#: groups before scoring.  A mapper can carry 255 bins; a few hundred
+#: sampled rows spread over 255 buckets leaves most empty, and PSI's
+#: log terms then report sampling noise as drift.  Mapper bins are
+#: near-equal-frequency on the training data, so adjacent-merge groups
+#: are near-equal-mass — the classic 10-20 bucket PSI setup — while
+#: the group boundaries still sit exactly on model bin edges.
+PSI_BUCKETS = 16
+
+
+def _coarsen(counts: np.ndarray, k: int = PSI_BUCKETS) -> np.ndarray:
+    """Merge adjacent buckets down to at most `k` groups (sum-preserving)."""
+    c = np.asarray(counts, dtype=np.float64).ravel()
+    if c.size <= k:
+        return c
+    idx = (np.arange(c.size) * k) // c.size
+    out = np.zeros(k, dtype=np.float64)
+    np.add.at(out, idx, c)
+    return out
+
+
+def psi(expected, actual, eps: float = 1e-6) -> float:
+    """Population stability index between two bucket-count vectors.
+
+    Counts are normalized to probabilities, clipped at `eps` (a bucket
+    empty on one side must not produce an infinite score), and scored
+    as sum((q - p) * ln(q / p)).  Symmetric, >= 0, 0 iff identical.
+    The unit test checks this against a literal NumPy transcription.
+    """
+    e = np.asarray(expected, dtype=np.float64).ravel()
+    a = np.asarray(actual, dtype=np.float64).ravel()
+    n = max(e.size, a.size)
+    if e.size < n:
+        e = np.pad(e, (0, n - e.size))
+    if a.size < n:
+        a = np.pad(a, (0, n - a.size))
+    te, ta = e.sum(), a.sum()
+    if te <= 0 or ta <= 0:
+        return 0.0
+    p = np.clip(e / te, eps, None)
+    q = np.clip(a / ta, eps, None)
+    return float(np.sum((q - p) * np.log(q / p)))
+
+
+class DriftMonitor:
+    """Per-feature PSI of sampled serving traffic vs the training bins.
+
+    Attach to a `ModelRegistry` like a `TrafficSampler`; call
+    `compute()` from any off-path cadence (the trainer daemon's poll);
+    `rebind()` on hot-swap so the buckets always belong to the model
+    actually serving.
+    """
+
+    def __init__(self, booster, config: Optional[Config] = None,
+                 model: str = "default"):
+        cfg = config if isinstance(config, Config) else Config(config or {})
+        self.model = model
+        self.capacity = max(int(cfg.serve_drift_ring), 1)
+        self.min_rows = max(int(cfg.serve_drift_min_rows), 1)
+        self.top_k = max(int(cfg.serve_drift_top_k), 1)
+        self._lock = threading.Lock()
+        self._rows: collections.deque = collections.deque(
+            maxlen=self.capacity)
+        self._width: Optional[int] = None
+        self._seen = 0
+        self._computed_at = 0      # rows seen at last compute
+        self._fallback_edges: Optional[List[np.ndarray]] = None
+        self._mappers = None
+        self._expected: Optional[List[np.ndarray]] = None
+        self.rebind(booster)
+
+    # ------------------------------------------------------ sampler hook
+    def __call__(self, X) -> None:
+        """Registry sampler hook: copy rows into the ring.  Bounded,
+        allocation-only, never touching the request's own array — the
+        same cost class as the TrafficSampler that already runs."""
+        X = np.asarray(X, dtype=np.float64)
+        if X.ndim == 1:
+            X = X.reshape(1, -1)
+        if X.size == 0:
+            return
+        with self._lock:
+            if self._width is None:
+                self._width = X.shape[1]
+            elif X.shape[1] != self._width:
+                return  # another model's rows — need rectangular windows
+            for row in X:
+                self._rows.append(np.array(row))
+            self._seen += X.shape[0]
+
+    # ---------------------------------------------------------- rebind
+    def rebind(self, booster) -> None:
+        """Point the buckets + training baseline at `booster` (called
+        with the candidate on every hot-swap).  A booster without an
+        in-memory binned train set (file-loaded, or external-memory
+        training) keeps the previous baseline; with neither, the first
+        sampled window becomes the baseline."""
+        ds = getattr(booster, "train_set", None)
+        mappers = getattr(ds, "bin_mappers", None) if ds is not None else None
+        bin_data = getattr(ds, "bin_data", None) if ds is not None else None
+        with self._lock:
+            if mappers:
+                self._mappers = mappers
+                self._fallback_edges = None
+                if bin_data is not None:
+                    bins = np.asarray(bin_data)
+                    self._expected = [
+                        np.bincount(bins[:, j].astype(np.int64),
+                                    minlength=mappers[j].num_bin)
+                        for j in range(bins.shape[1])]
+            # no mappers: keep whatever baseline exists (possibly none)
+
+    # --------------------------------------------------------- binning
+    def _bin_window(self, X: np.ndarray) -> List[np.ndarray]:
+        """Per-feature bucket-count vectors for a sampled window."""
+        counts = []
+        if self._mappers and len(self._mappers) >= X.shape[1]:
+            for j in range(X.shape[1]):
+                m = self._mappers[j]
+                codes = m.values_to_bins(X[:, j])
+                counts.append(np.bincount(codes.astype(np.int64),
+                                          minlength=m.num_bin))
+            return counts
+        # self-fit fallback: equal-frequency edges from the first window
+        if self._fallback_edges is None:
+            self._fallback_edges = [
+                np.unique(np.quantile(
+                    X[:, j], np.linspace(0, 1, FALLBACK_BINS + 1)[1:-1]))
+                for j in range(X.shape[1])]
+        for j in range(X.shape[1]):
+            codes = np.searchsorted(self._fallback_edges[j], X[:, j],
+                                    side="left")
+            counts.append(np.bincount(
+                codes, minlength=len(self._fallback_edges[j]) + 1))
+        return counts
+
+    # --------------------------------------------------------- compute
+    def compute(self) -> Optional[Dict[str, Any]]:
+        """Bin the sampled window, score PSI per feature against the
+        training baseline, export top-k gauges and a ledger record.
+        Returns the summary dict, or None when there is nothing new to
+        score (short window, or no rows since the last compute)."""
+        with self._lock:
+            if len(self._rows) < self.min_rows \
+                    or self._seen == self._computed_at:
+                return None
+            X = np.stack(list(self._rows))
+            self._computed_at = self._seen
+            seen = self._seen
+        actual = self._bin_window(X)
+        with self._lock:
+            if self._expected is None:
+                # baseline window (file-loaded booster): later windows
+                # score against the traffic observed at attach time
+                self._expected = actual
+                telemetry.REGISTRY.gauge("serve.drift.rows").set(X.shape[0])
+                return None
+            expected = self._expected
+        scores = [psi(_coarsen(expected[j]) if j < len(expected)
+                      else [], _coarsen(actual[j]))
+                  for j in range(len(actual))]
+        order = sorted(range(len(scores)), key=lambda j: -scores[j])
+        top = [{"feature": j, "psi": round(scores[j], 6)}
+               for j in order[:self.top_k]]
+        max_psi = scores[order[0]] if scores else 0.0
+        reg = telemetry.REGISTRY
+        for t in top:
+            reg.gauge("serve.drift.psi",
+                      feature=str(t["feature"])).set(t["psi"])
+        reg.gauge("serve.drift.max_psi").set(max_psi)
+        reg.gauge("serve.drift.rows").set(X.shape[0])
+        reg.counter("serve.drift.computes").inc()
+        telemetry.LEDGER.record("drift", model=self.model,
+                                rows=int(X.shape[0]), seen=int(seen),
+                                max_psi=round(max_psi, 6), top=top)
+        return {"rows": int(X.shape[0]), "max_psi": max_psi, "top": top}

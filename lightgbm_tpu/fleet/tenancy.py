@@ -91,16 +91,37 @@ def parse_slo_classes(spec: str) -> Dict[str, SLOClass]:
 
 
 class Tenant:
-    """One named model + its SLO class and latency history."""
+    """One named model + its SLO class, latency history and error-budget
+    burn meter (telemetry/slo.py)."""
 
-    __slots__ = ("name", "slo", "source", "hist")
+    __slots__ = ("name", "slo", "source", "hist", "meter", "budget_s")
 
-    def __init__(self, name: str, slo: SLOClass, source):
+    def __init__(self, name: str, slo: SLOClass, source,
+                 slo_target: float = 0.99, fast_s: float = 60.0,
+                 slow_s: float = 600.0):
         self.name = name
         self.slo = slo
         self.source = source  # model path/Booster given at register time
         self.hist = telemetry.REGISTRY.histogram("fleet.tenant.e2e",
                                                  tenant=name)
+        #: the SLO's latency budget in seconds — requests slower than
+        #: this are the "errors" the burn meter counts
+        self.budget_s = slo.p99_ms / 1000.0
+        self.meter = telemetry.BurnRateMeter(
+            target=slo_target, fast_s=fast_s, slow_s=slow_s)
+
+    def observe(self, seconds: float) -> None:
+        """Record one served request: e2e histogram + burn meter + the
+        per-tenant SLO gauges (`fleet.slo.burn_rate{tenant=}` up-is-bad,
+        `fleet.slo.budget_remaining{tenant=}` down-is-bad)."""
+        self.hist.observe(seconds)
+        self.meter.update(self.hist.count,
+                          self.hist.count_over(self.budget_s))
+        reg = telemetry.REGISTRY
+        reg.gauge("fleet.slo.burn_rate", tenant=self.name).set(
+            self.meter.burn_rate("fast"))
+        reg.gauge("fleet.slo.budget_remaining", tenant=self.name).set(
+            self.meter.budget_remaining())
 
     def observed_p99_ms(self) -> float:
         return self.hist.quantile(0.99) * 1000.0
@@ -139,7 +160,11 @@ class TenantRegistry:
                 f"(configured: {', '.join(self.classes)})")
         self.registry.load(name, model, warmup=warmup,
                            shard_devices=shard_devices)
-        tenant = Tenant(name, self.classes[slo], model)
+        cfg = self._config
+        tenant = Tenant(name, self.classes[slo], model,
+                        slo_target=float(cfg.fleet_slo_target),
+                        fast_s=float(cfg.fleet_slo_window_fast_s),
+                        slow_s=float(cfg.fleet_slo_window_slow_s))
         with self._lock:
             self._tenants[name] = tenant
             telemetry.REGISTRY.gauge("fleet.tenants").set(
@@ -210,7 +235,7 @@ class TenantRegistry:
         t0 = time.perf_counter()
         out = self.registry.predict(X, model=tenant, raw_score=raw_score,
                                     timeout=timeout, trace=trace)
-        t.hist.observe(time.perf_counter() - t0)
+        t.observe(time.perf_counter() - t0)
         return out
 
     def status(self) -> Dict:
@@ -222,7 +247,10 @@ class TenantRegistry:
             n: {"slo": t.slo.name, "slo_p99_ms": t.slo.p99_ms,
                 "observed_p99_ms": round(t.observed_p99_ms(), 3),
                 "requests": t.hist.count,
-                "over_slo": t.over_slo()}
+                "over_slo": t.over_slo(),
+                "burn_rate": round(t.meter.burn_rate("fast"), 4),
+                "burn_rate_slow": round(t.meter.burn_rate("slow"), 4),
+                "budget_remaining": round(t.meter.budget_remaining(), 4)}
             for n, t in sorted(tenants.items())}
         return base
 
@@ -317,4 +345,6 @@ class ReplicaAutoscaler:
             else "fleet.autoscale.down").inc()
         telemetry.event("fleet.autoscale", tenant=name,
                         replicas=target, previous=cur)
+        telemetry.LEDGER.record("autoscale", model=name,
+                                replicas=target, previous=cur)
         return target

@@ -50,15 +50,47 @@ def record_placement(placed, prefix: str = "parallel") -> None:
                 shard.data.nbytes)
 
 
+class _CollectiveTimer:
+    """Context manager pairing the ``mesh.collective.<name>`` span with
+    an ALWAYS-ON ``REGISTRY.timing`` observation.  Spans only record
+    when a tracer sink is attached, but the skew view in
+    ``telemetry/ops.py`` (`/debug/fleet`, `top`) needs collective
+    wall-clock unconditionally — a straggling device must show up in a
+    process that never configured a telemetry_sink."""
+
+    __slots__ = ("_name", "_span", "_t0")
+
+    def __init__(self, name: str, span_cm):
+        self._name = name
+        self._span = span_cm
+
+    def __enter__(self):
+        import time
+        self._t0 = time.perf_counter()
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        import time
+        from ..telemetry import REGISTRY
+        out = self._span.__exit__(*exc)
+        REGISTRY.timing(self._name).observe(
+            time.perf_counter() - self._t0)
+        return out
+
+
 def collective_span(name: str, **attrs):
     """Host-side span labeling mesh traffic: ``mesh.collective.<name>``.
 
     In-jit collectives are labeled via ``jax.named_scope`` instead (they
     trace into the compiled program); this wrapper is for the host-driven
     phases — placement, replication, gather — so both sides of the mesh
-    runtime share one searchable prefix."""
+    runtime share one searchable prefix.  Every exit also observes the
+    ``mesh.collective.<name>`` timing accumulator (see
+    ``_CollectiveTimer``)."""
     from ..telemetry import span
-    return span(f"mesh.collective.{name}", **attrs)
+    full = f"mesh.collective.{name}"
+    return _CollectiveTimer(full, span(full, **attrs))
 
 
 def place_from_datastore(store, mesh: Mesh, kind: str,

@@ -26,6 +26,13 @@ Endpoints (JSON in/out, no dependencies beyond http.server):
                   (telemetry.SERVE_RECORDER.snapshot(): newest-first
                   completed traces with per-stage ms), gated by the
                   `serve_trace*` params
+  GET  /debug/fleet[?n=K]
+                  -> the unified control-plane snapshot
+                  (telemetry.fleet_snapshot(): ledger lineage tail,
+                  tenant SLO burn table, drift top-k, replica health +
+                  mesh skew); `n` bounds the ledger tail / rejection
+                  list.  Both debug endpoints reject a non-integer or
+                  negative `n` with 400
 
 Trace-header contract: a caller may send `X-Request-Id: <token>`; the
 id (or a generated one) tags the request's `RequestTrace`, comes back
@@ -90,6 +97,24 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _query_limit(self, query: str, default: Optional[int] = None):
+        """Parse the shared `?n=K` limit of the /debug endpoints.
+        Returns (ok, limit); on a non-integer or NEGATIVE n the 400 has
+        already been sent (a stack trace is not an API response) and ok
+        is False."""
+        qs = urllib.parse.parse_qs(query)
+        if "n" not in qs:
+            return True, default
+        try:
+            limit = int(qs["n"][0])
+        except (ValueError, IndexError):
+            self._send_json(400, {"error": "n must be an integer"})
+            return False, None
+        if limit < 0:
+            self._send_json(400, {"error": "n must be >= 0"})
+            return False, None
+        return True, limit
+
     # --------------------------------------------------------------- GET
     def do_GET(self) -> None:  # noqa: N802 (stdlib name)
         telemetry.REGISTRY.counter("serve.http.requests").inc()
@@ -108,16 +133,16 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
         elif url.path == "/metrics":
             self._send_text(200, telemetry.REGISTRY.to_prometheus())
         elif url.path == "/debug/requests":
-            qs = urllib.parse.parse_qs(url.query)
-            limit = None
-            try:
-                if "n" in qs:
-                    limit = int(qs["n"][0])
-            except (ValueError, IndexError):
-                self._send_json(400, {"error": "n must be an integer"})
+            ok, limit = self._query_limit(url.query)
+            if not ok:
                 return
             self._send_json(
                 200, telemetry.SERVE_RECORDER.snapshot(limit=limit))
+        elif url.path == "/debug/fleet":
+            ok, limit = self._query_limit(url.query, default=8)
+            if not ok:
+                return
+            self._send_json(200, telemetry.fleet_snapshot(limit=limit))
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"})
 

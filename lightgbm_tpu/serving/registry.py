@@ -121,9 +121,10 @@ class ModelRegistry:
         self._swap_lock = threading.Lock()
         self._models: Dict[str, ServingModel] = {}
         # per-model traffic sampler hooks (fleet/shadow.py TrafficSampler
-        # attaches here): called with each request's row block, outside
-        # the serving data path — sampling never touches the bytes served
-        self._samplers: Dict[str, object] = {}
+        # and fleet/drift.py DriftMonitor attach here): each is called
+        # with every request's row block, outside the serving data path
+        # — sampling never touches the bytes served
+        self._samplers: Dict[str, List[object]] = {}
         cfg = self._config
         telemetry.SERVE_RECORDER.configure(
             enabled=cfg.serve_trace, capacity=cfg.serve_trace_ring,
@@ -185,6 +186,17 @@ class ModelRegistry:
                     telemetry.REGISTRY.gauge("serve.models").set(
                         len(self._models))
         telemetry.REGISTRY.counter("serve.model_loads").inc()
+        # lineage: record the swap the serving plane actually performed
+        # (the daemon records the DECISION; this is the apply).  Never
+        # let accounting fail a completed load.
+        try:
+            telemetry.LEDGER.record(
+                "registry.swap", model=name,
+                fingerprint=booster.model_fingerprint(),
+                replicas=getattr(runtime, "num_replicas", 1),
+                replaced=old is not None)
+        except Exception:
+            pass
         self._update_vram_gauge()
         if old is not None:
             old.close()
@@ -214,6 +226,9 @@ class ModelRegistry:
                 if freed:
                     telemetry.event("serve.demote", model=e.name,
                                     freed_bytes=freed)
+                    telemetry.LEDGER.record("registry.demote",
+                                            model=e.name,
+                                            freed_bytes=freed)
                     used -= freed
         self._update_vram_gauge()
         if used + need > budget:
@@ -279,21 +294,33 @@ class ModelRegistry:
     # --------------------------------------------------- traffic sampling
     def attach_sampler(self, name: str, sampler) -> None:
         """Attach a per-model traffic sampler (any callable taking the
-        request's row block).  The fleet shadow gate samples live
-        traffic this way; sampling happens before dispatch on a COPY-
+        request's row block).  The fleet shadow gate and the drift
+        monitor sample live traffic this way — several samplers may
+        coexist per model; sampling happens before dispatch on a COPY-
         free read of X, and a sampler exception never fails a request."""
         with self._lock:
-            self._samplers[name] = sampler
+            self._samplers.setdefault(name, []).append(sampler)
 
-    def detach_sampler(self, name: str) -> None:
+    def detach_sampler(self, name: str, sampler=None) -> None:
+        """Detach one sampler (by identity) or, with `sampler=None`,
+        every sampler registered for the model."""
         with self._lock:
-            self._samplers.pop(name, None)
+            if sampler is None:
+                self._samplers.pop(name, None)
+                return
+            hooks = self._samplers.get(name)
+            if hooks is None:
+                return
+            self._samplers[name] = [s for s in hooks if s is not sampler]
+            if not self._samplers[name]:
+                self._samplers.pop(name, None)
 
     def predict(self, X, model: str = "default", raw_score: bool = False,
                 timeout: Optional[float] = None,
                 trace: Optional[telemetry.RequestTrace] = None):
-        sampler = self._samplers.get(model)
-        if sampler is not None:
+        with self._lock:
+            samplers = list(self._samplers.get(model, ()))
+        for sampler in samplers:
             try:
                 sampler(X)
             except Exception:  # sampling is best-effort observability

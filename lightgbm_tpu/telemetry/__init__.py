@@ -28,6 +28,9 @@ from .request_trace import (RequestTrace, SERVE_RECORDER, ServeRecorder,
                             StageClock, e2e_latency_summary, new_request_id,
                             observe_stages, server_latency_block)
 from .diff import diff_snapshots, flatten, load_snapshot
+from .ledger import LEDGER, Ledger, ancestry, ledger_records, rejections
+from .slo import BurnRateMeter
+from .ops import fleet_snapshot, render_top
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "HISTOGRAM_BOUNDS", "MetricsRegistry",
@@ -41,4 +44,7 @@ __all__ = [
     "e2e_latency_summary", "new_request_id", "observe_stages",
     "server_latency_block",
     "diff_snapshots", "flatten", "load_snapshot",
+    "LEDGER", "Ledger", "ancestry", "ledger_records", "rejections",
+    "BurnRateMeter",
+    "fleet_snapshot", "render_top",
 ]

@@ -98,6 +98,29 @@ RULES: List[Tuple[str, str, str]] = [
     ("*fleet.sampler_errors", "up_is_bad", "counter"),
     ("*fleet.poll_errors", "up_is_bad", "counter"),
     ("*serve.auto_refresh_errors", "up_is_bad", "counter"),
+    # control-plane observability (ISSUE 12): burn rate rising means a
+    # tenant is eating error budget faster than its SLO allows —
+    # timing class (wall-clock-derived: a plain `telemetry diff` fails,
+    # the shared-core CI's --warn-timings run warns); its twin gauge
+    # budget_remaining fails in the DOWN direction, counter-classed:
+    # the gauge lives in [0, 1], so the timing tolerance (150% rel)
+    # could never fire on a drop — and the baseline segment pins it at
+    # a deterministic 1.0 (lenient SLO, no request can exceed budget).
+    # Drift PSI is
+    # computed from pinned data in the snapshot, so it is deterministic
+    # and fails hard on growth; the drift bookkeeping gauges (sampled
+    # row counts, feature indices) move freely.  Ledger record counts
+    # are pure bookkeeping.  Replica skew is wall-clock-derived
+    # (timing); the straggler INDEX is identity, not magnitude.
+    ("*fleet.slo.burn_rate*", "up_is_bad", "timing"),
+    ("*fleet.slo.budget_remaining*", "down_is_bad", "counter"),
+    ("*serve.drift.psi*", "up_is_bad", "counter"),
+    ("*serve.drift.max_psi", "up_is_bad", "counter"),
+    ("*serve.drift.*", "ignore", "counter"),
+    ("*ledger.records", "ignore", "counter"),
+    ("*mesh.skew.p99_ratio", "up_is_bad", "timing"),
+    ("*mesh.skew.*", "ignore", "counter"),
+    ("*mesh.collective.*", "ignore", "timing"),
     ("*fleet.tenant.*", "ignore", "counter"),
     ("*fleet.*", "ignore", "counter"),
     # serving: the bench `serving` block's latency percentiles /

@@ -1,0 +1,296 @@
+"""Model-lineage ledger: append-only, causally-linked control-plane log.
+
+The continuous-training fleet (fleet/daemon.py) mutates the serving
+plane through a chain of decisions — datastore generation bump →
+`init_model` continuation → shadow-gate verdict → registry hot-swap /
+demotion / autoscale — and before this module the chain survived only
+as counters ("3 swaps, 1 reject"), not as causes.  The ledger records
+every decision as one flat dict with the EVIDENCE it was taken on,
+keyed by content-addressed model fingerprints
+(`Booster.model_fingerprint()`: a sha256 over the model text minus its
+param block, so the same trees always hash the same), and links each
+record to its cause: a `swap` names the `parent` fingerprint it
+replaced, a `gate` record carries each check's measured numbers next
+to the bound it was judged against.
+
+Record kinds (the `name` field; every record also carries `seq`, `ts`,
+`model`):
+
+  root          the fleet's initial live model (fingerprint, trees, rows)
+  generation    datastore manifest generation observed to change
+  continuation  one init_model run (parent → candidate, rounds, rows)
+  gate          one ShadowGate verdict WITH evidence: per-check
+                measurements (frozen_trees / first_divergent_tree,
+                holdout live/candidate loss vs tolerance, traffic
+                shift vs max_shift) from GateVerdict.checks
+  swap          candidate went live (fingerprint, parent)
+  reject        candidate refused (candidate, parent, reason)
+  registry.swap a ModelRegistry.load made a fingerprint live
+  registry.demote  budget pressure moved an entry to host copies
+  autoscale     replica resize applied (replicas, previous)
+  drift         advisory feature-drift summary (top PSI features)
+
+Records live in a bounded in-memory ring (the process-global `LEDGER`,
+queried by `/debug/fleet` and `telemetry/ops.py`) AND flow through the
+existing sink machinery as `{"ev": "ledger", ...}` events whenever a
+sink is attached (`telemetry_sink=...`), so `python -m lightgbm_tpu
+lineage <events.jsonl>` reconstructs ancestry offline from the same
+JSONL every other telemetry surface writes.
+
+STDLIB-ONLY by design, like every sibling in this package: loadable by
+file path from jax-free processes (see metrics.py).
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import sys
+import threading
+from typing import Any, Dict, List, Optional
+
+from .metrics import REGISTRY
+from .sinks import iso_ts, make_event, read_jsonl
+from .spans import TRACER
+
+#: default in-memory ring capacity (records, oldest evicted first)
+DEFAULT_CAPACITY = 1024
+
+
+class Ledger:
+    """Bounded append-only record ring with monotonic sequence numbers.
+
+    `record()` is cheap (dict build + deque append under a lock) and
+    never raises toward the caller — control-plane accounting must not
+    take down the control plane.  Sequence numbers survive eviction:
+    `seq` keeps climbing after old records fall off the ring, so a gap
+    in an offline JSONL vs the in-memory tail is detectable.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(
+            maxlen=max(int(capacity), 1))
+        self._seq = 0
+
+    def configure(self, capacity: int) -> None:
+        """Resize the ring (keeps the newest records)."""
+        with self._lock:
+            self._ring = collections.deque(
+                self._ring, maxlen=max(int(capacity), 1))
+
+    def record(self, kind: str, model: str = "default",
+               **fields: Any) -> Dict[str, Any]:
+        """Append one record; mirror it to attached sinks as an
+        `{"ev": "ledger"}` event.  Returns the record."""
+        with self._lock:
+            self._seq += 1
+            rec = make_event("ledger", kind, seq=self._seq, model=model,
+                             **fields)
+            self._ring.append(rec)
+        REGISTRY.counter("ledger.records").inc()
+        if TRACER._sinks:
+            TRACER._emit(rec)
+        return rec
+
+    def records(self, model: Optional[str] = None,
+                n: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Oldest-first snapshot, optionally filtered by model and
+        truncated to the newest `n`."""
+        with self._lock:
+            out = list(self._ring)
+        if model is not None:
+            out = [r for r in out if r.get("model") == model]
+        if n is not None and n >= 0:
+            out = out[len(out) - min(n, len(out)):]
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+
+
+#: The process-global ledger every control-plane decision records into.
+LEDGER = Ledger()
+
+
+# ------------------------------------------------------- reconstruction
+def ledger_records(events: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Filter a parsed event stream (read_jsonl output, or
+    LEDGER.records() itself) down to ledger records, seq-ordered."""
+    recs = [e for e in events if e.get("ev") == "ledger"]
+    recs.sort(key=lambda r: r.get("seq", 0))
+    return recs
+
+
+def ancestry(records: List[Dict[str, Any]],
+             model: str = "default") -> List[Dict[str, Any]]:
+    """The serving model's lineage, root → current.
+
+    Walks the swap chain backwards from the newest `swap` (or `root`)
+    record via `parent` fingerprint links, then returns it oldest-first
+    with each hop's supporting evidence attached: the `continuation`
+    that built the candidate and the `gate` verdict that admitted it
+    (matched by candidate fingerprint)."""
+    recs = [r for r in records if r.get("model") == model]
+    by_candidate: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for r in recs:
+        if r.get("name") in ("continuation", "gate"):
+            fp = r.get("candidate", "")
+            if fp:
+                by_candidate.setdefault(fp, {})[r["name"]] = r
+    chain: List[Dict[str, Any]] = []
+    fp: Optional[str] = None
+    for r in reversed(recs):
+        if r.get("name") not in ("swap", "root"):
+            continue
+        rfp = r.get("fingerprint", "")
+        if fp is None or rfp == fp:
+            hop = dict(r)
+            ev = by_candidate.get(rfp, {})
+            if "continuation" in ev:
+                hop["continuation"] = ev["continuation"]
+            if "gate" in ev:
+                hop["gate"] = ev["gate"]
+            chain.append(hop)
+            if r["name"] == "root":
+                break
+            fp = r.get("parent", "")
+            if not fp:
+                break
+    chain.reverse()
+    return chain
+
+
+def rejections(records: List[Dict[str, Any]], model: str = "default",
+               n: int = 5) -> List[Dict[str, Any]]:
+    """The last `n` rejected candidates, newest first, each with its
+    gate evidence (matched by candidate fingerprint)."""
+    recs = [r for r in records if r.get("model") == model]
+    gates = {r.get("candidate", ""): r for r in recs
+             if r.get("name") == "gate"}
+    out: List[Dict[str, Any]] = []
+    for r in reversed(recs):
+        if r.get("name") != "reject":
+            continue
+        hop = dict(r)
+        gate = gates.get(r.get("candidate", ""))
+        if gate is not None:
+            hop["gate"] = gate
+        out.append(hop)
+        if len(out) >= n:
+            break
+    return out
+
+
+def _fmt_checks(checks: Dict[str, Any], bounds: Dict[str, Any]) -> str:
+    parts = []
+    if "frozen_trees" in checks:
+        parts.append(f"prefix: {checks['frozen_trees']} frozen / "
+                     f"{checks.get('candidate_trees', '?')} candidate"
+                     + (f", diverges at tree "
+                        f"{checks['first_divergent_tree']}"
+                        if "first_divergent_tree" in checks else ""))
+    if "live_loss" in checks:
+        parts.append(
+            f"holdout[{checks.get('holdout_rows', '?')}]: "
+            f"cand {checks.get('candidate_loss', float('nan')):.6g} vs "
+            f"live {checks['live_loss']:.6g} "
+            f"(tol {bounds.get('tolerance', '?')})")
+    if "traffic_shift" in checks:
+        parts.append(
+            f"traffic[{checks.get('traffic_rows', '?')}]: shift "
+            f"{checks['traffic_shift']:.4g} "
+            f"(max {bounds.get('max_shift', '?')})")
+    return "; ".join(parts) or "no checks recorded"
+
+
+def render_lineage(records: List[Dict[str, Any]], model: str = "default",
+                   n_rejects: int = 5) -> str:
+    """Human-readable lineage report: the serving chain with per-hop
+    gate evidence, then why the last candidates were refused."""
+    chain = ancestry(records, model=model)
+    lines = [f"lineage for model {model!r} "
+             f"({len(records)} ledger records)"]
+    if not chain:
+        lines.append("  (no swap/root records — is the ledger empty or "
+                     "the model name wrong?)")
+    for i, hop in enumerate(chain):
+        tag = "ROOT" if hop["name"] == "root" else f"SWAP {i}"
+        when = iso_ts(hop.get("ts")) if hop.get("ts") else "?"
+        lines.append(f"  {tag:>7}  {hop.get('fingerprint', '?')}  {when}"
+                     + (f"  rows={hop['rows']}" if "rows" in hop else "")
+                     + (f"  gen={hop['generation']}"
+                        if "generation" in hop else ""))
+        if hop["name"] == "swap":
+            lines.append(f"           parent {hop.get('parent', '?')}")
+        cont = hop.get("continuation")
+        if cont:
+            lines.append(f"           continuation: +{cont.get('rounds', '?')}"
+                         f" rounds over {cont.get('rows', '?')} rows")
+        gate = hop.get("gate")
+        if gate:
+            lines.append("           gate PASS: " + _fmt_checks(
+                gate.get("checks", {}), gate.get("bounds", {})))
+    rej = rejections(records, model=model, n=n_rejects)
+    if rej:
+        lines.append(f"  rejected candidates (newest first, "
+                     f"last {len(rej)}):")
+        for r in rej:
+            lines.append(f"    REJECT {r.get('candidate', '?')}: "
+                         f"{r.get('reason', '?')}")
+            gate = r.get("gate")
+            if gate:
+                lines.append("           " + _fmt_checks(
+                    gate.get("checks", {}), gate.get("bounds", {})))
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    """`python -m lightgbm_tpu lineage <events.jsonl> [model=default]
+    [n=5] [--json]` — reconstruct the serving model's ancestry and the
+    last N rejections from a telemetry JSONL sink file."""
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu lineage",
+        description="Model-lineage report from a telemetry JSONL file.")
+    ap.add_argument("events", help="JSONL event file (telemetry_sink=)")
+    ap.add_argument("kv", nargs="*",
+                    help="model=<name> (default: default), "
+                         "n=<rejects> (default: 5)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit {ancestry, rejections} as one JSON object")
+    args = ap.parse_args(list(argv) if argv is not None else None)
+    model, n = "default", 5
+    for tok in args.kv:
+        k, _, v = tok.partition("=")
+        if k == "model":
+            model = v
+        elif k == "n":
+            n = int(v)
+        else:
+            print(f"lineage: unknown argument {tok!r}", file=sys.stderr)
+            return 2
+    try:
+        recs = ledger_records(read_jsonl(args.events))
+    except OSError as e:
+        print(f"lineage: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"model": model,
+                          "ancestry": ancestry(recs, model=model),
+                          "rejections": rejections(recs, model=model,
+                                                   n=n)},
+                         default=str))
+    else:
+        print(render_lineage(recs, model=model, n_rejects=n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,13 +46,19 @@ class Gauge:
 
     `set` is a single attribute store — atomic under the GIL, no lock
     needed for last-write-wins semantics.
+
+    `labels` (sorted `(key, value)` pairs, like `Histogram`) let one
+    metric name carry per-entity series — `fleet.slo.burn_rate{tenant=}`
+    / `serve.drift.psi{feature=}` — rendered as Prometheus labels on
+    export and as `name{k=v}` keys in snapshots.
     """
 
-    __slots__ = ("name", "value")
+    __slots__ = ("name", "value", "labels")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...] = ()):
         self.name = name
         self.value = 0.0
+        self.labels = tuple(labels)
 
     def set(self, v: float) -> None:
         self.value = float(v)
@@ -175,6 +181,19 @@ class Histogram:
         return {"p50": self.quantile(0.50), "p90": self.quantile(0.90),
                 "p99": self.quantile(0.99), "p999": self.quantile(0.999)}
 
+    def count_over(self, threshold: float) -> int:
+        """Observations above `threshold` seconds, at bucket resolution:
+        everything in buckets strictly above the bucket containing the
+        threshold.  EXACT when the threshold lands on a bucket edge
+        (observe uses <=-edge semantics, so bucket i holds values <=
+        bounds[i]); otherwise the count excludes the threshold's own
+        bucket — a deterministic undercount of at most one bucket's
+        population.  This is the SLO error-count primitive: budgets that
+        sit on the log ladder (10ms = edge 32) count exactly."""
+        i = bisect.bisect_left(self.bounds, float(threshold))
+        with self._lock:
+            return sum(self.counts[i + 1:])
+
     @classmethod
     def merged(cls, hists: Iterable["Histogram"],
                name: str = "merged") -> "Histogram":
@@ -198,6 +217,15 @@ def _hist_key(name: str, labels: Tuple[Tuple[str, str], ...]) -> str:
     return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote and newline must be escaped inside the quoted value (the spec's
+    only three escapes).  Label VALUES are user-supplied (tenant names,
+    feature names) — interpolating them raw lets one adversarial name
+    smuggle extra series or break the exposition parse."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 class MetricsRegistry:
     """Thread-safe name -> metric map with snapshot/Prometheus export."""
 
@@ -215,12 +243,23 @@ class MetricsRegistry:
                 m = self._counters[name] = Counter(name)
             return m
 
-    def gauge(self, name: str) -> Gauge:
+    def gauge(self, name: str, **labels: str) -> Gauge:
+        """One gauge per (name, label-set); labels become Prometheus
+        labels on the exported series and `name{k=v}` snapshot keys
+        (`fleet.slo.burn_rate{tenant=gold}`).  Label-free callers are
+        unchanged."""
+        lab = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        key = _hist_key(name, lab)
         with self._lock:
-            m = self._gauges.get(name)
+            m = self._gauges.get(key)
             if m is None:
-                m = self._gauges[name] = Gauge(name)
+                m = self._gauges[key] = Gauge(name, lab)
             return m
+
+    def gauge_family(self, name: str) -> List[Gauge]:
+        """Every label variant registered under one gauge name."""
+        with self._lock:
+            return [g for g in self._gauges.values() if g.name == name]
 
     def timing(self, name: str) -> Timing:
         with self._lock:
@@ -308,10 +347,19 @@ class MetricsRegistry:
                 m = norm(n)
                 lines.append(f"# TYPE {m} counter")
                 lines.append(f"{m} {c.value}")
-            for n, g in sorted(self._gauges.items()):
+            ggroups: Dict[str, List[Gauge]] = {}
+            for key in sorted(self._gauges):
+                g = self._gauges[key]
+                ggroups.setdefault(g.name, []).append(g)
+            for n, gs in sorted(ggroups.items()):
                 m = norm(n)
                 lines.append(f"# TYPE {m} gauge")
-                lines.append(f"{m} {g.value:g}")
+                for g in gs:
+                    lab = ",".join(
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in g.labels)
+                    suf = "{" + lab + "}" if lab else ""
+                    lines.append(f"{m}{suf} {g.value:g}")
             for n, t in sorted(self._timings.items()):
                 m = norm(n, "_seconds")
                 lines.append(f"# TYPE {m} summary")
@@ -331,7 +379,9 @@ class MetricsRegistry:
                 m = norm(n, "_seconds")
                 lines.append(f"# TYPE {m} histogram")
                 for h in hs:
-                    lab = ",".join(f'{k}="{v}"' for k, v in h.labels)
+                    lab = ",".join(
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in h.labels)
                     pre = lab + "," if lab else ""
                     suf = "{" + lab + "}" if lab else ""
                     with h._lock:

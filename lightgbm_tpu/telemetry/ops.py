@@ -1,0 +1,242 @@
+"""Unified fleet ops surface: one snapshot, two front-ends.
+
+`fleet_snapshot()` assembles the control-plane state the other
+telemetry pieces record — ledger lineage tail (ledger.py), tenant SLO
+burn gauges (slo.py via fleet/tenancy.py), feature-drift PSI gauges
+(fleet/drift.py), per-replica latency + mesh skew — into one
+JSON-serializable dict.  `GET /debug/fleet` (serving/http.py) returns
+it over HTTP from the serving process; `python -m lightgbm_tpu top`
+fetches that endpoint and renders the same snapshot as a one-shot
+text report (a fresh CLI process has an empty registry — the snapshot
+MUST come from the process that owns the fleet).
+
+Skew derivation: the sharded serving plane exports
+`serve.replica.<i>.latency` histograms per stripe replica; a slow
+device shows up as one replica's p99 pulling away from its siblings
+long before the merged p99 moves.  `fleet_snapshot` computes
+
+    mesh.skew.p99_ratio  = worst replica p99 / median replica p99
+    mesh.skew.straggler  = index of the worst replica
+
+and writes both back into the registry as gauges, so the sentinel can
+gate on the ratio and a metrics scrape sees what `/debug/fleet` sees.
+
+STDLIB-ONLY by design, like every sibling in this package (see
+metrics.py).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional
+
+from .ledger import LEDGER, ancestry, rejections
+from .metrics import REGISTRY
+
+DEFAULT_URL = "http://127.0.0.1:8080/debug/fleet"
+
+
+def _label(pairs, key: str) -> str:
+    for k, v in pairs:
+        if k == key:
+            return v
+    return ""
+
+
+def _tenant_table() -> List[Dict[str, Any]]:
+    """Per-tenant SLO row: requests + p99 from the e2e histogram, burn
+    rate / budget remaining from the gauges fleet/tenancy.py maintains."""
+    burn = {_label(g.labels, "tenant"): g.value
+            for g in REGISTRY.gauge_family("fleet.slo.burn_rate")}
+    left = {_label(g.labels, "tenant"): g.value
+            for g in REGISTRY.gauge_family("fleet.slo.budget_remaining")}
+    rows = []
+    for h in REGISTRY.histogram_family("fleet.tenant.e2e"):
+        tenant = _label(h.labels, "tenant")
+        rows.append({
+            "tenant": tenant,
+            "requests": h.count,
+            "p99_ms": round(h.quantile(0.99) * 1e3, 3),
+            "burn_rate": round(burn.get(tenant, 0.0), 4),
+            "budget_remaining": round(left.get(tenant, 1.0), 4),
+        })
+    rows.sort(key=lambda r: -r["burn_rate"])
+    return rows
+
+
+def _drift_block() -> Dict[str, Any]:
+    """Top PSI features from the gauges fleet/drift.py maintains."""
+    feats = [{"feature": _label(g.labels, "feature"),
+              "psi": round(g.value, 5)}
+             for g in REGISTRY.gauge_family("serve.drift.psi")]
+    feats.sort(key=lambda f: -f["psi"])
+    mx = REGISTRY.gauge_family("serve.drift.max_psi")
+    return {"top": feats,
+            "max_psi": round(mx[0].value, 5) if mx else 0.0}
+
+
+def _replica_block(snapshot_hists: Dict[str, Dict[str, Any]]
+                   ) -> Dict[str, Any]:
+    """Per-replica latency health + skew gauges, from the
+    `serve.replica.<i>.latency` histograms the sharded runtime exports.
+    Writes `mesh.skew.*` gauges back into the registry as a side
+    effect (deliberate: the sentinel and Prometheus scrapes should see
+    the same derived signal this snapshot reports)."""
+    replicas = []
+    for key, h in sorted(snapshot_hists.items()):
+        if not (key.startswith("serve.replica.")
+                and key.endswith(".latency")):
+            continue
+        idx_s = key[len("serve.replica."):-len(".latency")]
+        try:
+            idx = int(idx_s)
+        except ValueError:
+            continue
+        replicas.append({"replica": idx, "requests": h["count"],
+                         "p50_ms": round(h["p50_s"] * 1e3, 3),
+                         "p99_ms": round(h["p99_s"] * 1e3, 3)})
+    replicas.sort(key=lambda r: r["replica"])
+    out: Dict[str, Any] = {"replicas": replicas}
+    active = [r for r in replicas if r["requests"] > 0]
+    if len(active) >= 2:
+        p99s = sorted(r["p99_ms"] for r in active)
+        median = p99s[len(p99s) // 2]
+        worst = max(active, key=lambda r: r["p99_ms"])
+        ratio = (worst["p99_ms"] / median) if median > 0 else 1.0
+        out["skew"] = {"p99_ratio": round(ratio, 4),
+                       "straggler": worst["replica"]}
+        REGISTRY.gauge("mesh.skew.p99_ratio").set(ratio)
+        REGISTRY.gauge("mesh.skew.straggler").set(worst["replica"])
+    return out
+
+
+def fleet_snapshot(limit: int = 8) -> Dict[str, Any]:
+    """The unified control-plane snapshot `/debug/fleet` serves.
+
+    Reads ONLY process-global state (LEDGER + REGISTRY) — callable from
+    any thread of the serving process with no fleet object handles.
+    `limit` bounds the ledger tail and the rejection list.
+    """
+    snap = REGISTRY.snapshot()
+    recs = LEDGER.records()
+    models = sorted({r.get("model", "default") for r in recs})
+    lineage = {}
+    for m in models:
+        chain = ancestry(recs, model=m)
+        lineage[m] = {
+            "serving": chain[-1].get("fingerprint") if chain else None,
+            "ancestry": chain,
+            "rejections": rejections(recs, model=m, n=limit),
+        }
+    collectives = {n: t for n, t in snap.get("timings", {}).items()
+                   if n.startswith("mesh.collective.")}
+    return {
+        "ledger": {"records": len(LEDGER),
+                   "tail": recs[max(0, len(recs) - limit):]},
+        "lineage": lineage,
+        "tenants": _tenant_table(),
+        "drift": _drift_block(),
+        "mesh": {**_replica_block(snap.get("histograms", {})),
+                 "collectives": collectives},
+    }
+
+
+# -------------------------------------------------------------- render
+def render_top(snap: Dict[str, Any]) -> str:
+    """One-shot `top`-style text report of a fleet snapshot."""
+    lines = ["fleet ops snapshot"]
+    lines.append(f"  ledger: {snap['ledger']['records']} records")
+    for model, lin in sorted(snap.get("lineage", {}).items()):
+        chain = lin.get("ancestry", [])
+        hops = " -> ".join(h.get("fingerprint", "?") for h in chain) \
+            or "(empty)"
+        lines.append(f"  model {model!r}: serving "
+                     f"{lin.get('serving') or '?'}")
+        lines.append(f"    ancestry: {hops}")
+        rej = lin.get("rejections", [])
+        if rej:
+            lines.append(f"    rejected: "
+                         + ", ".join(f"{r.get('candidate', '?')}"
+                                     f" ({r.get('reason', '?')})"
+                                     for r in rej))
+    tenants = snap.get("tenants", [])
+    if tenants:
+        lines.append("  tenants (worst burn first):")
+        lines.append("    tenant         reqs    p99_ms   burn  budget")
+        for t in tenants:
+            lines.append(
+                f"    {t['tenant']:<12} {t['requests']:>6} "
+                f"{t['p99_ms']:>9.3f} {t['burn_rate']:>6.2f} "
+                f"{t['budget_remaining']:>7.2f}")
+    drift = snap.get("drift", {})
+    if drift.get("top"):
+        lines.append(f"  drift (max PSI {drift.get('max_psi', 0.0)}):")
+        for f in drift["top"]:
+            lines.append(f"    feature {f['feature']:<16} "
+                         f"psi {f['psi']:.5f}")
+    mesh = snap.get("mesh", {})
+    reps = mesh.get("replicas", [])
+    if reps:
+        lines.append("  replicas:")
+        for r in reps:
+            lines.append(f"    replica {r['replica']}: "
+                         f"{r['requests']} reqs, "
+                         f"p50 {r['p50_ms']:.3f} ms, "
+                         f"p99 {r['p99_ms']:.3f} ms")
+        skew = mesh.get("skew")
+        if skew:
+            lines.append(f"    skew: p99_ratio {skew['p99_ratio']} "
+                         f"(straggler: replica {skew['straggler']})")
+    cols = mesh.get("collectives", {})
+    if cols:
+        lines.append("  mesh collectives:")
+        for n, t in sorted(cols.items()):
+            lines.append(f"    {n}: {t['count']} calls, "
+                         f"mean {t['mean_s'] * 1e3:.3f} ms, "
+                         f"max {t['max_s'] * 1e3:.3f} ms")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    """`python -m lightgbm_tpu top [url=http://host:port] [n=8]
+    [--json]` — fetch `/debug/fleet` from a serving process and render
+    the ops snapshot."""
+    ap = argparse.ArgumentParser(
+        prog="python -m lightgbm_tpu top",
+        description="One-shot fleet ops report from /debug/fleet.")
+    ap.add_argument("kv", nargs="*",
+                    help="url=<endpoint or http://host:port> "
+                         f"(default {DEFAULT_URL}), n=<ledger tail>")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw snapshot JSON")
+    args = ap.parse_args(list(argv) if argv is not None else None)
+    url, n = DEFAULT_URL, 8
+    for tok in args.kv:
+        k, _, v = tok.partition("=")
+        if k == "url":
+            url = v if "/debug/fleet" in v \
+                else v.rstrip("/") + "/debug/fleet"
+        elif k == "n":
+            n = int(v)
+        else:
+            print(f"top: unknown argument {tok!r}", file=sys.stderr)
+            return 2
+    try:
+        with urllib.request.urlopen(f"{url}?n={n}", timeout=10) as r:
+            snap = json.loads(r.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        print(f"top: cannot fetch {url}: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(snap, default=str))
+    else:
+        print(render_top(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

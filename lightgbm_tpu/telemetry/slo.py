@@ -1,0 +1,121 @@
+"""Tenant SLO error-budget burn-rate (multi-window, SRE-style).
+
+A tenant's SLO says "at most (1 - target) of requests may exceed the
+latency budget".  The existing `fleet.tenant.e2e{tenant=}` histograms
+already hold total counts and — via `Histogram.count_over(budget_s)` —
+over-budget counts, both cumulative since process start.  Cumulative
+ratios hide regressions: a tenant that was healthy for an hour can
+burn its entire budget in a minute while the all-time ratio barely
+moves.  The standard fix is the multi-window burn rate: sample the two
+cumulative counters over time, difference them across a FAST window
+(paging signal: is the budget burning *right now*?) and a SLOW window
+(ticket signal: has it been burning for a while?), and normalise by
+the allowed error fraction:
+
+    burn = (Δ over / Δ total) / (1 - target)
+
+burn == 1.0 means errors arrive exactly at the allowed rate (budget
+exhausts precisely at the window's end); 10.0 means ten times too
+fast; 0 means no over-budget requests in the window.
+`budget_remaining` folds the slow burn into a 0..1 "fraction of the
+window's budget left" gauge (clamped at 0) — the down-is-bad twin of
+the up-is-bad burn rate.
+
+The meter is a passive accumulator: callers (fleet/tenancy.py) push
+`(total, over)` counter readings whenever convenient — per request is
+fine, the meter is O(1) per update with a bounded deque — and read
+`burn_rate()` / `budget_remaining()` whenever a gauge or status block
+needs them.  The clock is injectable so tests can hand-compute oracle
+values on a fake timeline.
+
+STDLIB-ONLY by design, like every sibling in this package (loadable by
+file path from jax-free processes — see metrics.py).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Optional, Tuple
+
+#: default windows (seconds): fast = paging, slow = ticket.
+DEFAULT_FAST_S = 60.0
+DEFAULT_SLOW_S = 600.0
+
+
+class BurnRateMeter:
+    """Rolling-window error-budget burn from cumulative (total, over)
+    counter readings.
+
+    Samples are (t, total, over) triples in a deque pruned to the slow
+    window (plus one sample beyond its edge so a window that starts
+    between samples still has a defined baseline).  All reads difference
+    the newest sample against the oldest sample inside the window.
+    """
+
+    __slots__ = ("target", "fast_s", "slow_s", "_clock", "_samples",
+                 "_lock")
+
+    def __init__(self, target: float = 0.99,
+                 fast_s: float = DEFAULT_FAST_S,
+                 slow_s: float = DEFAULT_SLOW_S,
+                 clock: Optional[Callable[[], float]] = None):
+        if not 0.0 < target < 1.0:
+            raise ValueError(
+                f"slo target must be in (0, 1), got {target!r}")
+        self.target = float(target)
+        self.fast_s = float(fast_s)
+        self.slow_s = float(max(slow_s, fast_s))
+        self._clock = clock if clock is not None else time.monotonic
+        self._samples: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+
+    @property
+    def allowed(self) -> float:
+        """Allowed error fraction: 1 - target."""
+        return 1.0 - self.target
+
+    def update(self, total: int, over: int) -> None:
+        """Record a reading of the cumulative counters."""
+        now = self._clock()
+        with self._lock:
+            self._samples.append((now, int(total), int(over)))
+            # Prune to the slow window, keeping ONE sample at or beyond
+            # its far edge as the differencing baseline.
+            cutoff = now - self.slow_s
+            while (len(self._samples) >= 2
+                   and self._samples[1][0] <= cutoff):
+                self._samples.popleft()
+
+    def _window_rate(self, window_s: float) -> float:
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            now_t, now_total, now_over = self._samples[-1]
+            cutoff = now_t - window_s
+            base = self._samples[0]
+            for s in self._samples:
+                if s[0] > cutoff:
+                    break
+                base = s
+            d_total = now_total - base[1]
+            d_over = now_over - base[2]
+        if d_total <= 0:
+            return 0.0
+        return max(d_over, 0) / d_total / self.allowed
+
+    def burn_rate(self, window: str = "fast") -> float:
+        """Burn in the given window ('fast' or 'slow'): 1.0 = burning
+        exactly at the allowed rate, >1 = too fast, 0 = clean."""
+        return self._window_rate(
+            self.fast_s if window == "fast" else self.slow_s)
+
+    def budget_remaining(self) -> float:
+        """Fraction of the slow window's error budget left, clamped to
+        [0, 1]: 1 - burn_slow (a burn of 1.0 spends the whole window's
+        budget by the window's end)."""
+        return max(0.0, 1.0 - self._window_rate(self.slow_s))
+
+    def reset(self) -> None:
+        with self._lock:
+            self._samples.clear()

@@ -327,6 +327,27 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # scale-up only while stripes stay balanced (capacity-bound, not
     # skew-bound): max/mean cumulative stripe ratio allowed
     "fleet_autoscale_imbalance": (1.5, "float", ()),
+    # tenant SLO error budget (telemetry/slo.py): availability target —
+    # at most (1 - target) of a tenant's requests may exceed its class
+    # p99 budget; burn rate 1.0 means errors arrive exactly at that
+    # allowed rate
+    "fleet_slo_target": (0.99, "float", ()),
+    # burn-rate windows (seconds): fast = paging signal, slow = ticket
+    # signal + the budget_remaining gauge's horizon
+    "fleet_slo_window_fast_s": (60.0, "float", ()),
+    "fleet_slo_window_slow_s": (600.0, "float", ()),
+    # model-lineage ledger (telemetry/ledger.py): in-memory record-ring
+    # capacity (records also stream to the telemetry_sink when attached)
+    "fleet_ledger_ring": (1024, "int", ()),
+    # feature-drift monitor (fleet/drift.py): PSI of sampled serving
+    # traffic vs the training bin distribution, computed off the hot
+    # path from the trainer daemon's poll loop.  Opt-in
+    "serve_drift": (False, "bool", ()),
+    # sampled-row ring capacity / minimum window before a PSI compute /
+    # top-k drifting features exported as serve.drift.psi{feature=}
+    "serve_drift_ring": (512, "int", ()),
+    "serve_drift_min_rows": (64, "int", ()),
+    "serve_drift_top_k": (5, "int", ()),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -301,18 +301,24 @@ print(f"[run_ci] mesh smoke: sharded /predict byte-identical over "
       f"{used} striped replicas")
 EOF
 
-# fleet smoke (ISSUE 11): the continuous-training loop end to end on a
-# golden model — trainer daemon tailing an append-only store behind the
-# HTTP frontend, rows appended, exactly one shadow-gated hot-swap, and
-# a concurrent /predict loop that must see zero errors with every
-# response byte-identical to whichever model version was live at its
-# dispatch.  The full matrix (rejection, tenancy, autoscaling, the
-# swap/demote hammer) lives in tests/test_fleet.py
+# fleet smoke (ISSUE 11 + 12): the continuous-training loop end to end
+# on a golden model — trainer daemon tailing an append-only store behind
+# the HTTP frontend, rows appended, a shadow-gated hot-swap under a
+# concurrent /predict loop that must see zero errors with every response
+# byte-identical to whichever model version was live at its dispatch —
+# then the control plane: a forced rejection and a second accepted swap,
+# /debug/fleet probed (incl. the 400 contract), and the lineage CLI
+# asserted to reconstruct the full ancestry WITH per-check gate evidence
+# offline from the smoke's own JSONL sink.  The full matrix (tenancy,
+# autoscaling, burn rate, drift, the swap/demote hammer) lives in
+# tests/test_fleet.py and tests/test_fleet_observability.py
 JAX_PLATFORMS=cpu python - <<'EOF'
 import json
+import os
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -329,10 +335,17 @@ from lightgbm_tpu import telemetry
 bst = Booster(model_file="tests/data/golden_binary.model.txt")
 X, y = make_case_data(GOLDEN_CASES["binary"])
 store_dir = "/tmp/ci_fleet_store"
+events_path = "/tmp/ci_fleet_events.jsonl"
 import shutil
 shutil.rmtree(store_dir, ignore_errors=True)
+if os.path.exists(events_path):
+    os.unlink(events_path)
 create_fleet_store(store_dir, X, y, shard_rows=256)
 
+# the lineage ledger mirrors every control-plane record to attached
+# sinks — the offline CLI reads this file after the daemon is gone
+telemetry.LEDGER.reset()
+telemetry.TRACER.attach_jsonl(events_path)
 client = ServingClient(bst, params={"serve_warmup": False,
                                     "serve_max_wait_ms": 0.0})
 daemon = TrainerDaemon(
@@ -340,7 +353,9 @@ daemon = TrainerDaemon(
     train_params={"objective": "binary", "num_leaves": 15,
                   "verbosity": -1},
     params={"fleet_retrain_rows": 128, "fleet_rounds": 3,
-            "fleet_shadow_rows": 256})
+            "fleet_shadow_rows": 256, "serve_drift": True,
+            "serve_drift_min_rows": 32})
+root_fp = bst.model_fingerprint()
 srv = make_server(client, "127.0.0.1", 0)
 port = srv.server_address[1]
 threading.Thread(target=srv.serve_forever, daemon=True).start()
@@ -374,8 +389,6 @@ assert daemon.step(), "daemon did not retrain on the appended rows"
 time.sleep(0.3)                                   # traffic post-swap
 stop.set()
 t.join(timeout=60)
-srv.shutdown()
-srv.server_close()
 
 assert daemon.swaps == 1 and daemon.rejects == 0, \
     (daemon.swaps, daemon.rejects)
@@ -390,11 +403,85 @@ allowed = {np.asarray(bst.predict(Xq), np.float64).tobytes(),
 assert responses and set(responses) <= allowed, \
     "a /predict response matched NEITHER live model version"
 assert telemetry.REGISTRY.counter("fleet.gate.pass").value >= 1
+fp1 = live.model_fingerprint()
+
+# ---- control plane (ISSUE 12): force a rejection (any positive
+# holdout loss exceeds a negative tolerance), then a second accepted
+# swap — the lineage must carry both, each with measured gate evidence
+ShardStore.open(store_dir).append_rows(
+    X[:160], label=y[:160].astype(np.float32))
+daemon.gate.tolerance = -1.0
+assert daemon.step() and daemon.rejects == 1, "forced reject missed"
+assert daemon.live_booster.model_fingerprint() == fp1, \
+    "a REJECTED candidate went live"
+daemon.gate.tolerance = 10.0
+ShardStore.open(store_dir).append_rows(
+    X[:160], label=y[:160].astype(np.float32))
+assert daemon.step() and daemon.swaps == 2, "second swap missed"
+fp2 = daemon.live_booster.model_fingerprint()
+assert telemetry.REGISTRY.counter("serve.drift.computes").value >= 1, \
+    "drift monitor never scored the sampled traffic"
+
+# the unified ops surface, served live
+snap = json.loads(urllib.request.urlopen(
+    f"{base}/debug/fleet", timeout=30).read())
+for key in ("ledger", "lineage", "tenants", "drift", "mesh"):
+    assert key in snap, f"/debug/fleet missing {key!r}"
+chain = [h["fingerprint"]
+         for h in snap["lineage"]["default"]["ancestry"]]
+assert chain == [root_fp, fp1, fp2], chain
+assert snap["lineage"]["default"]["rejections"], "rejection not shown"
+assert snap["drift"]["top"], "drift block empty"
+try:
+    urllib.request.urlopen(f"{base}/debug/fleet?n=-1", timeout=30)
+    raise SystemExit("negative n was not rejected")
+except urllib.error.HTTPError as e:
+    assert e.code == 400, e.code
+
+srv.shutdown()
+srv.server_close()
 daemon.stop()
 client.close()
+telemetry.TRACER.clear_sinks()
 shutil.rmtree(store_dir, ignore_errors=True)
-print(f"[run_ci] fleet smoke: 1 gated hot-swap, {len(responses)} "
-      "concurrent /predict responses all byte-consistent, 0 errors")
+with open("/tmp/ci_fleet_fps.json", "w") as f:
+    json.dump({"root": root_fp, "fp1": fp1, "fp2": fp2}, f)
+print(f"[run_ci] fleet smoke: 2 gated hot-swaps + 1 forced reject, "
+      f"{len(responses)} concurrent /predict responses all "
+      "byte-consistent, 0 errors, /debug/fleet consistent")
+EOF
+
+# the lineage CLI must reconstruct the same ancestry OFFLINE from the
+# smoke's JSONL sink — two swaps, the rejected candidate, and the
+# per-check gate evidence (holdout losses next to their tolerance)
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import subprocess
+import sys
+
+fps = json.load(open("/tmp/ci_fleet_fps.json"))
+out = subprocess.run(
+    [sys.executable, "-m", "lightgbm_tpu", "lineage",
+     "/tmp/ci_fleet_events.jsonl"],
+    capture_output=True, text=True, timeout=120)
+assert out.returncode == 0, out.stderr
+text = out.stdout
+for fp in (fps["root"], fps["fp1"], fps["fp2"]):
+    assert fp in text, f"lineage lost fingerprint {fp}\n{text}"
+assert text.index(fps["root"]) < text.index(fps["fp1"]) < \
+    text.index(fps["fp2"]), f"ancestry out of order\n{text}"
+assert "gate PASS" in text and "REJECT" in text, text
+assert "holdout[" in text and "tol" in text, \
+    f"gate evidence missing from lineage report\n{text}"
+rep = json.loads(subprocess.run(
+    [sys.executable, "-m", "lightgbm_tpu", "lineage",
+     "/tmp/ci_fleet_events.jsonl", "--json"],
+    capture_output=True, text=True, timeout=120).stdout)
+chain = [h["fingerprint"] for h in rep["ancestry"]]
+assert chain == [fps["root"], fps["fp1"], fps["fp2"]], chain
+assert rep["rejections"][0]["gate"]["checks"]["candidate_loss"] > 0
+print("[run_ci] lineage CLI: full ancestry (root -> 2 swaps) + "
+      "rejection evidence reconstructed offline from JSONL")
 EOF
 
 # perf-regression sentinel: fresh deterministic snapshot diffed against

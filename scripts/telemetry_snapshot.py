@@ -86,7 +86,15 @@ def build_snapshot(rounds: int, rel_tol: float,
     # sentinel rules watch (swap.rejected / gate.fail / shed.slo stay
     # absent — the up_is_bad rules fire only if a later snapshot grows
     # them).  Everything is pinned: fixed rows, fixed rounds, step() is
-    # synchronous; fleet timings are timing/ignore-class in diff.RULES
+    # synchronous; fleet timings are timing/ignore-class in diff.RULES.
+    # ISSUE 12 names ride the same segment: serve_drift samples the
+    # pinned predict rows and PSI-scores them against the candidate's
+    # training bins (fully data-determined → the up_is_bad psi rules
+    # gate hard); the tenant predict sets the fleet.slo.* gauges — the
+    # SLO class is deliberately absurdly lenient (1e6 ms p99) so no
+    # request can ever be over budget and budget_remaining pins at a
+    # deterministic 1.0 (its down_is_bad rule is counter-class);
+    # ledger.records counts every control-plane record (ignore-class)
     import shutil
     import tempfile
     from lightgbm_tpu.fleet import TrainerDaemon, TenantRegistry, \
@@ -106,11 +114,17 @@ def build_snapshot(rounds: int, rel_tol: float,
             train_params={"objective": "binary", "num_leaves": 7,
                           "verbosity": -1},
             params={"fleet_retrain_rows": 128, "fleet_rounds": 2,
-                    "fleet_shadow_rows": 128})
+                    "fleet_shadow_rows": 128, "serve_drift": True,
+                    "serve_drift_min_rows": 32})
         from lightgbm_tpu.datastore.store import ShardStore
         ShardStore.open(fdir).append_rows(Xf[:192], label=yf[:192])
         daemon.step()
-        tenants = TenantRegistry(registry=fclient.registry)
+        # sampled through the registry's hook by this pinned predict,
+        # scored by the next poll (no new store rows → compute only)
+        fclient.predict(np.ascontiguousarray(Xf[:64]))
+        daemon.step()
+        tenants = TenantRegistry({"fleet_slo_classes": "lax=1000000"},
+                                 registry=fclient.registry)
         tenants.register("snapshot", fbst, warmup=False)
         tenants.predict(np.ascontiguousarray(Xf[:16]), tenant="snapshot")
         daemon.stop()

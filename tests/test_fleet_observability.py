@@ -1,0 +1,516 @@
+"""Fleet control-plane observability (ISSUE 12).
+
+The load-bearing claims:
+
+* LEDGER — every control-plane decision (root, continuation, gate
+  verdict WITH measured evidence, swap/reject) lands in the lineage
+  ledger, and `ancestry` reconstructs the serving model's chain across
+  two gated hot-swaps including a rejected candidate — both from the
+  in-memory ring and offline from the JSONL sink via the `lineage` CLI.
+* BURN RATE — the multi-window error-budget burn matches a
+  hand-computed oracle on a fake clock, `Histogram.count_over` is
+  exact at bucket edges, and serving through a `TenantRegistry`
+  populates the per-tenant SLO gauges.
+* DRIFT — `psi()` matches a literal NumPy transcription; the monitor
+  scores in-distribution traffic low and a shifted feature high (and
+  names the right feature); and enabling `serve_drift` leaves predict
+  responses BYTE-identical (sampling adds zero hot-path work).
+* OPS SURFACE — `/debug/fleet` serves the unified snapshot; the shared
+  `?n=` parser 400s (not stack-traces) on non-integer and negative
+  input for both debug endpoints.
+* EXPORT — Prometheus label values escape backslash/quote/newline; a
+  doctored `fleet.slo.burn_rate` or `serve.drift.psi` gauge makes
+  `telemetry diff` exit 1.
+"""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.datastore.store import ShardStore
+from lightgbm_tpu.fleet import (DriftMonitor, TenantRegistry, TrainerDaemon,
+                                create_fleet_store, psi)
+from lightgbm_tpu.fleet.drift import PSI_BUCKETS, _coarsen
+from lightgbm_tpu.serving import ModelRegistry
+from lightgbm_tpu.serving.http import make_server
+from lightgbm_tpu.telemetry import ledger as ledger_mod
+from lightgbm_tpu.telemetry.diff import main as diff_main
+from lightgbm_tpu.telemetry.metrics import MetricsRegistry
+from lightgbm_tpu.telemetry.slo import BurnRateMeter
+
+#: tiny-but-learnable data (mirrors tests/test_fleet.py)
+N0, NF = 384, 5
+TRAIN_PARAMS = {"objective": "binary", "num_leaves": 6,
+                "min_data_in_leaf": 8, "learning_rate": 0.2,
+                "verbosity": -1}
+SERVE_PARAMS = {"serve_max_wait_ms": 0.0, "serve_warmup": False}
+
+
+def _data(n=N0, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, NF)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n) > 0) \
+        .astype(np.float64)
+    return np.ascontiguousarray(X), y
+
+
+def _train(X, y, rounds=4):
+    return lgb.train(dict(TRAIN_PARAMS),
+                     lgb.Dataset(X, label=y, params=dict(TRAIN_PARAMS)),
+                     num_boost_round=rounds)
+
+
+# ===================================================== lineage ledger
+class TestLedger:
+    def test_ancestry_reconstruction_pure(self):
+        led = ledger_mod.Ledger()
+        led.record("root", fingerprint="aaa", rows=100)
+        led.record("continuation", candidate="bbb", parent="aaa")
+        led.record("gate", candidate="bbb", parent="aaa", passed=True,
+                   checks={"live_loss": 0.1, "candidate_loss": 0.09})
+        led.record("swap", fingerprint="bbb", parent="aaa")
+        led.record("continuation", candidate="ccc", parent="bbb")
+        led.record("gate", candidate="ccc", parent="bbb", passed=False,
+                   checks={"live_loss": 0.1, "candidate_loss": 0.9})
+        led.record("reject", candidate="ccc", parent="bbb",
+                   reason="holdout loss regressed")
+        led.record("continuation", candidate="ddd", parent="bbb")
+        led.record("gate", candidate="ddd", parent="bbb", passed=True,
+                   checks={"live_loss": 0.1, "candidate_loss": 0.08})
+        led.record("swap", fingerprint="ddd", parent="bbb")
+        recs = led.records()
+        chain = ledger_mod.ancestry(recs)
+        assert [h["fingerprint"] for h in chain] == ["aaa", "bbb", "ddd"]
+        # each swap hop carries its own continuation + gate evidence
+        assert chain[1]["gate"]["checks"]["candidate_loss"] == 0.09
+        assert chain[2]["gate"]["checks"]["candidate_loss"] == 0.08
+        assert chain[1]["continuation"]["candidate"] == "bbb"
+        rej = ledger_mod.rejections(recs)
+        assert len(rej) == 1 and rej[0]["candidate"] == "ccc"
+        assert rej[0]["gate"]["checks"]["candidate_loss"] == 0.9
+        # the rejected candidate is NOT in the serving chain
+        assert all(h["fingerprint"] != "ccc" for h in chain)
+
+    def test_seq_monotonic_across_eviction(self):
+        led = ledger_mod.Ledger(capacity=4)
+        for i in range(10):
+            led.record("generation", generation=i)
+        recs = led.records()
+        assert len(recs) == 4
+        assert [r["seq"] for r in recs] == [7, 8, 9, 10]
+
+    def test_model_filter(self):
+        led = ledger_mod.Ledger()
+        led.record("root", model="a", fingerprint="fa")
+        led.record("root", model="b", fingerprint="fb")
+        assert [r["fingerprint"] for r in led.records(model="a")] == ["fa"]
+
+    def test_two_swaps_one_reject_end_to_end(self, tmp_path):
+        """The acceptance-criteria flow: accept → forced reject →
+        accept, ancestry + per-check gate evidence reconstructed both
+        from the live ring and offline from the JSONL sink."""
+        X, y = _data(seed=3)
+        bst = _train(X[:128], y[:128])
+        root_fp = bst.model_fingerprint()
+        sink = str(tmp_path / "events.jsonl")
+        telemetry.LEDGER.reset()
+        telemetry.TRACER.attach_jsonl(sink)
+        store_dir = str(tmp_path / "store")
+        create_fleet_store(store_dir, X[:128], y[:128], shard_rows=64)
+        reg = ModelRegistry(dict(SERVE_PARAMS))
+        reg.load("default", bst)
+        daemon = TrainerDaemon(
+            store_dir, reg, bst, name="default",
+            train_params=dict(TRAIN_PARAMS),
+            params={"fleet_retrain_rows": 64, "fleet_rounds": 2,
+                    "fleet_shadow_rows": 128})
+        try:
+            st = ShardStore.open(store_dir)
+            st.append_rows(X[128:224], label=y[128:224])
+            assert daemon.step() and daemon.swaps == 1
+            fp1 = daemon.live_booster.model_fingerprint()
+            # force a deterministic reject: any positive holdout loss
+            # exceeds a negative tolerance
+            st = ShardStore.open(store_dir)
+            st.append_rows(X[224:304], label=y[224:304])
+            daemon.gate.tolerance = -1.0
+            assert daemon.step() and daemon.rejects == 1
+            assert daemon.live_booster.model_fingerprint() == fp1
+            daemon.gate.tolerance = 10.0
+            st = ShardStore.open(store_dir)
+            st.append_rows(X[304:], label=y[304:])
+            assert daemon.step() and daemon.swaps == 2
+            fp2 = daemon.live_booster.model_fingerprint()
+        finally:
+            daemon.stop()
+            reg.close()
+            telemetry.TRACER.clear_sinks()
+        # ---- in-memory ring
+        recs = telemetry.LEDGER.records()
+        chain = telemetry.ancestry(recs)
+        assert [h["fingerprint"] for h in chain] == [root_fp, fp1, fp2]
+        for hop in chain[1:]:
+            checks = hop["gate"]["checks"]
+            assert checks["frozen_trees"] < checks["candidate_trees"]
+            assert "live_loss" in checks and "candidate_loss" in checks
+            assert hop["gate"]["bounds"]["tolerance"] is not None
+        rej = telemetry.rejections(recs)
+        assert len(rej) == 1
+        assert rej[0]["gate"]["passed"] is False
+        assert "holdout" in rej[0]["reason"]
+        # the registry's apply-side records exist too (3 loads)
+        applies = [r for r in recs if r["name"] == "registry.swap"]
+        assert len(applies) == 3
+        assert applies[-1]["fingerprint"] == fp2
+        # ---- offline from the JSONL sink: same chain
+        offline = ledger_mod.ledger_records(telemetry.read_jsonl(sink))
+        ochain = ledger_mod.ancestry(offline)
+        assert [h["fingerprint"] for h in ochain] == [root_fp, fp1, fp2]
+        # ---- the lineage CLI renders it with evidence
+        rendered = ledger_mod.render_lineage(offline)
+        assert root_fp in rendered and fp2 in rendered
+        assert "gate PASS" in rendered and "REJECT" in rendered
+        assert "cand " in rendered  # measured holdout losses shown
+        assert ledger_mod.main([sink]) == 0
+        assert ledger_mod.main([sink, "--json"]) == 0
+
+    def test_fingerprint_content_addressed(self):
+        X, y = _data(n=160, seed=5)
+        bst = _train(X, y, rounds=3)
+        fp = bst.model_fingerprint()
+        assert fp == bst.model_fingerprint()  # cached + stable
+        clone = lgb.Booster(model_str=bst.model_to_string())
+        assert clone.model_fingerprint() == fp  # round-trip invariant
+        other = _train(X, y, rounds=4)
+        assert other.model_fingerprint() != fp
+
+
+# ==================================================== SLO burn rate
+class TestBurnRate:
+    def test_oracle_fast_and_slow_windows(self):
+        t = [0.0]
+        m = BurnRateMeter(target=0.99, fast_s=60.0, slow_s=600.0,
+                          clock=lambda: t[0])
+        assert m.burn_rate("fast") == 0.0  # no samples yet
+        m.update(0, 0)
+        # 30s: 100 requests, 2 over budget.
+        # fast burn = (2/100) / (1 - 0.99) = 2.0
+        t[0] = 30.0
+        m.update(100, 2)
+        assert m.burn_rate("fast") == pytest.approx(2.0)
+        assert m.burn_rate("slow") == pytest.approx(2.0)
+        assert m.budget_remaining() == 0.0  # clamped at zero
+        # 70 clean seconds: the fast window (40..100] only sees the
+        # clean diff (base sample t=30), the slow window still sees all
+        # of history: (2/200)/0.01 = 1.0
+        t[0] = 100.0
+        m.update(200, 2)
+        assert m.burn_rate("fast") == pytest.approx(0.0)
+        assert m.burn_rate("slow") == pytest.approx(1.0)
+        assert m.budget_remaining() == pytest.approx(0.0, abs=1e-9)
+        # beyond the slow window the dirty epoch ages out entirely
+        t[0] = 700.0
+        m.update(300, 2)
+        assert m.burn_rate("slow") == pytest.approx(0.0)
+        assert m.budget_remaining() == pytest.approx(1.0)
+
+    def test_oracle_partial_window_base(self):
+        # base sample straddles the window edge: differencing uses the
+        # newest sample AT or BEYOND the cutoff, so the rate is defined
+        # from the first in-window baseline
+        t = [0.0]
+        m = BurnRateMeter(target=0.9, fast_s=10.0, slow_s=100.0,
+                          clock=lambda: t[0])
+        m.update(0, 0)
+        t[0] = 5.0
+        m.update(50, 5)   # (5/50)/0.1 = 1.0
+        t[0] = 8.0
+        m.update(80, 20)  # fast: ((20-0)/(80-0))/0.1 = 2.5
+        assert m.burn_rate("fast") == pytest.approx(2.5)
+
+    def test_target_validation(self):
+        with pytest.raises(ValueError):
+            BurnRateMeter(target=1.0)
+        with pytest.raises(ValueError):
+            BurnRateMeter(target=0.0)
+
+    def test_count_over_exact_at_bucket_edge(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t.lat")
+        # 0.01s is exactly edge i=32 of the shared log ladder; observe
+        # uses <=-edge semantics, so values AT the edge are not "over"
+        for v in (0.001, 0.005, 0.01, 0.02, 0.02, 0.5):
+            h.observe(v)
+        assert h.count_over(0.01) == 3
+        assert h.count_over(10.0) == 0
+        assert h.count_over(1e-7) == 6
+
+    def test_tenant_gauges_from_serving(self):
+        X, y = _data(n=192, seed=11)
+        bst = _train(X, y, rounds=3)
+        tenants = TenantRegistry(dict(SERVE_PARAMS))
+        try:
+            tenants.register("burn-t", bst, slo="bronze")
+            for i in range(4):
+                tenants.predict(X[i * 8:(i + 1) * 8], tenant="burn-t")
+            g = telemetry.REGISTRY.gauge("fleet.slo.burn_rate",
+                                         tenant="burn-t")
+            gl = telemetry.REGISTRY.gauge("fleet.slo.budget_remaining",
+                                          tenant="burn-t")
+            assert g.value >= 0.0
+            assert 0.0 <= gl.value <= 1.0
+            st = tenants.status()["tenants"]["burn-t"]
+            assert "burn_rate" in st and "budget_remaining" in st
+            assert st["requests"] == 4
+        finally:
+            tenants.close()
+
+
+# ============================================================ drift
+class TestDrift:
+    def test_psi_matches_numpy_reference(self):
+        rng = np.random.RandomState(2)
+        e = rng.randint(0, 50, size=24).astype(float)
+        a = rng.randint(0, 50, size=24).astype(float)
+        eps = 1e-6
+        p = np.clip(e / e.sum(), eps, None)
+        q = np.clip(a / a.sum(), eps, None)
+        ref = float(np.sum((q - p) * np.log(q / p)))
+        assert psi(e, a) == pytest.approx(ref, rel=1e-12)
+        assert psi(e, e) == 0.0
+        assert psi([], []) == 0.0
+        # length mismatch zero-pads the shorter side
+        assert psi([1, 2, 3], [1, 2, 3, 0]) == 0.0
+
+    def test_coarsen_preserves_mass(self):
+        c = np.arange(255, dtype=float)
+        out = _coarsen(c)
+        assert out.size == PSI_BUCKETS
+        assert out.sum() == c.sum()
+        small = np.ones(8)
+        assert np.array_equal(_coarsen(small), small)
+
+    def test_monitor_scores_shift_on_right_feature(self):
+        X, y = _data(n=N0, seed=7)
+        bst = _train(X, y)
+        mon = DriftMonitor(bst, {"serve_drift_min_rows": 64})
+        rng = np.random.RandomState(8)
+        mon(rng.randn(256, NF))
+        r_in = mon.compute()
+        assert r_in is not None and r_in["max_psi"] < 0.5
+        # nothing new sampled → nothing recomputed
+        assert mon.compute() is None
+        mon2 = DriftMonitor(bst, {"serve_drift_min_rows": 64})
+        Xd = rng.randn(256, NF)
+        Xd[:, 2] += 3.0
+        mon2(Xd)
+        r_shift = mon2.compute()
+        assert r_shift["top"][0]["feature"] == 2
+        assert r_shift["top"][0]["psi"] > 1.0
+        assert r_shift["top"][0]["psi"] > 3 * r_in["max_psi"]
+        g = telemetry.REGISTRY.gauge("serve.drift.psi", feature="2")
+        assert g.value == pytest.approx(r_shift["top"][0]["psi"])
+
+    def test_file_loaded_booster_baselines_on_first_window(self, tmp_path):
+        X, y = _data(n=256, seed=9)
+        bst = _train(X, y)
+        path = str(tmp_path / "m.txt")
+        bst.save_model(path)
+        loaded = lgb.Booster(model_file=path)  # no train_set
+        mon = DriftMonitor(loaded, {"serve_drift_min_rows": 32})
+        rng = np.random.RandomState(10)
+        mon(rng.randn(64, NF))
+        assert mon.compute() is None  # first window = baseline
+        mon(rng.randn(64, NF))
+        r = mon.compute()              # scored against that baseline
+        assert r is not None and r["max_psi"] < 1.0
+
+    def test_drift_on_off_byte_parity(self, tmp_path):
+        """Acceptance: drift sampling adds ZERO work to the predict
+        hot path — responses byte-identical with serve_drift on/off."""
+        X, y = _data(seed=13)
+        bst = _train(X[:128], y[:128])
+        store_dir = str(tmp_path / "store")
+        create_fleet_store(store_dir, X[:128], y[:128], shard_rows=64)
+
+        def serve_bytes(drift_on):
+            reg = ModelRegistry(dict(SERVE_PARAMS))
+            reg.load("default", bst)
+            daemon = TrainerDaemon(
+                store_dir, reg, bst, name="default",
+                train_params=dict(TRAIN_PARAMS),
+                params={"fleet_retrain_rows": 10 ** 9,
+                        "serve_drift": drift_on,
+                        "serve_drift_min_rows": 16})
+            try:
+                out = [np.asarray(
+                    reg.predict(X[i * 32:(i + 1) * 32])).tobytes()
+                    for i in range(4)]
+                daemon.step()  # drift compute runs off-path
+                out.append(np.asarray(reg.predict(X[128:160])).tobytes())
+            finally:
+                daemon.stop()
+                reg.close()
+            return out
+
+        assert serve_bytes(True) == serve_bytes(False)
+
+
+# ==================================================== HTTP ops surface
+class TestDebugFleetEndpoint:
+    @pytest.fixture()
+    def server(self):
+        from lightgbm_tpu.serving.client import ServingClient
+        X, y = _data(n=192, seed=17)
+        bst = _train(X, y, rounds=3)
+        client = ServingClient(bst, params=dict(SERVE_PARAMS),
+                               name="default")
+        srv = make_server(client, "127.0.0.1", 0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        host, port = srv.server_address[:2]
+        try:
+            yield f"http://{host}:{port}", X
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            client.close()
+
+    def _get(self, url):
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return r.status, json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read().decode())
+
+    def test_debug_fleet_snapshot(self, server):
+        base, X = server
+        telemetry.LEDGER.record("root", fingerprint="ftest")
+        code, snap = self._get(f"{base}/debug/fleet")
+        assert code == 200
+        for key in ("ledger", "lineage", "tenants", "drift", "mesh"):
+            assert key in snap
+        assert snap["ledger"]["records"] >= 1
+        code, snap2 = self._get(f"{base}/debug/fleet?n=1")
+        assert code == 200 and len(snap2["ledger"]["tail"]) == 1
+
+    def test_bad_n_is_400_not_stack_trace(self, server):
+        base, _ = server
+        for path in ("/debug/fleet", "/debug/requests"):
+            for bad in ("abc", "-1", "1.5"):
+                code, body = self._get(f"{base}{path}?n={bad}")
+                assert code == 400, (path, bad)
+                assert "error" in body
+        # n=0 is a valid (empty) bound, not an error
+        code, _ = self._get(f"{base}/debug/fleet?n=0")
+        assert code == 200
+
+    def test_top_renders_fetched_snapshot(self, server, capsys):
+        from lightgbm_tpu.telemetry import ops as ops_mod
+        base, _ = server
+        assert ops_mod.main([f"url={base}"]) == 0
+        out = capsys.readouterr().out
+        assert "fleet ops snapshot" in out
+        assert ops_mod.main([f"url={base}", "--json"]) == 0
+
+    def test_top_unreachable_is_rc2(self):
+        from lightgbm_tpu.telemetry import ops as ops_mod
+        assert ops_mod.main(["url=http://127.0.0.1:9/"]) == 2
+
+
+# ======================================================= export/diff
+class TestPrometheusEscaping:
+    def test_label_values_escaped(self):
+        reg = MetricsRegistry()
+        evil = 'a"b\\c\nd'
+        reg.gauge("fleet.slo.burn_rate", tenant=evil).set(1.5)
+        reg.histogram("fleet.tenant.e2e", tenant=evil).observe(0.01)
+        text = reg.to_prometheus()
+        # the whole evil value lands on ONE line with quote, backslash
+        # and newline escaped per the exposition format
+        esc = 'tenant="a\\"b\\\\c\\nd"'
+        assert any(esc in ln and ln.endswith(" 1.5")
+                   for ln in text.splitlines())
+        assert 'a"b' not in text  # no unescaped quote leaked
+        # sane names still render plainly, grouped under one TYPE line
+        reg2 = MetricsRegistry()
+        reg2.gauge("g", tenant="x").set(1)
+        reg2.gauge("g", tenant="y").set(2)
+        t2 = reg2.to_prometheus()
+        assert t2.count("# TYPE lgbm_tpu_g gauge") == 1
+        assert 'lgbm_tpu_g{tenant="x"} 1' in t2
+        assert 'lgbm_tpu_g{tenant="y"} 2' in t2
+
+    def test_unlabeled_gauge_unchanged(self):
+        reg = MetricsRegistry()
+        reg.gauge("plain").set(3.0)
+        assert "lgbm_tpu_plain 3" in reg.to_prometheus()
+        assert reg.snapshot()["gauges"]["plain"] == 3.0
+
+    def test_labeled_gauge_snapshot_key(self):
+        reg = MetricsRegistry()
+        reg.gauge("fleet.slo.burn_rate", tenant="gold").set(0.5)
+        snap = reg.snapshot()
+        assert snap["gauges"]["fleet.slo.burn_rate{tenant=gold}"] == 0.5
+        fam = reg.gauge_family("fleet.slo.burn_rate")
+        assert len(fam) == 1 and fam[0].labels == (("tenant", "gold"),)
+
+
+class TestSentinelRules:
+    def _diff_rc(self, tmp_path, base, cur, *flags):
+        a = tmp_path / "a.json"
+        b = tmp_path / "b.json"
+        a.write_text(json.dumps(base))
+        b.write_text(json.dumps(cur))
+        return diff_main([str(a), str(b), *flags])
+
+    def test_doctored_burn_rate_fails_plain_diff(self, tmp_path):
+        base = {"gauges": {"fleet.slo.burn_rate{tenant=snapshot}": 0.0}}
+        cur = {"gauges": {"fleet.slo.burn_rate{tenant=snapshot}": 5.0}}
+        assert self._diff_rc(tmp_path, base, base) == 0
+        assert self._diff_rc(tmp_path, base, cur) == 1
+        # timing class: the CI's --warn-timings run only warns
+        assert self._diff_rc(tmp_path, base, cur, "--warn-timings") == 0
+
+    def test_doctored_psi_fails_even_with_warn_timings(self, tmp_path):
+        base = {"gauges": {"serve.drift.psi{feature=3}": 0.01,
+                           "serve.drift.max_psi": 0.01}}
+        cur = {"gauges": {"serve.drift.psi{feature=3}": 4.0,
+                          "serve.drift.max_psi": 4.0}}
+        assert self._diff_rc(tmp_path, base, cur) == 1
+        assert self._diff_rc(tmp_path, base, cur, "--warn-timings") == 1
+
+    def test_budget_remaining_fails_downward(self, tmp_path):
+        base = {"gauges":
+                {"fleet.slo.budget_remaining{tenant=snapshot}": 1.0}}
+        cur = {"gauges":
+               {"fleet.slo.budget_remaining{tenant=snapshot}": 0.1}}
+        assert self._diff_rc(tmp_path, base, cur) == 1
+        # counter-classed: the doctored drop fails the CI run too
+        assert self._diff_rc(tmp_path, base, cur, "--warn-timings") == 1
+        # a within-tolerance wiggle does not
+        ok = {"gauges":
+              {"fleet.slo.budget_remaining{tenant=snapshot}": 0.9}}
+        assert self._diff_rc(tmp_path, base, ok) == 0
+
+    def test_ledger_and_drift_bookkeeping_ignored(self, tmp_path):
+        base = {"counters": {"ledger.records": 5,
+                             "serve.drift.computes": 1},
+                "gauges": {"serve.drift.rows": 64.0,
+                           "mesh.skew.straggler": 0.0}}
+        cur = {"counters": {"ledger.records": 500,
+                            "serve.drift.computes": 90},
+               "gauges": {"serve.drift.rows": 512.0,
+                          "mesh.skew.straggler": 7.0}}
+        assert self._diff_rc(tmp_path, base, cur) == 0
+
+    def test_skew_ratio_is_timing_classed(self, tmp_path):
+        base = {"gauges": {"mesh.skew.p99_ratio": 1.0}}
+        cur = {"gauges": {"mesh.skew.p99_ratio": 9.0}}
+        assert self._diff_rc(tmp_path, base, cur) == 1
+        assert self._diff_rc(tmp_path, base, cur, "--warn-timings") == 0

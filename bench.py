@@ -679,13 +679,14 @@ def _run_worker() -> None:
             # whether the parity probe actually enabled the rung —
             # diff.py fails hard if it flips back to 0, so the slot
             # path cannot silently return
-            def _rung_bench(mode, rows, n_iters):
+            def _rung_bench(mode, rows, n_iters, compiled="off"):
                 Xr = X_eval
                 if len(Xr) < rows:
                     Xr = np.tile(Xr, (-(-rows // max(len(Xr), 1)), 1))
                 Xr = np.ascontiguousarray(Xr[:rows], np.float64)
                 c = ServingClient(bst, params={
-                    "serve_max_wait_ms": 0.0, "serve_device_sum": mode})
+                    "serve_max_wait_ms": 0.0, "serve_device_sum": mode,
+                    "serve_compiled": compiled})
                 rt = c.registry.get().runtime
                 d2h = telemetry.REGISTRY.counter("serve.d2h_bytes")
                 d2h0 = d2h.value
@@ -698,7 +699,15 @@ def _run_worker() -> None:
                     rlat.append(time.perf_counter() - t0)
                 rtotal = time.time() - t_rall
                 d2h_bytes = d2h.value - d2h0
-                active = bool(getattr(rt, "device_sum_active", False))
+                extra = {}
+                if compiled != "off":
+                    active = bool(getattr(rt, "compiled_active", False))
+                    plan = getattr(rt, "_plan", None)
+                    if plan is not None:
+                        extra["tiles"] = plan.num_tiles()
+                        extra["vmem_bytes"] = plan.total_plane_bytes()
+                else:
+                    active = bool(getattr(rt, "device_sum_active", False))
                 c.close()
                 rlat_ms = np.sort(np.asarray(rlat)) * 1e3
                 return {
@@ -708,11 +717,19 @@ def _run_worker() -> None:
                     "rows_per_sec": round(rows * n_iters / rtotal, 1),
                     "active": int(active),
                     "d2h_bytes_per_row": round(
-                        d2h_bytes / (rows * (n_iters + 1)), 1)}
+                        d2h_bytes / (rows * (n_iters + 1)), 1),
+                    **extra}
 
             rung_rows = int(os.environ.get("BENCH_SERVE_RUNG_ROWS", 4096))
             rung_iters = max(int(os.environ.get("BENCH_SERVE_RUNG_ITERS",
                                                 max(iters // 5, 5))), 1)
+            # the compiled rung (ISSUE 13): tile planes + fused traverse
+            # kernel, probe-gated exactly like device_sum.  device_sum
+            # is kept off so the measurement is the kernel alone, never
+            # a silent degradation one rung down — `active` plus the
+            # diff.py sentinel catch the probe flipping it back off
+            blk["compiled"] = _rung_bench("off", rung_rows, rung_iters,
+                                          compiled="on")
             blk["device_sum"] = _rung_bench("auto", rung_rows, rung_iters)
             slot = _rung_bench("off", rung_rows, rung_iters)
             slot.pop("active")
@@ -854,7 +871,12 @@ def _run_worker() -> None:
                 _log(f"fleet bench failed: {e}")
             print("@serving " + json.dumps(blk, separators=(",", ":")),
                   flush=True)
-            _log(f"serving rungs @{rung_rows} rows: device_sum "
+            _log(f"serving rungs @{rung_rows} rows: compiled "
+                 f"{blk['compiled']['rows_per_sec']:,.0f} rows/s "
+                 f"(active={blk['compiled']['active']}, "
+                 f"{blk['compiled'].get('tiles', 0)} tiles, "
+                 f"{blk['compiled'].get('vmem_bytes', 0)} B planes) "
+                 f"vs device_sum "
                  f"{blk['device_sum']['rows_per_sec']:,.0f} rows/s "
                  f"(active={blk['device_sum']['active']}, "
                  f"{blk['device_sum']['d2h_bytes_per_row']} B/row D2H) "

@@ -217,6 +217,54 @@ def _snapshot_callback(freq: int, output_model: str):
     return _callback
 
 
+def _compile_plan_main(argv: List[str]) -> int:
+    """`compile-plan <model> [serve_tile_vmem_kb=...] [--json]`: print
+    the serving compiler's tile plan — tiles, trees per tile, node
+    words, palette sizes, VMEM bytes per tile and the tree permutation
+    — for offline inspection without a device."""
+    import json
+    args = [a for a in argv if a != "--json"]
+    as_json = "--json" in argv
+    if not args:
+        print("usage: python -m lightgbm_tpu compile-plan <model_file>"
+              " [serve_tile_vmem_kb=...] [--json]", file=sys.stderr)
+        return 2
+    vmem = 512.0
+    for a in args[1:]:
+        if a.startswith("serve_tile_vmem_kb="):
+            vmem = float(a.split("=", 1)[1])
+        else:
+            raise LightGBMError(f"unknown compile-plan arg: {a}")
+    from .booster import Booster
+    from .compiler import PlanNotCompilable, build_plan, plan_summary
+    booster = Booster(model_file=args[0])
+    try:
+        plan = build_plan(booster.export_predict_arrays(),
+                          tile_vmem_kb=vmem)
+    except PlanNotCompilable as e:
+        print(f"not compilable: {e}", file=sys.stderr)
+        return 1
+    s = plan_summary(plan)
+    if as_json:
+        print(json.dumps(s, indent=2))
+        return 0
+    print(f"trees: {s['trees']}  num_class: {s['num_class']}  "
+          f"tiles: {s['tiles']}  tile_vmem_kb: {s['tile_vmem_kb']:g}")
+    print(f"total plane bytes: {s['total_plane_bytes']}")
+    ti = 0
+    for b in s["buckets"]:
+        for tile in b["tiles"]:
+            st = s["tile_stats"][ti]
+            print(f"  tile {ti}: depth={b['depth']} trees={len(tile)} "
+                  f"node_words={st['nodes']} palette={st['palette']} "
+                  f"vmem_bytes={st['bytes']}")
+            ti += 1
+    perm = s["permutation"]
+    print(f"permutation: {perm if len(perm) <= 64 else perm[:64]}"
+          f"{' ...' if len(perm) > 64 else ''}")
+    return 0
+
+
 def run(argv: List[str]) -> int:
     if not argv or argv[0] in ("-h", "--help"):
         print("usage: python -m lightgbm_tpu config=train.conf [key=value ...]\n"
@@ -233,9 +281,15 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu lineage <events.jsonl>"
               " [model=default] [n=5] [--json]\n"
               "       python -m lightgbm_tpu top [url=http://host:port]"
-              " [n=8] [--json]",
+              " [n=8] [--json]\n"
+              "       python -m lightgbm_tpu compile-plan <model_file>"
+              " [serve_tile_vmem_kb=...] [--json]",
               file=sys.stderr)
         return 0
+    if argv[0] == "compile-plan":
+        # offline serving-compiler plan inspection (compiler/plan.py is
+        # numpy-only, so this never touches a device)
+        return _compile_plan_main(argv[1:])
     if argv[0] == "serve":
         # prediction-serving HTTP frontend (serving/http.py): stdlib
         # server over the micro-batched device runtime

@@ -1,0 +1,33 @@
+"""Serving compiler: quantized tree-tile planes + fused traverse kernel.
+
+Compiles `Booster.export_predict_arrays` output into an execution plan
+the serving runtime's top ladder rung runs:
+
+  plan.py     — cluster trees into VMEM-sized tiles (greedy bin-packing
+                by node count, depth-bucketed so every tile in a bucket
+                shares one static traversal loop bound), recording the
+                permutation AND its inverse so the boosting-order f64
+                accumulation of the device-sum rung is preserved
+                bit-for-bit.
+  quantize.py — pack each node into a fused int32 node word (int16
+                threshold bin code + feature id + decision bits) plus an
+                int32 child word; per-tile f32 threshold palette decoded
+                by bin code.  Lossless by construction — and ASSERTED,
+                never assumed: any (feature, threshold_bin) pair mapping
+                to two distinct thresholds refuses to compile.
+  kernel.py   — one Pallas kernel per depth bucket: a tree tile's packed
+                planes load into VMEM and ALL trees in the tile traverse
+                + emit leaf slots per row block; the slots feed the
+                existing exact software-f64 adder
+                (`ops.predict.accumulate_slots_exact`), so the compiled
+                rung is byte-identical whenever routing matches.
+
+The plan/quantize layers are numpy-only (no jax import), so
+`python -m lightgbm_tpu compile-plan` can inspect a model offline
+without a device.
+"""
+from .plan import (CompiledPlan, PlanNotCompilable, build_plan,
+                   plan_summary)
+
+__all__ = ["CompiledPlan", "PlanNotCompilable", "build_plan",
+           "plan_summary"]

@@ -1,0 +1,191 @@
+"""Fused Pallas traverse kernel over quantized tree-tile planes.
+
+One grid step = (tile, row block): the tile's packed node planes load
+into VMEM once and EVERY tree in the tile routes the whole row block,
+emitting per-tree leaf slots.  The depth bucket's bound is the single
+static traversal loop count (ref: arXiv:2011.02022 — pipelined
+node-by-level walks out of on-chip memory; arXiv:1706.08359 uses the
+same tile decomposition for tree-parallel work division).
+
+Exactness contract (the compiled rung's whole claim): routing must be
+bit-identical to `ops.predict._leaf_slots` on the same staged f32 rows.
+Three rules keep it so:
+
+ * every gather is an integer one-hot contraction (or select unroll) on
+   BITCAST int32 — a one-hot f32 matmul would poison NaN payloads
+   (NaN*0 = NaN) and can truncate through bf16 operands on the MXU;
+   integer sums of a single selected term carry bit patterns verbatim;
+ * the decision evaluation is a transliteration of `_leaf_slots` —
+   same NaN substitution, same missing-type tests, same categorical
+   double-space range guard, same `fv <= thr` on the palette-decoded
+   f32 thresholds (asserted bitwise equal to the stacked plane at pack
+   time, quantize.py);
+ * the kernel emits SLOTS, not values: the f64 leaf accumulation stays
+   in `ops.predict.accumulate_slots_exact` (shared with the device-sum
+   rung) after a boosting-order gather, so summation order and rounding
+   are untouched by tiling.
+
+The refresh-time parity probe (serving/runtime.py) re-checks all of
+this end-to-end on every model refresh; any drift degrades the ladder
+instead of serving wrong bytes.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..analysis.contracts import contract
+from ..ops.predict import accumulate_slots_exact
+
+#: row-block height; bucket sizes are powers of two so BR always divides
+ROW_BLOCK = 256
+
+
+def _bits(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _gather_bits(onehot, table):
+    """Exact gather as an integer one-hot contraction: `onehot` [M, K]
+    0/1 int32, `table` [..., K] int32 bit patterns; each output sums
+    exactly one selected term, so NaN/inf payloads survive."""
+    return jax.lax.dot_general(
+        onehot, table, (((1,), (table.ndim - 1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+
+
+def _traverse_kernel(x_ref, words_ref, kids_ref, pal_ref, *rest,
+                     depth: int, mw: int):
+    """Route one row block through one tile; emit [TT, BR] leaf slots."""
+    catw_ref, o_ref = rest if mw else (None, rest[0])
+    words = words_ref[0]                        # [TT, NI] node words
+    tt, ni = words.shape
+    m = tt * ni
+    code = words & 0xFFFF
+    feat = (words >> 16) & 0xFFF
+    default_left = ((words >> 28) & 1) != 0
+    missing_type = (words >> 29) & 3
+    is_cat = words < 0                          # bit 31 (not >>31: the
+    kids = kids_ref[0]                          # arithmetic shift smears)
+    left = kids >> 16
+    right = ((kids & 0xFFFF) ^ 0x8000) - 0x8000
+
+    # feature gather: [BR, F] rows -> [BR, TT, NI] per-node values
+    xb = _bits(x_ref[...])                      # [BR, F]
+    f = xb.shape[1]
+    oh_f = (feat.reshape(m)[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (m, f), 1))
+    fval = jax.lax.bitcast_convert_type(
+        _gather_bits(oh_f.astype(jnp.int32), xb).T,
+        jnp.float32).reshape(-1, tt, ni)
+
+    # palette decode: 16-bit codes -> the exact f32 threshold planes
+    # (cat nodes' codes hold bitset word counts; rows past the palette
+    # just decode zero — the numeric compare is discarded for them)
+    palbits = _bits(pal_ref[...])[0]            # [P]
+    p = palbits.shape[0]
+    oh_p = (code.reshape(m)[:, None]
+            == jax.lax.broadcasted_iota(jnp.int32, (m, p), 1))
+    thr = jax.lax.bitcast_convert_type(
+        _gather_bits(oh_p.astype(jnp.int32), palbits),
+        jnp.float32).reshape(tt, ni)
+
+    # decision evaluation for ALL nodes at once (_leaf_slots semantics)
+    isnan = fval != fval
+    fv = jnp.where(isnan & (missing_type[None] != 2), 0.0, fval)
+    is_missing = (((missing_type[None] == 1) & (jnp.abs(fv) <= 1e-35))
+                  | ((missing_type[None] == 2) & isnan))
+    cmp = jnp.where(is_missing, default_left[None], fv <= thr[None])
+    if mw:
+        span = (code * 32).astype(jnp.float32)
+        ok = ~isnan & (fval > -1.0) & (fval < span[None])
+        v = jnp.where(ok, fval, 0.0).astype(jnp.int32)
+        widx = jnp.clip(v // 32, 0, mw - 1)
+        catw = catw_ref[0]                      # [TT, NI, MW]
+        w = jnp.zeros_like(v)
+        for k in range(mw):
+            w = jnp.where(widx == k, catw[None, :, :, k], w)
+        bit = (w >> (v % 32)) & 1
+        cmp = jnp.where(is_cat[None], ok & (bit == 1), cmp)
+
+    # descent: all trees step together; negative cursor = parked leaf
+    childsel = jnp.where(cmp, left[None], right[None])  # [BR, TT, NI]
+    slot_ids = jax.lax.broadcasted_iota(jnp.int32, (1, 1, ni), 2)
+
+    def step(_, nd):
+        oh = jnp.maximum(nd, 0)[:, :, None] == slot_ids
+        nxt = jnp.sum(jnp.where(oh, childsel, 0), axis=2)
+        return jnp.where(nd >= 0, nxt, nd)
+
+    nd0 = jnp.zeros(fval.shape[:2], jnp.int32)
+    nd = jax.lax.fori_loop(0, depth, step, nd0)
+    # a corrupted plane can leave a cursor >= 0 after `depth` steps; pin
+    # it to leaf 0 so the slot gather stays in range (the parity probe
+    # is what rejects the plane — the kernel must only not crash)
+    o_ref[0] = (~jnp.minimum(nd, -1)).T
+
+
+def _traverse_bucket(X, words, kids, pal, catw, depth: int, mw: int,
+                     interpret: bool):
+    """pallas_call driver for one depth bucket: grid over (tile, row
+    block), output [n_tiles * TT, N] slots in plan-flattened order."""
+    b = X.shape[0]
+    f = X.shape[1]
+    ntiles, tt, ni = words.shape
+    p = pal.shape[1]
+    br = min(b, ROW_BLOCK)
+    if b % br:
+        raise ValueError(f"batch of {b} rows is not bucket-padded "
+                         f"(row block {br})")
+    kern = functools.partial(_traverse_kernel, depth=depth, mw=mw)
+    in_specs = [
+        pl.BlockSpec((br, f), lambda i, j: (j, 0)),
+        pl.BlockSpec((1, tt, ni), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, tt, ni), lambda i, j: (i, 0, 0)),
+        pl.BlockSpec((1, p), lambda i, j: (i, 0)),
+    ]
+    args = [X, words, kids, pal]
+    if catw is not None:
+        in_specs.append(
+            pl.BlockSpec((1, tt, ni, mw), lambda i, j: (i, 0, 0, 0)))
+        args.append(catw)
+    out = pl.pallas_call(
+        kern,
+        grid=(ntiles, b // br),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, tt, br), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((ntiles, tt, b), jnp.int32),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(ntiles * tt, b)
+
+
+@contract(X="[N, F] f32", gather_idx="[T] i32", value_hi="[T, NL] u32",
+          value_lo="[T, NL] u32", meta="static", n_class="static int",
+          convert="static", interpret="static", ret="tree")
+@functools.partial(jax.jit, static_argnames=("meta", "n_class",
+                                             "convert", "interpret"))
+def compiled_predict(X, planes, gather_idx, value_hi, value_lo, cls=None,
+                     *, meta, n_class=1, convert=None, interpret=False):
+    """The compiled rung's whole device program: every bucket's tiles
+    traverse, the flattened slots gather back to BOOSTING order via the
+    plan's inverse permutation, and `accumulate_slots_exact` finishes
+    with the shared bit-exact f64 sum (+ optional fused convert).
+
+    `planes` is a tuple of per-bucket `(words, kids, pal, catw|None)`
+    tuples; `meta` the matching static `(depth, mw)` tuples.  One
+    program per ROW bucket regardless of depth-bucket count, so the
+    bounded-compile budget (log2(cap)+1 programs) is unchanged.
+    """
+    parts = []
+    with jax.named_scope("compiled_traverse"):
+        for (words, kids, pal, catw), (depth, mw) in zip(planes, meta):
+            parts.append(_traverse_bucket(X, words, kids, pal, catw,
+                                          depth, mw, interpret))
+    slots = jnp.concatenate(parts, axis=0)[gather_idx]
+    return accumulate_slots_exact(slots, value_hi, value_lo,
+                                  n_class=n_class, cls=cls,
+                                  convert=convert)

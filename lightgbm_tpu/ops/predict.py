@@ -495,6 +495,53 @@ def predict_raw_ensemble_exact(stacked, X: Array, n_class: int = 1,
         return convert(_f64_bits_to_f32(hi, lo))
 
 
+@contract(slots="[T, N] i32", value_hi="[T, NL] u32",
+          value_lo="[T, NL] u32", n_class="static int", cls="[T] i32?",
+          convert="static", ret="tree")
+def accumulate_slots_exact(slots: Array, value_hi: Array, value_lo: Array,
+                           n_class: int = 1, cls: Array = None,
+                           convert=None):
+    """Bit-exact f64 accumulation of PRE-ROUTED leaf slots, in tree
+    (boosting) order — the accumulation half of
+    `predict_raw_ensemble_exact`, factored out so traversal and
+    accumulation can come from different programs.
+
+    The serving compiler's tiled Pallas kernel (compiler/kernel.py)
+    produces [T, N] slots in a tile-local order, gathers them back to
+    boosting order with the plan's inverse permutation, and feeds them
+    here: same `_f64_add_bits` per-step rounding, same i % K multiclass
+    interleaving (via the optional `cls` plane), same downcast+convert
+    tail — so any traversal that routes identically accumulates
+    byte-identically by construction.
+
+    Returns raw accumulator bit planes `(hi, lo)` when `convert` is
+    None, else finished f32 scores (see `predict_raw_ensemble_exact`).
+    """
+    n = slots.shape[1]
+    shape = (n, n_class) if n_class > 1 else (n,)
+    xs = {"slots": slots, "hi": value_hi, "lo": value_lo}
+    if n_class > 1:
+        xs["cls"] = cls
+
+    def step(carry, tree):
+        chi, clo = carry
+        vhi = tree["hi"][tree["slots"]]
+        vlo = tree["lo"][tree["slots"]]
+        if n_class > 1:
+            k = tree["cls"]
+            nhi, nlo = _f64_add_bits(chi[:, k], clo[:, k], vhi, vlo)
+            return (chi.at[:, k].set(nhi), clo.at[:, k].set(nlo)), None
+        nhi, nlo = _f64_add_bits(chi, clo, vhi, vlo)
+        return (nhi, nlo), None
+
+    with jax.named_scope("accumulate_slots_exact"):
+        init = (jnp.zeros(shape, jnp.uint32), jnp.zeros(shape, jnp.uint32))
+        (hi, lo), _ = jax.lax.scan(step, init, xs)
+        if convert is None:
+            return hi, lo
+        return convert(_f64_bits_to_f32(hi, lo))
+
+
 @contract(stacked="tree", X="[N, F] float", ret="[T, N] i32")
 def predict_leaf_ensemble(stacked, X: Array) -> Array:
     """Per-tree leaf slots over padded stacked tree arrays (serving path).

@@ -10,7 +10,8 @@ flush time (both raise `ServingOverloadError`, both counted under
 `serve.shed` plus a per-cause counter — `serve.shed.queue_full` vs
 `serve.shed.deadline` — so overload causes are distinguishable at the
 metrics level).  Device failures inside the runtime degrade to the host
-walk there (`serve.fallbacks`), so a wedged accelerator slows serving
+walk there (`serve.host_walk{cause=}`), so a wedged accelerator slows
+serving
 rather than erroring it — the probe-wedge lesson from bench.py.
 
 Batches coalesce only compatible requests (same raw/prob flavor, same

@@ -160,11 +160,15 @@ class ModelRegistry:
                 runtime = ShardedServingRuntime(
                     booster, shard_devices=shard_devices,
                     max_batch_rows=cfg.serve_max_batch_rows,
-                    name=name, device_sum=cfg.serve_device_sum)
+                    name=name, device_sum=cfg.serve_device_sum,
+                    compiled=cfg.serve_compiled,
+                    tile_vmem_kb=cfg.serve_tile_vmem_kb)
             else:
                 runtime = ServingRuntime(
                     booster, max_batch_rows=cfg.serve_max_batch_rows,
-                    name=name, device_sum=cfg.serve_device_sum)
+                    name=name, device_sum=cfg.serve_device_sum,
+                    compiled=cfg.serve_compiled,
+                    tile_vmem_kb=cfg.serve_tile_vmem_kb)
             # the swap lock spans admit -> swap: the LRU demotion
             # decision and the swap it admits are one atomic step, so a
             # concurrent load can neither demote this entry the instant

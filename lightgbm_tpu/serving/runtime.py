@@ -10,6 +10,14 @@ in tests/test_serving.py.
 
 Fallback ladder (every rung byte-identical to `booster.predict`):
 
+  0. compiled    — `compiler/`: the export is compiled into quantized
+     VMEM-sized tree tiles and traversed by the fused Pallas kernel
+     (`compiler.kernel.compiled_predict`); the tile slots gather back
+     to boosting order and run through the same software-f64
+     accumulation as the device-sum rung.  Gated by its own
+     refresh-time parity probe (`serve.compiled_disabled{cause=}` on
+     any refusal) and by `serve_compiled` ("auto" enables on TPU only
+     — CPU backends keep the cheaper XLA rungs unless forced).
   1. device-sum  — `ops.predict.predict_raw_ensemble_exact`: traversal
      AND f64 leaf accumulation on device (software binary64 over u32
      bit planes), `convert_output` folded into the program.  D2H is
@@ -49,6 +57,8 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from ..compiler import PlanNotCompilable, build_plan
+from ..compiler.kernel import compiled_predict
 from ..ops.predict import predict_leaf_ensemble, predict_raw_ensemble_exact
 
 #: padding cap (and the micro-batcher's default flush threshold): with
@@ -85,10 +95,16 @@ class ServingRuntime:
     `refresh` swaps it atomically — concurrent requests either see the
     whole old model or the whole new one, never a mix.
 
-    `device_sum` selects the top ladder rung: "auto" (default) enables
+    `device_sum` selects the device-sum rung: "auto" (default) enables
     the exact device-sum program only after the export-time parity
     probe bit-matches, "force" skips the probe (tests/benches of the
-    machinery), "off" pins the slot path.
+    machinery), "off" pins the slot path.  `compiled` gates the tiled
+    Pallas rung above it the same way, with one extra wrinkle: "auto"
+    additionally requires a TPU backend (on CPU the kernel would run
+    interpreted — strictly slower than the XLA device-sum program — so
+    auto quietly keeps the existing ladder; "on"/"force" override for
+    tests and benches).  `tile_vmem_kb` is the compiler's per-tile
+    plane budget.
     """
 
     def __init__(self, booster, *,
@@ -97,6 +113,8 @@ class ServingRuntime:
                  num_iteration: Optional[int] = None,
                  name: str = "default",
                  device_sum: str = "auto",
+                 compiled: str = "auto",
+                 tile_vmem_kb: float = 512.0,
                  device=None):
         self._booster = booster
         self.name = name
@@ -105,6 +123,14 @@ class ServingRuntime:
         self._num = num_iteration
         self._device_sum_mode = str(device_sum).lower()
         self._device_sum_ok = False
+        self._compiled_mode = str(compiled).lower()
+        self._tile_vmem_kb = float(tile_vmem_kb)
+        self._compiled_ok = False
+        self._plan = None
+        self._plan_planes = None
+        self._plan_meta = None
+        self._plan_gidx = None
+        self._probe_failed = False
         self.demoted = False
         #: pin every device array (export planes + staged inputs) to one
         #: device — the sharded serving plane builds one pinned runtime
@@ -122,14 +148,17 @@ class ServingRuntime:
         """(Re-)export the booster — picks up continued training,
         `rollback_one_iter`, and `refit`-style in-place mutations (the
         export cache is `_model_version`-keyed, so an unchanged model
-        costs one dict lookup).  Re-runs the device-sum parity probe
-        against the new export and re-promotes a demoted runtime."""
+        costs one dict lookup).  Re-runs the device-sum and compiled
+        parity probes against the new export and re-promotes a demoted
+        runtime."""
         with self._refresh_lock:
             self._export = self._pin_export(
                 self._booster.export_predict_arrays(self._start,
                                                     self._num))
             self.demoted = False
+            self._probe_failed = False
             self._device_sum_ok = self._device_sum_enable(self._export)
+            self._compiled_ok = self._compiled_enable(self._export)
 
     def _pin_export(self, ex: Dict) -> Dict:
         """Copy the export's device arrays onto this runtime's pinned
@@ -172,6 +201,11 @@ class ServingRuntime:
         return self._device_sum_ok
 
     @property
+    def compiled_active(self) -> bool:
+        """Is the compiled tile rung serving (plan built, probe passed)?"""
+        return self._compiled_ok
+
+    @property
     def num_class(self) -> int:
         return self._export["num_class"]
 
@@ -180,8 +214,9 @@ class ServingRuntime:
 
     def device_bytes(self) -> int:
         """Accelerator-resident bytes of this runtime's export (stacked
-        traversal planes + leaf-value bit planes) — the registry's
-        `serve_vram_budget_mb` accounting unit.  0 after `demote()`."""
+        traversal planes + leaf-value bit planes + compiled tile
+        planes) — the registry's `serve_vram_budget_mb` accounting
+        unit.  0 after `demote()`."""
         ex = self._export
         if self.demoted or not ex:
             return 0
@@ -193,6 +228,9 @@ class ServingRuntime:
         for k in ("value_hi", "value_lo"):
             if ex.get(k) is not None:
                 total += int(ex[k].nbytes)
+        if self._plan_planes is not None:
+            total += sum(int(a.nbytes) for bucket in self._plan_planes
+                         for a in bucket if a is not None)
         return total
 
     def demote(self) -> int:
@@ -205,6 +243,13 @@ class ServingRuntime:
             freed = self.device_bytes()
             if freed == 0:
                 return 0
+            # the compiled planes exist ONLY on device — drop the rung
+            # entirely (the next refresh() rebuilds and re-probes it)
+            self._compiled_ok = False
+            self._plan = None
+            self._plan_planes = None
+            self._plan_meta = None
+            self._plan_gidx = None
             ex = dict(self._export)
             st = ex.get("stacked")
             if st:
@@ -240,6 +285,7 @@ class ServingRuntime:
             return True
         ok = self._probe_device_sum(ex)
         if not ok:
+            self._probe_failed = True
             telemetry.REGISTRY.counter("serve.device_sum_disabled").inc()
             telemetry.event("serve.device_sum_disabled", model=self.name)
         return ok
@@ -309,6 +355,108 @@ class ServingRuntime:
         X[rng.rand(rows, nf) < 0.03] = 0.0
         return np.ascontiguousarray(X)
 
+    # ---------------------------------------------------- compiled gate
+    def _disable_compiled(self, cause: str, detail: str = "") -> None:
+        telemetry.REGISTRY.counter("serve.compiled_disabled",
+                                   cause=cause).inc()
+        telemetry.event("serve.compiled_disabled", model=self.name,
+                        cause=cause, detail=detail[:200])
+
+    def _compiled_enable(self, ex: Dict) -> bool:
+        """Decide the compiled tile rung for this export (refresh-time):
+        build the plan, pin its planes, then demand byte parity on the
+        probe batch.  ANY refusal lands in
+        `serve.compiled_disabled{cause=}` and the ladder below serves —
+        a model that cannot compile is a degradation, never an error."""
+        self._plan = None
+        self._plan_planes = None
+        self._plan_meta = None
+        self._plan_gidx = None
+        mode = self._compiled_mode
+        if mode == "off":
+            return False
+        backend = jax.default_backend()
+        if mode == "auto" and backend != "tpu":
+            # interpreted Pallas on CPU is strictly slower than the XLA
+            # device-sum program — auto keeps the existing ladder
+            self._disable_compiled("platform", backend)
+            return False
+        if ex["stacked"] is None or not ex["trees"] \
+                or ex.get("value_hi") is None or ex["average_factor"] != 1:
+            self._disable_compiled("model")
+            return False
+        try:
+            plan = build_plan(ex, tile_vmem_kb=self._tile_vmem_kb,
+                              name=self.name)
+        except PlanNotCompilable as e:
+            self._disable_compiled("not_compilable", str(e))
+            return False
+        planes = []
+        for p in plan.planes:
+            arrs = [jnp.asarray(p["words"]), jnp.asarray(p["kids"]),
+                    jnp.asarray(p["pal"]),
+                    jnp.asarray(p["catw"]) if "catw" in p else None]
+            if self.device is not None:
+                arrs = [jax.device_put(a, self.device)
+                        if a is not None else None for a in arrs]
+            planes.append(tuple(arrs))
+        gidx = jnp.asarray(plan.gather_idx)
+        if self.device is not None:
+            gidx = jax.device_put(gidx, self.device)
+        self._plan = plan
+        self._plan_planes = tuple(planes)
+        self._plan_meta = tuple(
+            (p["depth"], p["catw"].shape[-1] if "catw" in p else 0)
+            for p in plan.planes)
+        self._plan_gidx = gidx
+        if mode == "force":
+            return True
+        ok = self._probe_compiled(ex)
+        if not ok:
+            self._probe_failed = True
+            self._disable_compiled("probe")
+            self._plan = None
+            self._plan_planes = None
+            self._plan_meta = None
+            self._plan_gidx = None
+        return ok
+
+    def _probe_compiled(self, ex: Dict) -> bool:
+        """Refresh-time exact-parity gate for the compiled rung: the
+        tiled kernel's accumulated bits — raw AND converted — must
+        match the host f64 gather/sum over the slot program's device
+        slots on the threshold-clustered probe batch (the same
+        reference `_probe_device_sum` holds the device-sum rung to).
+        Exceptions count as failed probes."""
+        try:
+            X = self._probe_batch(ex, rows=min(256, self.max_batch_rows))
+            slots = self._device_slots_chunk(X, ex["stacked"])
+            K = ex["num_class"]
+            leaf_values = ex["leaf_values"]
+            want = np.zeros((X.shape[0], K), np.float64)
+            for i in range(slots.shape[0]):
+                want[:, i % K] += leaf_values[i, slots[i]]
+            if K == 1:
+                want = want[:, 0]
+            got = self._compiled_chunk(X, ex, want_raw=True)
+            if got.shape != want.shape or not np.array_equal(
+                    got.view(np.uint64), want.view(np.uint64)):
+                return False
+            obj = self._booster.objective_
+            if obj is not None:
+                got_c = self._compiled_chunk(X, ex, want_raw=False)
+                want_c = self._convert(want)
+                if got_c.shape != want_c.shape \
+                        or got_c.dtype != want_c.dtype \
+                        or not np.array_equal(got_c.view(np.uint32),
+                                              want_c.view(np.uint32)):
+                    return False
+            return True
+        except Exception as e:
+            telemetry.event("serve.compiled_probe_error",
+                            model=self.name, error=str(e)[:200])
+            return False
+
     def buckets(self) -> List[int]:
         """Every padding bucket this runtime can present to the device."""
         out = []
@@ -342,6 +490,10 @@ class ServingRuntime:
             for b in sizes:
                 Z = np.zeros((b, nf), np.float64)
                 self._device_slots_chunk(Z, ex["stacked"])
+                if self._compiled_ok:
+                    self._compiled_chunk(Z, ex, want_raw=True)
+                    if obj is not None:
+                        self._compiled_chunk(Z, ex, want_raw=False)
                 if self._device_sum_ok:
                     self._device_sum_chunk(Z, ex, want_raw=True)
                     if obj is not None:
@@ -383,13 +535,18 @@ class ServingRuntime:
             t0 = time.perf_counter()
             want_raw = raw_score or self._booster.objective_ is None
             out = None
-            if self._device_sum_ok and ex["trees"]:
-                out = self._device_sum(X, ex, want_raw, clock)
+            if self._compiled_ok and ex["trees"]:
+                out = self._compiled(X, ex, want_raw, clock)
             if out is not None:
-                clock.rung = "device_sum"
+                clock.rung = "compiled"
             else:
-                raw = self._raw(X, ex, clock)
-                out = raw if want_raw else self._convert(raw)
+                if self._device_sum_ok and ex["trees"]:
+                    out = self._device_sum(X, ex, want_raw, clock)
+                if out is not None:
+                    clock.rung = "device_sum"
+                else:
+                    raw = self._raw(X, ex, clock)
+                    out = raw if want_raw else self._convert(raw)
             total = time.perf_counter() - t0
             telemetry.REGISTRY.timing("serve.predict").observe(total)
             accounted = sum(clock.stages.get(s, 0.0)
@@ -398,6 +555,66 @@ class ServingRuntime:
             clock.add("convert", max(total - accounted, 0.0))
         telemetry.REGISTRY.counter("serve.rows").inc(n)
         return out
+
+    # ------------------------------------------- rung 0: compiled tiles
+    def _compiled(self, X: np.ndarray, ex: Dict, want_raw: bool,
+                  clock: Optional[telemetry.StageClock] = None,
+                  ) -> Optional[np.ndarray]:
+        """Finished scores from the tiled Pallas program, or None when
+        the device-sum rung must take over (same chunk/degrade shape as
+        `_device_sum`)."""
+        stacked = ex["stacked"]
+        if X.shape[1] < stacked["min_features"] or X.shape[0] == 0:
+            return None
+        try:
+            outs = [self._compiled_chunk(
+                        X[lo:lo + self.max_batch_rows], ex, want_raw,
+                        clock)
+                    for lo in range(0, X.shape[0], self.max_batch_rows)]
+        except Exception as e:
+            telemetry.REGISTRY.counter("serve.device_errors").inc()
+            telemetry.event("serve.device_error", model=self.name,
+                            path="compiled", error=str(e)[:200])
+            return None
+        telemetry.REGISTRY.counter("serve.compiled").inc()
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def _compiled_chunk(self, Xc: np.ndarray, ex: Dict, want_raw: bool,
+                        clock: Optional[telemetry.StageClock] = None,
+                        ) -> np.ndarray:
+        if clock is None:
+            clock = telemetry.StageClock()
+        b = bucket_rows(Xc.shape[0], self.max_batch_rows)
+        t = time.perf_counter()
+        Xd = self._stage32(Xc, b)
+        clock.add("stage_copy", time.perf_counter() - t)
+        K = ex["num_class"]
+        cls = ex["stacked"].get("cls") if K > 1 else None
+        conv = None if want_raw else self._booster.objective_.convert_output
+        # interpret off-TPU: parity machinery stays testable everywhere
+        interp = jax.default_backend() != "tpu"
+        t = time.perf_counter()
+        out = compiled_predict(Xd, self._plan_planes, self._plan_gidx,
+                               ex["value_hi"], ex["value_lo"], cls,
+                               meta=self._plan_meta, n_class=K,
+                               convert=conv, interpret=interp)
+        clock.add("dispatch", time.perf_counter() - t)
+        n = Xc.shape[0]
+        if want_raw:
+            t = time.perf_counter()
+            hi = np.asarray(jax.device_get(out[0]))
+            lo = np.asarray(jax.device_get(out[1]))
+            clock.add("d2h", time.perf_counter() - t)
+            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                hi.nbytes + lo.nbytes)
+            raw = ((hi.astype(np.uint64) << np.uint64(32))
+                   | lo).view(np.float64)
+            return raw[:n]
+        t = time.perf_counter()
+        o = np.asarray(jax.device_get(out))
+        clock.add("d2h", time.perf_counter() - t)
+        telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
+        return o[:n]
 
     # ----------------------------------------------- rung 1: device sum
     def _device_sum(self, X: np.ndarray, ex: Dict, want_raw: bool,
@@ -470,9 +687,23 @@ class ServingRuntime:
         if clock is not None:
             clock.rung = "slot_path" if slots is not None else "host_walk"
         if trees and slots is None:
-            # host fallback (tree.py walk, exact f64) — device error,
-            # linear trees, or an X too narrow for the stacked arrays
-            telemetry.REGISTRY.counter("serve.fallbacks").inc()
+            # host fallback (tree.py walk, exact f64) — the labeled
+            # counter makes the WHY diagnosable from /metrics alone:
+            # linear_tree (no stacked planes), forced (X too narrow /
+            # empty), probe_fail (device errors on a runtime whose
+            # refresh-time parity probes already failed — the smoking
+            # gun for a silently miscompiling device), device_error
+            stacked = ex["stacked"]
+            if stacked is None:
+                cause = "linear_tree"
+            elif X.shape[1] < stacked["min_features"] or n == 0:
+                cause = "forced"
+            elif self._probe_failed:
+                cause = "probe_fail"
+            else:
+                cause = "device_error"
+            telemetry.REGISTRY.counter("serve.host_walk",
+                                       cause=cause).inc()
             with telemetry.span("serve.fallback", model=self.name,
                                 rows=n):
                 for i, t in enumerate(trees):

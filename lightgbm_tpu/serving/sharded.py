@@ -13,9 +13,10 @@ index), then dispatch the per-replica chunk lists concurrently.  Under
 fixed scheduling (quiesced replicas) the same input always takes the
 same stripes.
 
-Every replica serves through the unchanged 3-rung fallback ladder, so
-each stripe is byte-identical to ``booster.predict`` no matter which
-device ran it — and a wedged device degrades ONLY its replica (its
+Every replica serves through the unchanged 4-rung fallback ladder
+(compiled tiles included — each replica compiles and pins its own
+plan), so each stripe is byte-identical to ``booster.predict`` no
+matter which device ran it — and a wedged device degrades ONLY its replica (its
 rungs fall back per call; the other replicas never see the error).
 
 Telemetry: ``serve.replicas`` / ``serve.replica.<i>.outstanding``
@@ -47,7 +48,7 @@ from .runtime import DEFAULT_MAX_BATCH_ROWS, ServingRuntime
 #: fallback-ladder rungs from best to most degraded — a striped call
 #: reports the WORST rung any of its chunks used, so a single wedged
 #: replica is visible on the merged trace
-_RUNG_ORDER = ("device_sum", "slot_path", "host_walk")
+_RUNG_ORDER = ("compiled", "device_sum", "slot_path", "host_walk")
 
 
 def resolve_shard_devices(n: int) -> List:
@@ -82,7 +83,9 @@ class ShardedServingRuntime:
                  start_iteration: int = 0,
                  num_iteration: Optional[int] = None,
                  name: str = "default",
-                 device_sum: str = "auto"):
+                 device_sum: str = "auto",
+                 compiled: str = "auto",
+                 tile_vmem_kb: float = 512.0):
         if devices is None:
             devices = resolve_shard_devices(shard_devices)
         if not devices:
@@ -92,12 +95,15 @@ class ShardedServingRuntime:
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self.devices = list(devices)
         # replica 0 exports (and caches) the arrays; the rest replicate
-        # that cached export onto their own device
+        # that cached export onto their own device — each replica builds
+        # and pins its OWN compiled tile plan (the planes live on the
+        # replica's device, so a shared plan would defeat the striping)
         self._replicas = [
             ServingRuntime(booster, max_batch_rows=self.max_batch_rows,
                            start_iteration=start_iteration,
                            num_iteration=num_iteration,
                            name=f"{name}.r{i}", device_sum=device_sum,
+                           compiled=compiled, tile_vmem_kb=tile_vmem_kb,
                            device=dev)
             for i, dev in enumerate(self.devices)]
         self._sched_lock = threading.Lock()
@@ -127,6 +133,10 @@ class ShardedServingRuntime:
     @property
     def device_sum_active(self) -> bool:
         return self._replicas[0].device_sum_active
+
+    @property
+    def compiled_active(self) -> bool:
+        return self._replicas[0].compiled_active
 
     @property
     def booster(self):

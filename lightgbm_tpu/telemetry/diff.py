@@ -127,8 +127,10 @@ RULES: List[Tuple[str, str, str]] = [
     # throughput are wall-clock (timing class, CPU-fallback noise
     # warns); shed growth means overload handling regressed and fails
     # hard; queue/in-flight/model-count gauges and traffic counters are
-    # load-dependent bookkeeping.  serve.fallbacks is caught by the
-    # *fallback* rule above; shed/device-error growth fails hard here
+    # load-dependent bookkeeping.  serve.host_walk{cause=} growth means
+    # requests degraded all the way to the host walk — fail hard (the
+    # old unlabeled serve.fallbacks was caught by the *fallback* rule
+    # above); shed/device-error growth fails hard here
     ("*serving.p50_ms", "up_is_bad", "timing"),
     ("*serving.p99_ms", "up_is_bad", "timing"),
     ("*serving.rows_per_sec", "down_is_bad", "timing"),
@@ -139,6 +141,24 @@ RULES: List[Tuple[str, str, str]] = [
     # comparison block is informational (the rung we WANT to lose).
     ("*serve.device_sum_disabled", "up_is_bad", "counter"),
     ("*serve.demotions", "up_is_bad", "counter"),
+    # compiled rung sentinels (ISSUE 13): same shape as device_sum —
+    # `active` flipping 1 -> 0 or the per-cause disabled counters
+    # growing means the tile planes silently stopped serving; host_walk
+    # growth means requests fell all the way off the ladder.  Tile /
+    # plane-byte counts are identity (a plan that changes shape on the
+    # same model is a packer bug caught elsewhere); compile.plan.* is
+    # build-time bookkeeping.
+    ("*serve.host_walk*", "up_is_bad", "counter"),
+    # cause=platform is the designed CPU outcome of serve_compiled=auto
+    # (the rung is TPU-only by default), not a degradation
+    ("*serve.compiled_disabled{cause=platform}", "ignore", "counter"),
+    ("*serve.compiled_disabled*", "up_is_bad", "counter"),
+    ("*serving.compiled.active", "down_is_bad", "counter"),
+    ("*serving.compiled.rows_per_sec", "down_is_bad", "timing"),
+    ("*serving.compiled.p50_ms", "up_is_bad", "timing"),
+    ("*serving.compiled.p99_ms", "up_is_bad", "timing"),
+    ("*serving.compiled.*", "ignore", "counter"),
+    ("*compile.plan.*", "ignore", "counter"),
     ("*serving.device_sum.active", "down_is_bad", "counter"),
     ("*serving.device_sum.d2h_bytes_per_row", "up_is_bad", "counter"),
     ("*serving.device_sum.rows_per_sec", "down_is_bad", "timing"),

@@ -27,13 +27,19 @@ class Counter:
     `inc` takes a per-instance lock: `+=` on a shared int is two bytecodes
     and the serving threads hammer the same counters concurrently, so
     relying on GIL scheduling would lose increments under contention.
+
+    `labels` (sorted `(key, value)` pairs, like `Gauge`/`Histogram`) let
+    one counter name carry per-cause series — `serve.host_walk{cause=}` —
+    rendered as Prometheus labels on export and as `name{k=v}` keys in
+    snapshots.  Label-free counters are unchanged.
     """
 
-    __slots__ = ("name", "value", "_lock")
+    __slots__ = ("name", "value", "labels", "_lock")
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, labels: Tuple[Tuple[str, str], ...] = ()):
         self.name = name
         self.value = 0
+        self.labels = tuple(labels)
         self._lock = threading.Lock()
 
     def inc(self, n: int = 1) -> None:
@@ -236,12 +242,23 @@ class MetricsRegistry:
         self._timings: Dict[str, Timing] = {}
         self._histograms: Dict[str, Histogram] = {}
 
-    def counter(self, name: str) -> Counter:
+    def counter(self, name: str, **labels: str) -> Counter:
+        """One counter per (name, label-set); labels become Prometheus
+        labels on the exported series and `name{k=v}` snapshot keys
+        (`serve.host_walk{cause=device_error}`).  Label-free callers are
+        unchanged."""
+        lab = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        key = _hist_key(name, lab)
         with self._lock:
-            m = self._counters.get(name)
+            m = self._counters.get(key)
             if m is None:
-                m = self._counters[name] = Counter(name)
+                m = self._counters[key] = Counter(name, lab)
             return m
+
+    def counter_family(self, name: str) -> List[Counter]:
+        """Every label variant registered under one counter name."""
+        with self._lock:
+            return [c for c in self._counters.values() if c.name == name]
 
     def gauge(self, name: str, **labels: str) -> Gauge:
         """One gauge per (name, label-set); labels become Prometheus
@@ -343,10 +360,19 @@ class MetricsRegistry:
 
         lines = []
         with self._lock:
-            for n, c in sorted(self._counters.items()):
+            cgroups: Dict[str, List[Counter]] = {}
+            for key in sorted(self._counters):
+                c = self._counters[key]
+                cgroups.setdefault(c.name, []).append(c)
+            for n, cs in sorted(cgroups.items()):
                 m = norm(n)
                 lines.append(f"# TYPE {m} counter")
-                lines.append(f"{m} {c.value}")
+                for c in cs:
+                    lab = ",".join(
+                        f'{k}="{_escape_label_value(v)}"'
+                        for k, v in c.labels)
+                    suf = "{" + lab + "}" if lab else ""
+                    lines.append(f"{m}{suf} {c.value}")
             ggroups: Dict[str, List[Gauge]] = {}
             for key in sorted(self._gauges):
                 g = self._gauges[key]

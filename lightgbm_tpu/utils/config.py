@@ -258,6 +258,17 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # after the export-time parity probe bit-matches the host f64
     # reference; "force" skips the probe; "off" pins the slot path
     "serve_device_sum": ("auto", "str", ("device_sum",)),
+    # compiled serving rung (lightgbm_tpu/compiler/): quantized
+    # tree-tile planes + fused Pallas traverse kernel above the
+    # device-sum rung.  "auto" enables it on TPU backends only, after
+    # the refresh-time byte-parity probe passes; "on" also allows
+    # interpreted CPU execution (still probe-gated); "force" skips the
+    # probe; "off" pins the existing ladder
+    "serve_compiled": ("auto", "str", ("compiled",)),
+    # compiler tile budget: the packed planes of one tree tile (node
+    # words + threshold palette + categorical bitsets) must fit this
+    # many KB, so a tile's working set stays VMEM-resident
+    "serve_tile_vmem_kb": (512.0, "float", ("tile_vmem_kb",)),
     # co-residency budget for registry exports in MB (stacked traversal
     # planes + leaf-value bit planes); a load over budget demotes LRU
     # entries to host copies and, still over, is rejected with a clear

@@ -190,6 +190,90 @@ print("[run_ci] device-sum smoke: exact parity, "
       f"{moved} B D2H for 2x300x{K} scores")
 EOF
 
+# compiled-rung smoke (ISSUE 13): a golden model behind the HTTP
+# frontend with serve_compiled=on — the tile-plane parity probe must
+# pass, /predict must come off the compiled rung byte-identical to
+# booster.predict, and a doctored plan (one corrupted node word) must be
+# probe-rejected at refresh time and degrade to the next rung with zero
+# request errors and identical bytes.  The per-family / ragged / cause
+# matrix lives in tests/test_serving_compiler.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import ServingClient
+import lightgbm_tpu.serving.runtime as srt
+from lightgbm_tpu.serving.http import make_server
+
+bst = Booster(model_file="tests/data/golden_multiclass.model.txt")
+X, _ = make_case_data(GOLDEN_CASES["multiclass"])
+X = np.ascontiguousarray(X[:128])
+client = ServingClient(bst, params={"serve_warmup": False,
+                                    "serve_compiled": "on",
+                                    "serve_max_wait_ms": 0.0})
+rt = client.registry.get().runtime
+assert rt.compiled_active, "compiled parity probe failed on CPU"
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+cc = telemetry.REGISTRY.counter("serve.compiled")
+before = cc.value
+body = json.dumps({"rows": X.tolist()}).encode()
+req = urllib.request.Request(f"http://127.0.0.1:{port}/predict",
+                             data=body,
+                             headers={"Content-Type": "application/json"})
+resp = json.loads(urllib.request.urlopen(req, timeout=120).read())
+got = np.asarray(resp["predictions"], np.float64)
+want = bst.predict(X)
+assert got.shape == want.shape and np.array_equal(got, want), \
+    "compiled /predict != booster.predict"
+assert cc.value > before, "response did not come off the compiled rung"
+tiles = rt._plan.num_tiles()
+srv.shutdown()
+srv.server_close()
+client.close()
+
+# doctored plan: reroute one child word — the refresh-time probe must
+# reject it (cause=probe) and serving must keep its exact bytes one
+# rung down, with zero errors
+orig_build = srt.build_plan
+
+
+def doctored(ex, **kw):
+    plan = orig_build(ex, **kw)
+    plan.planes[0]["kids"][0, 0, 0] = (3 << 16) | 3
+    return plan
+
+
+srt.build_plan = doctored
+try:
+    dis = telemetry.REGISTRY.counter("serve.compiled_disabled",
+                                     cause="probe")
+    dis_before = dis.value
+    client2 = ServingClient(bst, params={"serve_warmup": False,
+                                         "serve_compiled": "on",
+                                         "serve_max_wait_ms": 0.0})
+    rt2 = client2.registry.get().runtime
+    assert not rt2.compiled_active, "doctored plan passed the probe"
+    assert dis.value == dis_before + 1, "cause=probe not recorded"
+    got2 = client2.predict(X)
+    assert np.array_equal(got2, want), "degraded rung changed bytes"
+    client2.close()
+finally:
+    srt.build_plan = orig_build
+print(f"[run_ci] compiled smoke: HTTP parity off the compiled rung "
+      f"({tiles} tiles), doctored plan probe-rejected with exact "
+      "degradation")
+EOF
+
 # external-memory smoke: a dataset ~4x the datastore budget trains via
 # the spilled shard store and must be byte-identical to the in-memory
 # model, with the prefetch pipeline's host residency inside the budget

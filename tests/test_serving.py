@@ -368,7 +368,10 @@ def test_device_error_falls_back_to_host_walk(monkeypatch):
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)
     assert rt.device_sum_active
-    fb = telemetry.REGISTRY.counter("serve.fallbacks")
+    # the probes passed, so the host walk must be attributed to the
+    # device error — not probe_fail — in the labeled cause counter
+    fb = telemetry.REGISTRY.counter("serve.host_walk",
+                                    cause="device_error")
     de = telemetry.REGISTRY.counter("serve.device_errors")
     before_fb, before_de = fb.value, de.value
 
@@ -389,7 +392,8 @@ def test_device_sum_error_degrades_one_rung_only(monkeypatch):
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)
     assert rt.device_sum_active
-    fb = telemetry.REGISTRY.counter("serve.fallbacks")
+    fb = telemetry.REGISTRY.counter("serve.host_walk",
+                                    cause="device_error")
     sp = telemetry.REGISTRY.counter("serve.slot_path")
     before_fb, before_sp = fb.value, sp.value
 

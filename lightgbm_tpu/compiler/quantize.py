@@ -68,7 +68,10 @@ def pack_bucket(trees, bucket, mw: int) -> Tuple[Dict, List[Dict]]:
     n_tiles = len(bucket.tiles)
     tt = max(len(tile) for tile in bucket.tiles)
     ni = bucket.max_nodes
-    if ni > MAX_TILE_NODES:
+    if ni >= MAX_TILE_NODES:
+        # leaf slots run 0..ni (ni internal nodes have ni+1 leaves) and
+        # encode as ~slot, so the kids halves must hold -(ni+1):
+        # ni == 32768 would wrap ~32768 to +32767 — an INTERNAL index
         raise PlanNotCompilable(
             f"{ni} nodes per tree exceeds the kids word's int16 halves")
 

@@ -58,7 +58,7 @@ import jax.numpy as jnp
 
 from .. import telemetry
 from ..compiler import PlanNotCompilable, build_plan
-from ..compiler.kernel import compiled_predict
+from ..compiler.kernel import ROW_BLOCK, compiled_predict
 from ..ops.predict import predict_leaf_ensemble, predict_raw_ensemble_exact
 
 #: padding cap (and the micro-batcher's default flush threshold): with
@@ -76,6 +76,34 @@ _EXACT_JIT = jax.jit(predict_raw_ensemble_exact,
                      static_argnames=("n_class", "convert"))
 
 
+class _ServeState:
+    """Everything `predict` reads, published as ONE reference.
+
+    `refresh()` / `demote()` build a complete bundle off to the side —
+    export planes, compiled tile planes, rung gates, probe verdicts —
+    and assign it to `ServingRuntime._state` in a single store.  A
+    request that snapshots the state mid-refresh therefore computes
+    with the whole old model or the whole new one, never the old plan's
+    tiles over the new export's leaf values: the background
+    auto-refresh (registry._background_refresh) runs seconds of device
+    probes concurrently with live predict callers."""
+
+    __slots__ = ("export", "device_sum_ok", "compiled_ok", "plan",
+                 "plan_planes", "plan_meta", "plan_gidx", "probe_failed",
+                 "demoted")
+
+    def __init__(self, export: Dict):
+        self.export = export
+        self.device_sum_ok = False
+        self.compiled_ok = False
+        self.plan = None
+        self.plan_planes = None
+        self.plan_meta = None
+        self.plan_gidx = None
+        self.probe_failed = False
+        self.demoted = False
+
+
 def bucket_rows(n: int, max_rows: int = DEFAULT_MAX_BATCH_ROWS) -> int:
     """Smallest power of two >= n, clamped to [1, max_rows].
 
@@ -91,9 +119,11 @@ def bucket_rows(n: int, max_rows: int = DEFAULT_MAX_BATCH_ROWS) -> int:
 class ServingRuntime:
     """Serves one exported model through bucket-padded device programs.
 
-    Thread-safe: `predict` snapshots the export once per call, and
-    `refresh` swaps it atomically — concurrent requests either see the
-    whole old model or the whole new one, never a mix.
+    Thread-safe: `predict` snapshots the published `_ServeState` once
+    per call, and `refresh`/`demote` build a complete replacement
+    bundle and publish it in a single assignment — concurrent requests
+    either see the whole old model (export AND compiled plan) or the
+    whole new one, never a mix.
 
     `device_sum` selects the device-sum rung: "auto" (default) enables
     the exact device-sum program only after the export-time parity
@@ -122,16 +152,9 @@ class ServingRuntime:
         self._start = start_iteration
         self._num = num_iteration
         self._device_sum_mode = str(device_sum).lower()
-        self._device_sum_ok = False
         self._compiled_mode = str(compiled).lower()
         self._tile_vmem_kb = float(tile_vmem_kb)
-        self._compiled_ok = False
-        self._plan = None
-        self._plan_planes = None
-        self._plan_meta = None
-        self._plan_gidx = None
-        self._probe_failed = False
-        self.demoted = False
+        self._state = _ServeState({})
         #: pin every device array (export planes + staged inputs) to one
         #: device — the sharded serving plane builds one pinned runtime
         #: per mesh device (serving/sharded.py).  None = default device,
@@ -140,7 +163,6 @@ class ServingRuntime:
         self._refresh_lock = threading.Lock()
         self._staging_lock = threading.Lock()
         self._staging: Dict = {}
-        self._export: Dict = {}
         self.refresh()
 
     # ------------------------------------------------------------ export
@@ -152,13 +174,21 @@ class ServingRuntime:
         parity probes against the new export and re-promotes a demoted
         runtime."""
         with self._refresh_lock:
-            self._export = self._pin_export(
+            ex = self._pin_export(
                 self._booster.export_predict_arrays(self._start,
                                                     self._num))
-            self.demoted = False
-            self._probe_failed = False
-            self._device_sum_ok = self._device_sum_enable(self._export)
-            self._compiled_ok = self._compiled_enable(self._export)
+            # two-phase publish, each phase a complete self-consistent
+            # bundle (a request snapshots exactly one of them — never
+            # the OLD plan's tiles over the NEW export's leaf values):
+            #  1. the new export with no device rungs — fresh bytes are
+            #     visible immediately via the exact slot path while the
+            #     probes below run seconds of device work;
+            #  2. the same export with its probed rungs attached.
+            self._state = _ServeState(ex)
+            st = _ServeState(ex)
+            st.device_sum_ok = self._device_sum_enable(ex, st)
+            st.compiled_ok = self._compiled_enable(ex, st)
+            self._state = st
 
     def _pin_export(self, ex: Dict) -> Dict:
         """Copy the export's device arrays onto this runtime's pinned
@@ -184,6 +214,26 @@ class ServingRuntime:
                     ex[k] = jax.device_put(ex[k], self.device)
         return ex
 
+    # Read-only views of the published state — tests and the ops
+    # surface peek at these; the serving path itself snapshots `_state`
+    # once per call and never reads them as separate live attributes.
+    @property
+    def _export(self) -> Dict:
+        return self._state.export
+
+    @property
+    def _plan(self):
+        return self._state.plan
+
+    @property
+    def _plan_planes(self):
+        return self._state.plan_planes
+
+    @property
+    def demoted(self) -> bool:
+        """Is the published bundle host-resident (post LRU demotion)?"""
+        return self._state.demoted
+
     def stale(self) -> bool:
         """Has the booster mutated since the last refresh()?"""
         return self._export["version"] != getattr(
@@ -198,12 +248,12 @@ class ServingRuntime:
     @property
     def device_sum_active(self) -> bool:
         """Is the device-sum rung serving (probe passed, not off)?"""
-        return self._device_sum_ok
+        return self._state.device_sum_ok
 
     @property
     def compiled_active(self) -> bool:
         """Is the compiled tile rung serving (plan built, probe passed)?"""
-        return self._compiled_ok
+        return self._state.compiled_ok
 
     @property
     def num_class(self) -> int:
@@ -217,19 +267,20 @@ class ServingRuntime:
         traversal planes + leaf-value bit planes + compiled tile
         planes) — the registry's `serve_vram_budget_mb` accounting
         unit.  0 after `demote()`."""
-        ex = self._export
-        if self.demoted or not ex:
+        st = self._state
+        ex = st.export
+        if st.demoted or not ex:
             return 0
         total = 0
-        st = ex.get("stacked")
-        if st:
-            total += sum(int(v.nbytes) for v in st.values()
+        stacked = ex.get("stacked")
+        if stacked:
+            total += sum(int(v.nbytes) for v in stacked.values()
                          if hasattr(v, "nbytes"))
         for k in ("value_hi", "value_lo"):
             if ex.get(k) is not None:
                 total += int(ex[k].nbytes)
-        if self._plan_planes is not None:
-            total += sum(int(a.nbytes) for bucket in self._plan_planes
+        if st.plan_planes is not None:
+            total += sum(int(a.nbytes) for bucket in st.plan_planes
                          for a in bucket if a is not None)
         return total
 
@@ -243,35 +294,38 @@ class ServingRuntime:
             freed = self.device_bytes()
             if freed == 0:
                 return 0
-            # the compiled planes exist ONLY on device — drop the rung
-            # entirely (the next refresh() rebuilds and re-probes it)
-            self._compiled_ok = False
-            self._plan = None
-            self._plan_planes = None
-            self._plan_meta = None
-            self._plan_gidx = None
-            ex = dict(self._export)
-            st = ex.get("stacked")
-            if st:
+            cur = self._state
+            ex = dict(cur.export)
+            stacked = ex.get("stacked")
+            if stacked:
                 ex["stacked"] = {
                     k: np.asarray(v) if isinstance(v, jax.Array) else v
-                    for k, v in st.items()}
+                    for k, v in stacked.items()}
             for k in ("value_hi", "value_lo"):
                 if ex.get(k) is not None:
                     ex[k] = np.asarray(ex[k])
-            self._export = ex
+            # the compiled planes exist ONLY on device — the demoted
+            # bundle drops that rung entirely (the next refresh()
+            # rebuilds and re-probes it); the device-sum rung survives,
+            # re-uploading the host copies per call
+            st = _ServeState(ex)
+            st.device_sum_ok = cur.device_sum_ok
+            st.probe_failed = cur.probe_failed
+            st.demoted = True
             # the booster-side export cache pins the same device
             # buffers — drop it so they can actually free
             if getattr(self._booster, "_serving_export_cache",
                        None) is not None:
                 self._booster._serving_export_cache = None
-            self.demoted = True
+            self._state = st
         telemetry.REGISTRY.counter("serve.demotions").inc()
         return freed
 
     # -------------------------------------------------- device-sum gate
-    def _device_sum_enable(self, ex: Dict) -> bool:
-        """Decide the top ladder rung for this export (refresh-time)."""
+    def _device_sum_enable(self, ex: Dict, st: _ServeState) -> bool:
+        """Decide the top ladder rung for this export (refresh-time);
+        probe verdicts land on the in-construction bundle `st`, which
+        refresh() publishes whole."""
         if self._device_sum_mode == "off":
             return False
         if ex["stacked"] is None or not ex["trees"] \
@@ -285,7 +339,7 @@ class ServingRuntime:
             return True
         ok = self._probe_device_sum(ex)
         if not ok:
-            self._probe_failed = True
+            st.probe_failed = True
             telemetry.REGISTRY.counter("serve.device_sum_disabled").inc()
             telemetry.event("serve.device_sum_disabled", model=self.name)
         return ok
@@ -362,16 +416,13 @@ class ServingRuntime:
         telemetry.event("serve.compiled_disabled", model=self.name,
                         cause=cause, detail=detail[:200])
 
-    def _compiled_enable(self, ex: Dict) -> bool:
+    def _compiled_enable(self, ex: Dict, st: _ServeState) -> bool:
         """Decide the compiled tile rung for this export (refresh-time):
-        build the plan, pin its planes, then demand byte parity on the
-        probe batch.  ANY refusal lands in
-        `serve.compiled_disabled{cause=}` and the ladder below serves —
-        a model that cannot compile is a degradation, never an error."""
-        self._plan = None
-        self._plan_planes = None
-        self._plan_meta = None
-        self._plan_gidx = None
+        build the plan, pin its planes onto the in-construction bundle
+        `st`, then demand byte parity on the probe batch.  ANY refusal
+        lands in `serve.compiled_disabled{cause=}` and the ladder below
+        serves — a model that cannot compile is a degradation, never an
+        error."""
         mode = self._compiled_mode
         if mode == "off":
             return False
@@ -403,25 +454,25 @@ class ServingRuntime:
         gidx = jnp.asarray(plan.gather_idx)
         if self.device is not None:
             gidx = jax.device_put(gidx, self.device)
-        self._plan = plan
-        self._plan_planes = tuple(planes)
-        self._plan_meta = tuple(
+        st.plan = plan
+        st.plan_planes = tuple(planes)
+        st.plan_meta = tuple(
             (p["depth"], p["catw"].shape[-1] if "catw" in p else 0)
             for p in plan.planes)
-        self._plan_gidx = gidx
+        st.plan_gidx = gidx
         if mode == "force":
             return True
-        ok = self._probe_compiled(ex)
+        ok = self._probe_compiled(st)
         if not ok:
-            self._probe_failed = True
+            st.probe_failed = True
             self._disable_compiled("probe")
-            self._plan = None
-            self._plan_planes = None
-            self._plan_meta = None
-            self._plan_gidx = None
+            st.plan = None
+            st.plan_planes = None
+            st.plan_meta = None
+            st.plan_gidx = None
         return ok
 
-    def _probe_compiled(self, ex: Dict) -> bool:
+    def _probe_compiled(self, st: _ServeState) -> bool:
         """Refresh-time exact-parity gate for the compiled rung: the
         tiled kernel's accumulated bits — raw AND converted — must
         match the host f64 gather/sum over the slot program's device
@@ -429,6 +480,7 @@ class ServingRuntime:
         reference `_probe_device_sum` holds the device-sum rung to).
         Exceptions count as failed probes."""
         try:
+            ex = st.export
             X = self._probe_batch(ex, rows=min(256, self.max_batch_rows))
             slots = self._device_slots_chunk(X, ex["stacked"])
             K = ex["num_class"]
@@ -438,13 +490,13 @@ class ServingRuntime:
                 want[:, i % K] += leaf_values[i, slots[i]]
             if K == 1:
                 want = want[:, 0]
-            got = self._compiled_chunk(X, ex, want_raw=True)
+            got = self._compiled_chunk(X, st, want_raw=True)
             if got.shape != want.shape or not np.array_equal(
                     got.view(np.uint64), want.view(np.uint64)):
                 return False
             obj = self._booster.objective_
             if obj is not None:
-                got_c = self._compiled_chunk(X, ex, want_raw=False)
+                got_c = self._compiled_chunk(X, st, want_raw=False)
                 want_c = self._convert(want)
                 if got_c.shape != want_c.shape \
                         or got_c.dtype != want_c.dtype \
@@ -477,24 +529,34 @@ class ServingRuntime:
         feature width — the jit caches are keyed on [bucket, F], so
         warming a narrower matrix would not count.  Returns the number
         of buckets warmed (0 when the model is host-walk only)."""
-        ex = self._export
+        st = self._state
+        ex = st.export
         if ex["stacked"] is None or not ex["trees"]:
             return 0
         nf = max(self.num_feature(), int(ex["stacked"]["min_features"]))
         sizes = self.buckets()
         obj = self._booster.objective_
         K = ex["num_class"]
+        compiled_ok = st.compiled_ok
         with telemetry.span("serve.warmup", model=self.name,
                             buckets=len(sizes)):
             t0 = time.perf_counter()
             for b in sizes:
                 Z = np.zeros((b, nf), np.float64)
                 self._device_slots_chunk(Z, ex["stacked"])
-                if self._compiled_ok:
-                    self._compiled_chunk(Z, ex, want_raw=True)
-                    if obj is not None:
-                        self._compiled_chunk(Z, ex, want_raw=False)
-                if self._device_sum_ok:
+                if compiled_ok:
+                    try:
+                        self._compiled_chunk(Z, st, want_raw=True)
+                        if obj is not None:
+                            self._compiled_chunk(Z, st, want_raw=False)
+                    except Exception as e:
+                        # degrade-don't-error, same contract as the
+                        # predict path: a rung that cannot even warm
+                        # must not fail the model load — retire it and
+                        # keep warming the surviving ladder
+                        compiled_ok = False
+                        self._drop_compiled(st, "warmup_error", str(e))
+                if st.device_sum_ok:
                     self._device_sum_chunk(Z, ex, want_raw=True)
                     if obj is not None:
                         self._device_sum_chunk(Z, ex, want_raw=False)
@@ -504,6 +566,23 @@ class ServingRuntime:
             telemetry.REGISTRY.timing("serve.warmup").observe(
                 time.perf_counter() - t0)
         return len(sizes)
+
+    def _drop_compiled(self, st: _ServeState, cause: str,
+                       detail: str = "") -> None:
+        """Retire the compiled rung from the PUBLISHED bundle (warmup
+        failures): republish the same export minus the plan — unless a
+        concurrent refresh/demote already swapped a newer bundle in, in
+        which case theirs wins."""
+        self._disable_compiled(cause, detail)
+        with self._refresh_lock:
+            cur = self._state
+            if cur is not st or not cur.compiled_ok:
+                return
+            new = _ServeState(cur.export)
+            new.device_sum_ok = cur.device_sum_ok
+            new.probe_failed = cur.probe_failed
+            new.demoted = cur.demoted
+            self._state = new
 
     # ----------------------------------------------------------- predict
     def predict(self, X, raw_score: bool = False,
@@ -530,22 +609,26 @@ class ServingRuntime:
         if X.ndim == 1:
             X = X.reshape(1, -1)
         n = X.shape[0]
-        ex = self._export
+        # ONE snapshot of the published bundle: every rung below reads
+        # export and plan from the same `st`, so a concurrent refresh
+        # can never mix this request across model versions
+        st = self._state
+        ex = st.export
         with telemetry.span("serve.predict", model=self.name, rows=n):
             t0 = time.perf_counter()
             want_raw = raw_score or self._booster.objective_ is None
             out = None
-            if self._compiled_ok and ex["trees"]:
-                out = self._compiled(X, ex, want_raw, clock)
+            if st.compiled_ok and ex["trees"]:
+                out = self._compiled(X, st, want_raw, clock)
             if out is not None:
                 clock.rung = "compiled"
             else:
-                if self._device_sum_ok and ex["trees"]:
+                if st.device_sum_ok and ex["trees"]:
                     out = self._device_sum(X, ex, want_raw, clock)
                 if out is not None:
                     clock.rung = "device_sum"
                 else:
-                    raw = self._raw(X, ex, clock)
+                    raw = self._raw(X, st, clock)
                     out = raw if want_raw else self._convert(raw)
             total = time.perf_counter() - t0
             telemetry.REGISTRY.timing("serve.predict").observe(total)
@@ -557,18 +640,18 @@ class ServingRuntime:
         return out
 
     # ------------------------------------------- rung 0: compiled tiles
-    def _compiled(self, X: np.ndarray, ex: Dict, want_raw: bool,
+    def _compiled(self, X: np.ndarray, st: _ServeState, want_raw: bool,
                   clock: Optional[telemetry.StageClock] = None,
                   ) -> Optional[np.ndarray]:
         """Finished scores from the tiled Pallas program, or None when
         the device-sum rung must take over (same chunk/degrade shape as
         `_device_sum`)."""
-        stacked = ex["stacked"]
+        stacked = st.export["stacked"]
         if X.shape[1] < stacked["min_features"] or X.shape[0] == 0:
             return None
         try:
             outs = [self._compiled_chunk(
-                        X[lo:lo + self.max_batch_rows], ex, want_raw,
+                        X[lo:lo + self.max_batch_rows], st, want_raw,
                         clock)
                     for lo in range(0, X.shape[0], self.max_batch_rows)]
         except Exception as e:
@@ -579,12 +662,22 @@ class ServingRuntime:
         telemetry.REGISTRY.counter("serve.compiled").inc()
         return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
 
-    def _compiled_chunk(self, Xc: np.ndarray, ex: Dict, want_raw: bool,
+    def _compiled_chunk(self, Xc: np.ndarray, st: _ServeState,
+                        want_raw: bool,
                         clock: Optional[telemetry.StageClock] = None,
                         ) -> np.ndarray:
         if clock is None:
             clock = telemetry.StageClock()
+        ex = st.export
         b = bucket_rows(Xc.shape[0], self.max_batch_rows)
+        if b > ROW_BLOCK and b % ROW_BLOCK:
+            # the kernel grid tiles rows in ROW_BLOCK blocks; an odd
+            # user cap (serve_max_batch_rows=3000) clamps the top
+            # bucket to a non-multiple — pad on up so the block spec
+            # divides.  Padding rows are zero and sliced away below;
+            # rows are independent, so the real rows' bytes are
+            # untouched.
+            b += ROW_BLOCK - b % ROW_BLOCK
         t = time.perf_counter()
         Xd = self._stage32(Xc, b)
         clock.add("stage_copy", time.perf_counter() - t)
@@ -594,9 +687,9 @@ class ServingRuntime:
         # interpret off-TPU: parity machinery stays testable everywhere
         interp = jax.default_backend() != "tpu"
         t = time.perf_counter()
-        out = compiled_predict(Xd, self._plan_planes, self._plan_gidx,
+        out = compiled_predict(Xd, st.plan_planes, st.plan_gidx,
                                ex["value_hi"], ex["value_lo"], cls,
-                               meta=self._plan_meta, n_class=K,
+                               meta=st.plan_meta, n_class=K,
                                convert=conv, interpret=interp)
         clock.add("dispatch", time.perf_counter() - t)
         n = Xc.shape[0]
@@ -675,10 +768,11 @@ class ServingRuntime:
         return o[:n]
 
     # ------------------------------------------- rungs 2+3: slots, host
-    def _raw(self, X: np.ndarray, ex: Dict,
+    def _raw(self, X: np.ndarray, st: _ServeState,
              clock: Optional[telemetry.StageClock] = None) -> np.ndarray:
         """Exact f64 raw scores: device leaf slots (bucketed) + host
         gather/sum in tree order — the host walk's summation, verbatim."""
+        ex = st.export
         trees = ex["trees"]
         K = ex["num_class"]
         n = X.shape[0]
@@ -698,7 +792,7 @@ class ServingRuntime:
                 cause = "linear_tree"
             elif X.shape[1] < stacked["min_features"] or n == 0:
                 cause = "forced"
-            elif self._probe_failed:
+            elif st.probe_failed:
                 cause = "probe_fail"
             else:
                 cause = "device_error"

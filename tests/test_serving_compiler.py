@@ -284,6 +284,105 @@ def test_compiled_device_error_degrades_one_rung(monkeypatch):
         == hw_before
 
 
+# ------------------------------------------- atomic publish + degrade
+def test_refresh_never_serves_mixed_state(monkeypatch):
+    # refresh() runs on a background thread while predict() keeps
+    # serving: a request landing mid-refresh (new export produced, the
+    # compiled probe still running) must compute with ONE whole model —
+    # never the old plan's tiles over the new export's leaf values
+    rng = np.random.RandomState(3)
+    X = rng.randn(600, 5)
+    y = (X[:, 0] - X[:, 1] + 0.3 * rng.randn(600) > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=5)
+    rt = ServingRuntime(bst, compiled="on")
+    assert rt.compiled_active
+    old = bst.predict(X[:64], raw_score=True)
+    assert np.array_equal(rt.predict(X[:64], raw_score=True), old)
+    bst.update()                               # same tree shapes (refit-
+    bst.best_iteration = -1                    # style hot refresh)
+    new = bst.predict(X[:64], raw_score=True)
+    assert not np.array_equal(old, new)
+    seen = {}
+    orig_build = srt.build_plan
+
+    def mid_refresh_probe(ex, **kw):
+        seen["mid"] = rt.predict(X[:64], raw_score=True)
+        return orig_build(ex, **kw)
+
+    monkeypatch.setattr(srt, "build_plan", mid_refresh_probe)
+    rt.refresh()
+    # mid-refresh bytes are EXACTLY one model's output (the rung-less
+    # phase-1 bundle serves the new export via the slot path)
+    assert np.array_equal(seen["mid"], new), \
+        "mid-refresh request mixed old plan with new export"
+    assert rt.compiled_active
+    assert np.array_equal(rt.predict(X[:64], raw_score=True), new)
+
+
+def test_compiled_odd_max_batch_rows_pads_row_block(monkeypatch):
+    # serve_max_batch_rows need not divide the kernel's ROW_BLOCK: the
+    # clamped top bucket (300 rows) pads on up to a multiple inside the
+    # compiled path, so load-time warmup and predict both serve instead
+    # of raising out of the pallas_call driver
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst, compiled="on", max_batch_rows=300)
+    assert rt.compiled_active
+    # warm ONLY the clamped bucket — the one size that can trip the
+    # kernel's row-block check (power-of-two buckets always divide);
+    # full-ladder warmup coverage lives in the precompile test above
+    monkeypatch.setattr(rt, "buckets", lambda: [300])
+    assert rt.warmup() == 1
+    assert rt.compiled_active, "clamped-bucket warmup dropped the rung"
+    cc = telemetry.REGISTRY.counter("serve.compiled")
+    before = cc.value
+    assert np.array_equal(rt.predict(X[:280], raw_score=True),
+                          bst.predict(X[:280], raw_score=True))
+    assert cc.value > before
+
+
+def test_warmup_compiled_failure_degrades_not_errors(monkeypatch):
+    # a compiled rung that cannot even warm must not fail the model
+    # load (registry.load calls warmup() with no predict-path guard
+    # around it): the rung retires with cause=warmup_error and the
+    # surviving ladder serves byte-identical results
+    bst, X = _golden("binary")
+    rt = ServingRuntime(bst, compiled="force", max_batch_rows=8)
+    assert rt.compiled_active
+
+    def boom(*a, **k):
+        raise RuntimeError("compile wedged")
+
+    monkeypatch.setattr(srt, "compiled_predict", boom)
+    dis = telemetry.REGISTRY.counter("serve.compiled_disabled",
+                                     cause="warmup_error")
+    before = dis.value
+    assert rt.warmup() > 0
+    assert dis.value == before + 1
+    assert not rt.compiled_active
+    assert np.array_equal(rt.predict(X[:8], raw_score=True),
+                          bst.predict(X[:8], raw_score=True))
+
+
+def test_quantize_refuses_tile_at_leaf_slot_capacity():
+    # leaf slots run 0..ni, encoded ~slot: ni == 2^15 would wrap the
+    # deepest leaf's ~32768 to +32767 (an internal-node index) in the
+    # kids word's int16 half — the packer must refuse AT the boundary
+    from lightgbm_tpu.compiler.plan import TileBucket
+    from lightgbm_tpu.compiler.quantize import MAX_TILE_NODES, pack_bucket
+    bst, _ = _golden("binary")
+    trees = bst.export_predict_arrays()["trees"]
+    bucket = TileBucket(depth=2)
+    bucket.tiles = [[0]]
+    bucket.max_nodes = MAX_TILE_NODES
+    with pytest.raises(PlanNotCompilable):
+        pack_bucket(trees, bucket, 0)
+    bucket.max_nodes = MAX_TILE_NODES - 1      # -(ni+1) = -32768 fits
+    planes, _ = pack_bucket(trees, bucket, 0)
+    assert planes["words"].shape[-1] == MAX_TILE_NODES - 1
+
+
 # ------------------------------------------------- host-walk cause labels
 def test_host_walk_cause_probe_fail(monkeypatch):
     # a runtime whose refresh-time parity probe FAILED that then hits a

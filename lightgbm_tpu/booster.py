@@ -1088,7 +1088,8 @@ class Booster:
                 self._dd.store, self._mesh, kind,
                 payload="bundle" if bundled else "bins",
                 pad_features=pad_features,
-                prefetch_depth=cfg.datastore_prefetch)
+                prefetch_depth=cfg.datastore_prefetch,
+                collective_timeout_ms=cfg.mesh_collective_timeout_ms)
         else:
             if self._dd.datastore_pending:
                 log.warning("tree_learner=feature with external_memory "

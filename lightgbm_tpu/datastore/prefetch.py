@@ -29,6 +29,15 @@ except ImportError:  # file-path load in a jax-free synthetic package
     class LightGBMError(RuntimeError):
         pass
 
+try:
+    from ..resilience import FAULTS
+except ImportError:  # same jax-free file-path load
+    class _NoFaults:
+        @staticmethod
+        def inject(site, payload=None):
+            return payload
+    FAULTS = _NoFaults()
+
 _DONE = object()
 
 
@@ -70,6 +79,7 @@ class ShardPrefetcher:
             for k, rel in self.plan:
                 if self._stop.is_set():
                     return
+                FAULTS.inject("prefetch.read")
                 block = self.store.load_shard(k, self.payload)
                 if rel is not None:
                     block = block[:, rel]

@@ -36,6 +36,7 @@ process; `fleet_max_retrains=N` bounds the run (CI smokes).
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 from typing import Dict, Optional
@@ -47,11 +48,19 @@ from ..basic import Dataset
 from ..booster import Booster
 from ..datastore.store import ShardStore, ShardWriter
 from ..engine import train as engine_train
+from ..resilience import FAULTS, Supervisor, read_state, write_state, \
+    write_text
 from ..utils import log
 from ..utils.config import Config, canonical_param_name
 from ..utils.log import LightGBMError
 from .drift import DriftMonitor
-from .shadow import ShadowGate, TrafficSampler
+from .shadow import GateVerdict, ShadowGate, TrafficSampler
+
+#: crash-safe daemon state, atomic + crc-stamped, next to the manifest
+STATE_FILE = "fleet_state.json"
+#: the live model's full text dump, rewritten at every accepted swap —
+#: what a restarted daemon reloads to resume the exact model chain
+MODEL_FILE = "fleet_model.txt"
 
 
 def create_fleet_store(dirpath: str, X, y, shard_rows: int = 4096,
@@ -94,6 +103,9 @@ class TrainerDaemon:
             if canonical_param_name(k) != "num_iterations"}
         self._train_params.setdefault("verbosity", -1)
         self.gate = ShadowGate(self._config)
+        #: watchdog lane for gate evaluations: a hung gate fails CLOSED
+        self._gate_sup = Supervisor(
+            "fleet.gate", self._config.fleet_gate_timeout_ms)
         self.sampler = TrafficSampler(self._config.fleet_sample_ring)
         if registry is not None:
             registry.attach_sampler(name, self.sampler)
@@ -106,12 +118,22 @@ class TrainerDaemon:
                 registry.attach_sampler(name, self.drift)
         store = ShardStore.open(store_dir)
         #: rows the live model has already trained through — the tail
-        #: mark; only rows beyond it count toward fleet_retrain_rows
+        #: mark; only rows beyond it count toward fleet_retrain_rows.
+        #: Without persisted state this falls back to the CURRENT row
+        #: count (the pre-resilience behaviour); `_recover` replaces it
+        #: with the crash-persisted mark so rows appended before a crash
+        #: but never trained through still count toward the next retrain
         self.trained_rows = store.n_rows
         self.generation = store.generation
         self.retrains = 0
         self.swaps = 0
         self.rejects = 0
+        self._state_seq = 0
+        self._recover(store)
+        if self.drift is not None and self._live is not booster:
+            # recovery reloaded a later chain link — the drift buckets
+            # must belong to the model now serving
+            self.drift.rebind(self._live)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         telemetry.REGISTRY.gauge("fleet.rows_seen").set(store.n_rows)
@@ -119,19 +141,130 @@ class TrainerDaemon:
         # continue from — everything later links back to this record
         telemetry.LEDGER.configure(self._config.fleet_ledger_ring)
         telemetry.LEDGER.record(
-            "root", model=name, fingerprint=booster.model_fingerprint(),
-            trees=len(booster.trees), rows=store.n_rows,
+            "root", model=name,
+            fingerprint=self._live.model_fingerprint(),
+            trees=len(self._live.trees), rows=store.n_rows,
             generation=store.generation)
 
     @property
     def live_booster(self) -> Booster:
         return self._live
 
+    # -------------------------------------------------- crash-safe state
+    def _state_path(self) -> str:
+        return os.path.join(self.store_dir, STATE_FILE)
+
+    def _model_path(self) -> str:
+        return os.path.join(self.store_dir, MODEL_FILE)
+
+    def _recover(self, store: ShardStore) -> None:
+        """Adopt the crash-persisted daemon state, if any.
+
+        Three outcomes, each counted under ``fleet.recover.*``:
+
+        - ``resumed``: the passed booster IS the persisted live model —
+          adopt the tail mark and counters;
+        - ``model_restored``: the persisted live model is a LATER chain
+          link (the process died after a swap) — reload it from
+          ``fleet_model.txt``, republish it to the registry, adopt the
+          mark.  The resumed chain is byte-identical to an
+          uninterrupted run because the swap's full model text was
+          persisted atomically before the crash;
+        - ``ignored``: the state belongs to a different model/chain
+          (or its model file is gone) — start fresh, as before.
+
+        A corrupt or truncated state file (crc/json failure) counts
+        ``fleet.recover.state_corrupt`` and starts fresh — fail open,
+        never wedge the daemon on its own scratch state.
+        """
+        path = self._state_path()
+        state = read_state(path)
+        if state is None:
+            if os.path.exists(path):
+                telemetry.REGISTRY.counter(
+                    "fleet.recover.state_corrupt").inc()
+                log.warning(f"fleet: {path} is corrupt; starting fresh")
+            return
+        if state.get("model") != self.name:
+            telemetry.REGISTRY.counter("fleet.recover.ignored").inc()
+            return
+        saved_fp = str(state.get("fingerprint", ""))
+        how = ""
+        if saved_fp == self._live.model_fingerprint():
+            how = "resumed"
+        else:
+            mp = self._model_path()
+            restored = None
+            if os.path.exists(mp):
+                try:
+                    cand = Booster(model_file=mp)
+                    if cand.model_fingerprint() == saved_fp:
+                        restored = cand
+                except LightGBMError:
+                    restored = None
+            if restored is None:
+                telemetry.REGISTRY.counter("fleet.recover.ignored").inc()
+                log.warning(
+                    f"fleet: persisted state for {self.name!r} does not "
+                    "match the passed booster and no matching "
+                    f"{MODEL_FILE} exists; starting fresh")
+                return
+            self._live = restored
+            if self.registry is not None:
+                # build-then-swap republish: serving resumes on the
+                # model that was live when the process died
+                self.registry.load(self.name, restored)
+            how = "model_restored"
+        self.trained_rows = min(int(state.get("trained_rows", 0)),
+                                store.n_rows)
+        self.retrains = int(state.get("retrains", 0))
+        self.swaps = int(state.get("swaps", 0))
+        self.rejects = int(state.get("rejects", 0))
+        self._state_seq = int(state.get("seq", 0))
+        if state.get("inflight"):
+            # the process died mid-continuation, after training but
+            # before the verdict landed: the candidate is discarded and
+            # its window retrains (the tail mark never advanced)
+            telemetry.REGISTRY.counter(
+                "fleet.recover.inflight_discarded").inc()
+        telemetry.REGISTRY.counter(f"fleet.recover.{how}").inc()
+        telemetry.LEDGER.record(
+            "recover", model=self.name, how=how,
+            fingerprint=self._live.model_fingerprint(),
+            trained_rows=self.trained_rows, state_seq=self._state_seq)
+        log.info(f"fleet: {how} {self.name!r} from {path} "
+                 f"(tail mark {self.trained_rows}, "
+                 f"seq {self._state_seq})")
+
+    def _persist(self, store: ShardStore, verdict=None,
+                 candidate_fp: str = "", inflight: str = "") -> None:
+        """Atomically rewrite ``fleet_state.json`` (tmp+rename, crc,
+        generation-stamped `seq`).  `inflight` carries the candidate
+        fingerprint while a continuation is between train and verdict."""
+        self._state_seq += 1
+        state = {
+            "model": self.name,
+            "fingerprint": self._live.model_fingerprint(),
+            "trained_rows": int(self.trained_rows),
+            "generation": int(store.generation),
+            "seq": self._state_seq,
+            "retrains": self.retrains,
+            "swaps": self.swaps,
+            "rejects": self.rejects,
+            "inflight": inflight,
+        }
+        if verdict is not None:
+            state["last_gate"] = {"passed": bool(verdict.passed),
+                                  "reason": verdict.reason[:200],
+                                  "candidate": candidate_fp}
+        write_state(self._state_path(), state)
+
     # ---------------------------------------------------------- the loop
     def step(self) -> bool:
         """One poll: re-open the manifest; when >= fleet_retrain_rows
         new rows have landed, retrain + gate + (maybe) swap.  Returns
         True when a retrain was attempted."""
+        FAULTS.inject("fleet.poll")
         store = ShardStore.open(self.store_dir)
         telemetry.REGISTRY.gauge("fleet.rows_seen").set(store.n_rows)
         if store.generation != self.generation:
@@ -169,11 +302,29 @@ class TrainerDaemon:
                 "continuation", model=self.name, candidate=cand_fp,
                 parent=parent_fp, rounds=int(cfg.fleet_rounds),
                 rows=len(X), generation=store.generation)
+            # inflight marker BEFORE the gate: a crash between here and
+            # the verdict is visible to the restarted daemon (the
+            # candidate is discarded, its window retrains)
+            self._persist(store, inflight=cand_fp)
             k = min(int(cfg.fleet_shadow_rows), len(X))
-            verdict = self.gate.evaluate(
-                self._live, candidate,
-                holdout=(X[len(X) - k:], y[len(y) - k:]),
-                traffic=self.sampler.sample(), model=self.name)
+
+            def _gate():
+                FAULTS.inject("fleet.gate")
+                return self.gate.evaluate(
+                    self._live, candidate,
+                    holdout=(X[len(X) - k:], y[len(y) - k:]),
+                    traffic=self.sampler.sample(), model=self.name)
+            try:
+                verdict = self._gate_sup.call(_gate)
+            except Exception as e:
+                # fail CLOSED: a gate that errors (or hangs past
+                # fleet_gate_timeout_ms) rejects the candidate — the
+                # live model keeps serving, never an unvetted swap
+                telemetry.REGISTRY.counter("fleet.gate.errors").inc()
+                verdict = GateVerdict(
+                    False, f"gate error: {str(e)[:200]}")
+                log.warning(f"fleet: gate for {self.name!r} failed "
+                            f"({e}); candidate rejected fail-closed")
             # the gate record carries the verdict's MEASURED evidence
             # next to the bounds it was judged against — the "why" the
             # pass/fail counters cannot answer
@@ -186,6 +337,12 @@ class TrainerDaemon:
         self.retrains += 1
         telemetry.REGISTRY.counter("fleet.retrains").inc()
         if verdict.passed:
+            # persist the full model text BEFORE the live pointer flips:
+            # a crash after this line resumes on the swapped model
+            # (byte-identical chain), a crash before it retrains the
+            # window against the old live model — either way the chain
+            # stays consistent
+            write_text(self._model_path(), candidate.model_to_string())
             if self.registry is not None:
                 # the existing build-then-swap path: the candidate is
                 # exported, admitted, warmed and batched BEFORE the name
@@ -215,6 +372,7 @@ class TrainerDaemon:
         # advance the tail mark either way: a rejected window must not
         # hot-spin retraining the same rows forever
         self.trained_rows = store.n_rows
+        self._persist(store, verdict=verdict, candidate_fp=cand_fp)
 
     def run(self) -> None:
         """Poll until stopped or `fleet_max_retrains` is exhausted."""
@@ -224,7 +382,10 @@ class TrainerDaemon:
         while not self._stop.is_set():
             try:
                 attempted = self.step()
-            except LightGBMError as e:
+            except Exception as e:
+                # the daemon loop must survive ANY poll failure — an
+                # injected fault or device error in one retrain window
+                # must not kill the tailing thread
                 telemetry.REGISTRY.counter("fleet.poll_errors").inc()
                 log.warning(f"fleet: poll failed ({e}); retrying")
                 attempted = False

@@ -25,6 +25,7 @@ from __future__ import annotations
 import jax
 import numpy as np
 
+from ..resilience import FAULTS, Supervisor
 from ..utils.log import LightGBMError
 from .compat import Mesh, NamedSharding, PartitionSpec as P
 
@@ -96,7 +97,8 @@ def collective_span(name: str, **attrs):
 def place_from_datastore(store, mesh: Mesh, kind: str,
                          payload: str = "bins",
                          pad_features: bool = True,
-                         prefetch_depth: int = 2):
+                         prefetch_depth: int = 2,
+                         collective_timeout_ms: float = 0.0):
     """Stream datastore shards straight into per-device row blocks.
 
     The sharded equivalent of ``datastore.assemble.assemble_feature_
@@ -143,6 +145,9 @@ def place_from_datastore(store, mesh: Mesh, kind: str,
     it = iter(pf)
     cur = None  # carried (row0, block) straddling a device boundary
     bufs = []
+    # one watchdog lane for the whole placement: a device_put that
+    # wedges raises DeviceTimeoutError instead of hanging assembly
+    sup = Supervisor("mesh.collective", collective_timeout_ms)
     with collective_span("place", kind=kind, rows=n, cols=f,
                          shards=S_total, payload=payload):
         try:
@@ -175,7 +180,10 @@ def place_from_datastore(store, mesh: Mesh, kind: str,
                                     device=int(dev.id), rows=rows_per):
                     # each staging block is committed then never mutated,
                     # so a zero-copy device_put alias is safe
-                    bufs.append(jax.device_put(host, dev))
+                    def _put(host=host, dev=dev):
+                        FAULTS.inject("mesh.collective")
+                        return jax.device_put(host, dev)
+                    bufs.append(sup.call(_put))
         finally:
             pf.close()
             peak_mb = pf.peak_resident_bytes / (1024.0 * 1024.0)

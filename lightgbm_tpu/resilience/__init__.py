@@ -1,0 +1,31 @@
+"""Resilience plane: fault injection, watchdogs, breakers, safe state.
+
+Four small, stdlib-only pieces threaded through serving, fleet,
+datastore and mesh (see docs/RESILIENCE.md for the full contract):
+
+ - ``FAULTS`` / ``FaultPlane`` (faults.py) — named injection sites
+   arming exceptions, latency, hangs and payload corruption, so every
+   degradation path is exercised deliberately;
+ - ``Supervisor`` / ``DeviceTimeoutError`` (supervise.py) — deadline-
+   bounded calls at every device boundary: a wedged device costs one
+   deadline, not a wedged process;
+ - ``CircuitBreaker`` (breaker.py) — per-rung open/half_open/closed
+   gating with exponential-backoff background re-probes, so transient
+   device errors recover without a manual refresh (content mismatches
+   stay permanent by design);
+ - ``write_state`` / ``read_state`` (state.py) — crc-stamped atomic
+   JSON for restart-safe daemon state.
+
+Like telemetry/, this package NEVER imports jax.
+"""
+from .breaker import (CLOSED, HALF_OPEN, OPEN, PERMANENT, CircuitBreaker)
+from .faults import FAULTS, FaultInjected, FaultPlane, FaultSpec
+from .supervise import DeviceTimeoutError, Supervisor
+from .state import read_state, write_state, write_text
+
+__all__ = [
+    "CLOSED", "HALF_OPEN", "OPEN", "PERMANENT", "CircuitBreaker",
+    "FAULTS", "FaultInjected", "FaultPlane", "FaultSpec",
+    "DeviceTimeoutError", "Supervisor",
+    "read_state", "write_state", "write_text",
+]

@@ -1,0 +1,68 @@
+"""Crash-safe JSON state files (resilience tentpole, part d).
+
+The same atomic-write discipline as the datastore manifest
+(``datastore/format.py``): canonical sorted-keys dump, an embedded
+crc32 self-checksum over everything but the checksum field, tmp +
+``os.replace``.  A reader therefore sees either a complete,
+self-consistent state or (on corruption/truncation) None — never a
+half-written file silently steering a recovery.
+
+Used by ``fleet/daemon.py`` for ``fleet_state.json`` (tail mark, last
+gate verdict, live-model fingerprint); generic enough for any other
+subsystem that needs restart-safe breadcrumbs.
+"""
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from typing import Any, Dict, Optional
+
+CRC_FIELD = "state_crc32"
+
+
+def _canonical(state: Dict[str, Any]) -> bytes:
+    body = {k: v for k, v in state.items() if k != CRC_FIELD}
+    return json.dumps(body, sort_keys=True,
+                      separators=(",", ":")).encode()
+
+
+def write_state(path: str, state: Dict[str, Any]) -> str:
+    """Atomically persist ``state`` (crc-stamped, tmp + rename)."""
+    state = dict(state)
+    state[CRC_FIELD] = zlib.crc32(_canonical(state)) & 0xFFFFFFFF
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(state, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def read_state(path: str) -> Optional[Dict[str, Any]]:
+    """Load + validate a state file.  Returns None when the file is
+    absent, unreadable, not JSON, or fails its checksum — recovery
+    decisions fall back to the crash-unsafe default and COUNT the
+    corruption instead of trusting garbage."""
+    try:
+        with open(path) as fh:
+            state = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(state, dict):
+        return None
+    want = state.get(CRC_FIELD)
+    if want != (zlib.crc32(_canonical(state)) & 0xFFFFFFFF):
+        return None
+    state.pop(CRC_FIELD, None)   # readers get back what they wrote
+    return state
+
+
+def write_text(path: str, text: str) -> str:
+    """Atomic sibling for non-JSON artifacts (the persisted live-model
+    dump next to ``fleet_state.json``)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        fh.write(text)
+    os.replace(tmp, path)
+    return path

@@ -36,6 +36,7 @@ from typing import List, Optional
 import numpy as np
 
 from .. import telemetry
+from ..resilience import FAULTS
 from ..utils.log import LightGBMError
 
 
@@ -95,7 +96,7 @@ class MicroBatcher:
         self._stage: dict = {}
         self._closed = False
         self._worker = threading.Thread(
-            target=self._loop, name=f"lgbm-serve-{runtime.name}",
+            target=self._guard, name=f"lgbm-serve-{runtime.name}",
             daemon=True)
         self._worker.start()
 
@@ -146,6 +147,25 @@ class MicroBatcher:
         return self.submit(X, raw_score=raw_score, trace=trace).wait(timeout)
 
     # ------------------------------------------------------------- worker
+    def _guard(self) -> None:
+        """The worker thread's outermost frame.  `_loop` returning
+        means close(); anything ESCAPING it would previously kill the
+        worker silently — every later request then hung until its wait
+        timeout with the queue draining nowhere.  Count the crash,
+        restart the loop, keep serving."""
+        while True:
+            try:
+                self._loop()
+                return
+            except BaseException as e:
+                if self._closed:
+                    return
+                telemetry.REGISTRY.counter(
+                    "serve.batcher.worker_restarts").inc()
+                telemetry.event("serve.batcher.worker_restart",
+                                model=self.runtime.name,
+                                error=str(e)[:200])
+
     def _loop(self) -> None:
         while True:
             try:
@@ -171,11 +191,24 @@ class MicroBatcher:
                 rows += nxt.n
             telemetry.REGISTRY.gauge("serve.queue_depth").set(
                 self._q.qsize())
-            self._flush(batch)
+            try:
+                self._flush(batch)
+            except BaseException as e:
+                # a batcher bug (or the serve.flush chaos fault) must
+                # not strand its in-hand batch: fail these requests
+                # cleanly, then let _guard restart the loop
+                for r in batch:
+                    if not r.done.is_set():
+                        r.error = ServingClosedError(
+                            f"batcher worker crashed: {str(e)[:200]}")
+                        self._finalize(r, "error", str(e)[:200])
+                        r.done.set()
+                raise
             telemetry.REGISTRY.gauge("serve.queue_depth").set(
                 self._q.qsize())
 
     def _flush(self, batch: List[_Request]) -> None:
+        FAULTS.inject("serve.flush")
         telemetry.REGISTRY.gauge("serve.in_flight").set(len(batch))
         now = time.monotonic()
         live: List[_Request] = []

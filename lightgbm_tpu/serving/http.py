@@ -97,6 +97,14 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _max_body_mb(self) -> float:
+        """The serve_max_body_mb cap of the bound client's registry
+        config (0 or a missing config disables the cap)."""
+        try:
+            return float(self.client.registry._config.serve_max_body_mb)
+        except AttributeError:
+            return 0.0
+
     def _query_limit(self, query: str, default: Optional[int] = None):
         """Parse the shared `?n=K` limit of the /debug endpoints.
         Returns (ok, limit); on a non-integer or NEGATIVE n the 400 has
@@ -155,6 +163,25 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
         with telemetry.span("serve.http.predict"):
             try:
                 length = int(self.headers.get("Content-Length", 0))
+            except (ValueError, TypeError):
+                telemetry.REGISTRY.counter("serve.http.bad_requests").inc()
+                self._send_json(400, {"error": "bad Content-Length"})
+                return
+            # cap BEFORE reading: an oversized declared body never
+            # allocates (and never monopolises the socket reader) —
+            # the unread body means the connection must close
+            cap = int(self._max_body_mb() * 1024 * 1024)
+            if cap > 0 and length > cap:
+                telemetry.REGISTRY.counter(
+                    "serve.http.body_too_large").inc()
+                self.close_connection = True
+                self._send_json(413, {
+                    "error": f"request body {length} bytes exceeds "
+                             f"serve_max_body_mb="
+                             f"{self._max_body_mb():g} "
+                             f"({cap} bytes)"})
+                return
+            try:
                 body = json.loads(self.rfile.read(length) or b"{}")
                 rows = body["rows"]
                 X = np.asarray(rows, dtype=np.float64)

@@ -33,11 +33,18 @@ import time
 from typing import Dict, List, Optional, Union
 
 from .. import telemetry
+from ..resilience import FAULTS
 from ..utils.config import Config
 from ..utils.log import LightGBMError
 from .batcher import MicroBatcher, ServingClosedError
 from .runtime import ServingRuntime
 from .sharded import ShardedServingRuntime
+
+#: bound on back-to-back hot-swap retries in `predict` — each retry
+#: requires ANOTHER swap to have landed mid-dispatch, so a healthy
+#: registry never comes close; the bound turns a pathological
+#: swap-storm into a clean error instead of an unbounded loop
+_SWAP_RETRIES = 8
 
 
 class ServingModel:
@@ -130,6 +137,11 @@ class ModelRegistry:
             enabled=cfg.serve_trace, capacity=cfg.serve_trace_ring,
             slow_ms=cfg.serve_trace_slow_ms,
             sample_every=cfg.serve_trace_sample)
+        # resilience plane: `fault_spec` arms the process-global fault
+        # registry (chaos tests / CI chaos smoke; see resilience/faults.py
+        # for the grammar) — the $LGBM_FAULTS env var arms at import
+        if cfg.fault_spec:
+            FAULTS.arm(cfg.fault_spec)
 
     # -------------------------------------------------------------- load
     def load(self, name: str, model: Union[str, object], *,
@@ -162,13 +174,19 @@ class ModelRegistry:
                     max_batch_rows=cfg.serve_max_batch_rows,
                     name=name, device_sum=cfg.serve_device_sum,
                     compiled=cfg.serve_compiled,
-                    tile_vmem_kb=cfg.serve_tile_vmem_kb)
+                    tile_vmem_kb=cfg.serve_tile_vmem_kb,
+                    dispatch_timeout_ms=cfg.serve_dispatch_timeout_ms,
+                    breaker_backoff_s=cfg.serve_breaker_backoff_s,
+                    breaker_backoff_max_s=cfg.serve_breaker_backoff_max_s)
             else:
                 runtime = ServingRuntime(
                     booster, max_batch_rows=cfg.serve_max_batch_rows,
                     name=name, device_sum=cfg.serve_device_sum,
                     compiled=cfg.serve_compiled,
-                    tile_vmem_kb=cfg.serve_tile_vmem_kb)
+                    tile_vmem_kb=cfg.serve_tile_vmem_kb,
+                    dispatch_timeout_ms=cfg.serve_dispatch_timeout_ms,
+                    breaker_backoff_s=cfg.serve_breaker_backoff_s,
+                    breaker_backoff_max_s=cfg.serve_breaker_backoff_max_s)
             # the swap lock spans admit -> swap: the LRU demotion
             # decision and the swap it admits are one atomic step, so a
             # concurrent load can neither demote this entry the instant
@@ -329,7 +347,7 @@ class ModelRegistry:
                 sampler(X)
             except Exception:  # sampling is best-effort observability
                 telemetry.REGISTRY.counter("fleet.sampler_errors").inc()
-        while True:
+        for _ in range(_SWAP_RETRIES):
             entry = self.get(model)
             try:
                 return entry.predict(X, raw_score=raw_score,
@@ -339,12 +357,17 @@ class ModelRegistry:
                 # name lookup and the dispatch — the successor entry is
                 # already live, so the swap stays invisible to callers.
                 # Re-raise when the name is gone or unchanged (a real
-                # close, not a swap); each retry requires another swap,
-                # so the loop terminates.
+                # close, not a swap); each retry requires another swap
+                # landed mid-dispatch, and the bound above turns a
+                # pathological swap-storm into a clean error.
                 with self._lock:
                     cur = self._models.get(model)
                 if cur is None or cur is entry:
                     raise
+        telemetry.REGISTRY.counter("serve.swap_retry_exhausted").inc()
+        raise ServingClosedError(
+            f"model {model!r} was hot-swapped {_SWAP_RETRIES} times "
+            "mid-dispatch; giving up — retry the request")
 
     # ------------------------------------------------------------- close
     def close(self) -> None:

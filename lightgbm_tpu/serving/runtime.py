@@ -60,6 +60,7 @@ from .. import telemetry
 from ..compiler import PlanNotCompilable, build_plan
 from ..compiler.kernel import ROW_BLOCK, compiled_predict
 from ..ops.predict import predict_leaf_ensemble, predict_raw_ensemble_exact
+from ..resilience import FAULTS, OPEN, CircuitBreaker, Supervisor
 
 #: padding cap (and the micro-batcher's default flush threshold): with
 #: power-of-two buckets this caps the compile count at log2(4096)+1 = 13
@@ -145,7 +146,10 @@ class ServingRuntime:
                  device_sum: str = "auto",
                  compiled: str = "auto",
                  tile_vmem_kb: float = 512.0,
-                 device=None):
+                 device=None,
+                 dispatch_timeout_ms: float = 0.0,
+                 breaker_backoff_s: float = 30.0,
+                 breaker_backoff_max_s: float = 600.0):
         self._booster = booster
         self.name = name
         self.max_batch_rows = max(int(max_batch_rows), 1)
@@ -155,6 +159,27 @@ class ServingRuntime:
         self._compiled_mode = str(compiled).lower()
         self._tile_vmem_kb = float(tile_vmem_kb)
         self._state = _ServeState({})
+        # resilience plane: one watchdog lane + one circuit breaker per
+        # device rung.  `dispatch_timeout_ms <= 0` (the default) makes
+        # the supervisors transparent direct calls; breakers replace the
+        # old disable-until-refresh behavior for transient failures —
+        # open on error, half-open background re-probe after backoff,
+        # permanent only on a CONTENT mismatch.
+        self._supervisors = {
+            "compiled": Supervisor("compiled.traverse",
+                                   dispatch_timeout_ms),
+            "device_sum": Supervisor("serve.dispatch.device_sum",
+                                     dispatch_timeout_ms),
+            "slot_path": Supervisor("serve.dispatch.slot_path",
+                                    dispatch_timeout_ms),
+        }
+        self._breakers = {
+            rung: CircuitBreaker(f"{name}.{rung}",
+                                 backoff_s=breaker_backoff_s,
+                                 backoff_max_s=breaker_backoff_max_s)
+            for rung in ("compiled", "device_sum", "slot_path")}
+        self._reprobe_lock = threading.Lock()
+        self._reprobe_threads: Dict[str, threading.Thread] = {}
         #: pin every device array (export planes + staged inputs) to one
         #: device — the sharded serving plane builds one pinned runtime
         #: per mesh device (serving/sharded.py).  None = default device,
@@ -174,6 +199,11 @@ class ServingRuntime:
         parity probes against the new export and re-promotes a demoted
         runtime."""
         with self._refresh_lock:
+            # a refresh is a new export whose probes re-derive every
+            # rung verdict — including the PERMANENT ones (that is the
+            # documented way out of a content mismatch)
+            for br in self._breakers.values():
+                br.reset()
             ex = self._pin_export(
                 self._booster.export_predict_arrays(self._start,
                                                     self._num))
@@ -336,21 +366,35 @@ class ServingRuntime:
             # slot path serves these models exactly instead
             return False
         if self._device_sum_mode == "force":
+            self._breakers["device_sum"].record_success()
             return True
-        ok = self._probe_device_sum(ex)
-        if not ok:
+        verdict = self._probe_device_sum(ex)
+        if verdict == "ok":
+            self._breakers["device_sum"].record_success()
+            return True
+        if verdict == "mismatch":
+            # wrong CONTENT: permanent until a refresh re-probes a new
+            # export — no amount of waiting fixes wrong bits
             st.probe_failed = True
-            telemetry.REGISTRY.counter("serve.device_sum_disabled").inc()
-            telemetry.event("serve.device_sum_disabled", model=self.name)
-        return ok
+            self._breakers["device_sum"].record_mismatch()
+        else:
+            # transient device exception: the breaker's half-open
+            # re-probe can recover the rung without a manual refresh
+            self._breakers["device_sum"].record_failure()
+        telemetry.REGISTRY.counter("serve.device_sum_disabled").inc()
+        telemetry.event("serve.device_sum_disabled", model=self.name,
+                        cause=verdict)
+        return False
 
-    def _probe_device_sum(self, ex: Dict) -> bool:
+    def _probe_device_sum(self, ex: Dict) -> str:
         """Export-time exact-parity gate (the `_probe_fused` pattern
         from ops/pallas_hist.py): the device-sum program must
         bit-match the host f64 gather/sum over the SAME device slots —
         raw and converted — on a threshold-clustered probe batch, or
-        the model degrades to the slot path.  Any exception counts as
-        a failed probe (a broken rung must degrade, not raise)."""
+        the model degrades to the slot path.  Verdict: "ok",
+        "mismatch" (wrong bits — permanent) or "error" (device
+        exception — breaker-recoverable); a broken rung always
+        degrades, never raises."""
         try:
             # single-chunk probe: stay within the bucket cap so the
             # staging buffer fits (small-bucket runtimes probe small)
@@ -366,7 +410,7 @@ class ServingRuntime:
             got = self._device_sum_chunk(X, ex, want_raw=True)
             if got.shape != want.shape or not np.array_equal(
                     got.view(np.uint64), want.view(np.uint64)):
-                return False
+                return "mismatch"
             obj = self._booster.objective_
             if obj is not None:
                 got_c = self._device_sum_chunk(X, ex, want_raw=False)
@@ -375,12 +419,12 @@ class ServingRuntime:
                         or got_c.dtype != want_c.dtype \
                         or not np.array_equal(got_c.view(np.uint32),
                                               want_c.view(np.uint32)):
-                    return False
-            return True
+                    return "mismatch"
+            return "ok"
         except Exception as e:
             telemetry.event("serve.device_sum_probe_error",
                             model=self.name, error=str(e)[:200])
-            return False
+            return "error"
 
     def _probe_batch(self, ex: Dict, rows: int = 256) -> np.ndarray:
         """Deterministic adversarial probe batch: feature values
@@ -461,24 +505,35 @@ class ServingRuntime:
             for p in plan.planes)
         st.plan_gidx = gidx
         if mode == "force":
+            self._breakers["compiled"].record_success()
             return True
-        ok = self._probe_compiled(st)
-        if not ok:
+        verdict = self._probe_compiled(st)
+        if verdict == "ok":
+            self._breakers["compiled"].record_success()
+            return True
+        if verdict == "mismatch":
             st.probe_failed = True
+            self._breakers["compiled"].record_mismatch()
             self._disable_compiled("probe")
             st.plan = None
             st.plan_planes = None
             st.plan_meta = None
             st.plan_gidx = None
-        return ok
+        else:
+            # transient probe exception: KEEP the built planes so the
+            # half-open re-probe can retry without a rebuild — the open
+            # breaker (plus compiled_ok=False) gates serving meanwhile
+            self._breakers["compiled"].record_failure()
+            self._disable_compiled("probe_error")
+        return False
 
-    def _probe_compiled(self, st: _ServeState) -> bool:
+    def _probe_compiled(self, st: _ServeState) -> str:
         """Refresh-time exact-parity gate for the compiled rung: the
         tiled kernel's accumulated bits — raw AND converted — must
         match the host f64 gather/sum over the slot program's device
         slots on the threshold-clustered probe batch (the same
         reference `_probe_device_sum` holds the device-sum rung to).
-        Exceptions count as failed probes."""
+        Same verdict split: "ok" | "mismatch" | "error"."""
         try:
             ex = st.export
             X = self._probe_batch(ex, rows=min(256, self.max_batch_rows))
@@ -493,7 +548,7 @@ class ServingRuntime:
             got = self._compiled_chunk(X, st, want_raw=True)
             if got.shape != want.shape or not np.array_equal(
                     got.view(np.uint64), want.view(np.uint64)):
-                return False
+                return "mismatch"
             obj = self._booster.objective_
             if obj is not None:
                 got_c = self._compiled_chunk(X, st, want_raw=False)
@@ -502,12 +557,12 @@ class ServingRuntime:
                         or got_c.dtype != want_c.dtype \
                         or not np.array_equal(got_c.view(np.uint32),
                                               want_c.view(np.uint32)):
-                    return False
-            return True
+                    return "mismatch"
+            return "ok"
         except Exception as e:
             telemetry.event("serve.compiled_probe_error",
                             model=self.name, error=str(e)[:200])
-            return False
+            return "error"
 
     def buckets(self) -> List[int]:
         """Every padding bucket this runtime can present to the device."""
@@ -541,25 +596,52 @@ class ServingRuntime:
         with telemetry.span("serve.warmup", model=self.name,
                             buckets=len(sizes)):
             t0 = time.perf_counter()
+            device_sum_warm = st.device_sum_ok
+            slot_warm = True
             for b in sizes:
                 Z = np.zeros((b, nf), np.float64)
-                self._device_slots_chunk(Z, ex["stacked"])
+                if slot_warm:
+                    try:
+                        self._device_slots_chunk(Z, ex["stacked"])
+                    except Exception as e:
+                        # degrade-don't-error, same contract as the
+                        # predict path: warmup must never fail the model
+                        # load — open the rung's breaker (the half-open
+                        # re-probe recovers it) and keep warming the
+                        # surviving ladder
+                        slot_warm = False
+                        self._breakers["slot_path"].record_failure()
+                        telemetry.REGISTRY.counter(
+                            "serve.device_errors").inc()
+                        telemetry.event("serve.device_error",
+                                        model=self.name,
+                                        path="slot_warmup",
+                                        error=str(e)[:200])
                 if compiled_ok:
                     try:
                         self._compiled_chunk(Z, st, want_raw=True)
                         if obj is not None:
                             self._compiled_chunk(Z, st, want_raw=False)
                     except Exception as e:
-                        # degrade-don't-error, same contract as the
-                        # predict path: a rung that cannot even warm
-                        # must not fail the model load — retire it and
-                        # keep warming the surviving ladder
+                        # a rung that cannot even warm must not fail the
+                        # model load — retire it and keep warming the
+                        # surviving ladder
                         compiled_ok = False
                         self._drop_compiled(st, "warmup_error", str(e))
-                if st.device_sum_ok:
-                    self._device_sum_chunk(Z, ex, want_raw=True)
-                    if obj is not None:
-                        self._device_sum_chunk(Z, ex, want_raw=False)
+                if device_sum_warm:
+                    try:
+                        self._device_sum_chunk(Z, ex, want_raw=True)
+                        if obj is not None:
+                            self._device_sum_chunk(Z, ex, want_raw=False)
+                    except Exception as e:
+                        device_sum_warm = False
+                        self._breakers["device_sum"].record_failure()
+                        telemetry.REGISTRY.counter(
+                            "serve.device_errors").inc()
+                        telemetry.event("serve.device_error",
+                                        model=self.name,
+                                        path="device_sum_warmup",
+                                        error=str(e)[:200])
                 if obj is not None:
                     shape = (b,) if K == 1 else (b, K)
                     self._convert(np.zeros(shape, np.float64))
@@ -583,6 +665,127 @@ class ServingRuntime:
             new.probe_failed = cur.probe_failed
             new.demoted = cur.demoted
             self._state = new
+
+    # ----------------------------------------- breaker-gated recovery
+    def _maybe_reprobe(self, st: _ServeState) -> None:
+        """Request-path hook: promote any OPEN breaker whose backoff
+        has elapsed to half_open and kick ONE background re-probe for
+        it.  The request itself never probes — the `.state` read is a
+        lock-free attribute load, so the closed/hot path pays one
+        string compare per rung."""
+        if st.demoted:
+            return
+        for rung, br in self._breakers.items():
+            if br.state == OPEN and br.begin_probe():
+                self._kick_reprobe(rung)
+
+    def _kick_reprobe(self, rung: str) -> None:
+        # begin_probe() hands out exactly one half-open claim per open
+        # period, so this can never double-spawn for a rung
+        t = threading.Thread(
+            target=self._reprobe, args=(rung,), daemon=True,
+            name=f"lgbm-serve-reprobe-{self.name}-{rung}")
+        with self._reprobe_lock:
+            self._reprobe_threads[rung] = t
+        t.start()
+
+    def _reprobe(self, rung: str) -> None:
+        """Half-open background re-probe: re-run the rung's parity
+        probe against the LIVE bundle and close / re-open (backoff
+        doubled) / permanent the breaker on the verdict.  Runs under
+        the refresh lock — a concurrent refresh() either waits or has
+        already republished, and its fresh probes win either way."""
+        br = self._breakers[rung]
+        telemetry.REGISTRY.counter("serve.breaker.reprobe",
+                                   rung=rung).inc()
+        try:
+            with self._refresh_lock:
+                cur = self._state
+                ex = cur.export
+                if cur.demoted or not ex or ex.get("stacked") is None \
+                        or not ex.get("trees"):
+                    br.record_failure()
+                    return
+                if rung == "device_sum":
+                    verdict = ("ok" if self._device_sum_mode == "force"
+                               else self._probe_device_sum(ex))
+                elif rung == "compiled":
+                    if cur.plan_planes is None:
+                        # mismatch dropped the planes (permanent) or a
+                        # demote did — only a refresh rebuilds them
+                        verdict = "error"
+                    elif self._compiled_mode == "force":
+                        verdict = "ok"
+                    else:
+                        verdict = self._probe_compiled(cur)
+                else:
+                    verdict = self._probe_slot_path(ex)
+                if verdict == "ok":
+                    br.record_success()
+                    telemetry.REGISTRY.counter("serve.breaker.recovered",
+                                               rung=rung).inc()
+                    telemetry.event("serve.breaker.recovered",
+                                    model=self.name, rung=rung)
+                    self._publish_rung(cur, rung, True)
+                elif verdict == "mismatch":
+                    br.record_mismatch()
+                    if rung == "compiled":
+                        self._disable_compiled("probe")
+                    else:
+                        telemetry.REGISTRY.counter(
+                            "serve.device_sum_disabled").inc()
+                        telemetry.event("serve.device_sum_disabled",
+                                        model=self.name, cause="mismatch")
+                    self._publish_rung(cur, rung, False, mismatch=True)
+                else:
+                    br.record_failure()
+        except Exception as e:  # a failed re-probe must never propagate
+            br.record_failure()
+            telemetry.event("serve.breaker.reprobe_error",
+                            model=self.name, rung=rung,
+                            error=str(e)[:200])
+
+    def _probe_slot_path(self, ex: Dict) -> str:
+        """Re-probe gate for the slot rung: the slot path is exact by
+        construction (device slots + host f64 gather), so recovery only
+        needs the device program to answer again."""
+        try:
+            X = self._probe_batch(ex, rows=min(64, self.max_batch_rows))
+            self._device_slots_chunk(X, ex["stacked"])
+            return "ok"
+        except Exception as e:
+            telemetry.event("serve.slot_probe_error", model=self.name,
+                            error=str(e)[:200])
+            return "error"
+
+    def _publish_rung(self, cur: _ServeState, rung: str, ok: bool,
+                      mismatch: bool = False) -> None:
+        """Republish the live bundle with one rung verdict flipped
+        (caller holds `_refresh_lock`).  The slot rung has no state
+        flag — its breaker is the only gate — and a no-op flip is not
+        republished (predict-time failures leave the flag True; the
+        breaker alone gated the rung, so closing it suffices)."""
+        if rung == "slot_path":
+            return
+        flag = "device_sum_ok" if rung == "device_sum" else "compiled_ok"
+        if getattr(cur, flag) == ok and not mismatch:
+            return
+        new = _ServeState(cur.export)
+        new.device_sum_ok = cur.device_sum_ok
+        new.compiled_ok = cur.compiled_ok
+        new.plan = cur.plan
+        new.plan_planes = cur.plan_planes
+        new.plan_meta = cur.plan_meta
+        new.plan_gidx = cur.plan_gidx
+        new.probe_failed = cur.probe_failed or mismatch
+        new.demoted = cur.demoted
+        setattr(new, flag, ok)
+        if rung == "compiled" and not ok:
+            new.plan = None
+            new.plan_planes = None
+            new.plan_meta = None
+            new.plan_gidx = None
+        self._state = new
 
     # ----------------------------------------------------------- predict
     def predict(self, X, raw_score: bool = False,
@@ -614,16 +817,19 @@ class ServingRuntime:
         # can never mix this request across model versions
         st = self._state
         ex = st.export
+        self._maybe_reprobe(st)
         with telemetry.span("serve.predict", model=self.name, rows=n):
             t0 = time.perf_counter()
             want_raw = raw_score or self._booster.objective_ is None
             out = None
-            if st.compiled_ok and ex["trees"]:
+            if st.compiled_ok and ex["trees"] \
+                    and self._breakers["compiled"].allow_request():
                 out = self._compiled(X, st, want_raw, clock)
             if out is not None:
                 clock.rung = "compiled"
             else:
-                if st.device_sum_ok and ex["trees"]:
+                if st.device_sum_ok and ex["trees"] \
+                        and self._breakers["device_sum"].allow_request():
                     out = self._device_sum(X, ex, want_raw, clock)
                 if out is not None:
                     clock.rung = "device_sum"
@@ -655,6 +861,7 @@ class ServingRuntime:
                         clock)
                     for lo in range(0, X.shape[0], self.max_batch_rows)]
         except Exception as e:
+            self._breakers["compiled"].record_failure()
             telemetry.REGISTRY.counter("serve.device_errors").inc()
             telemetry.event("serve.device_error", model=self.name,
                             path="compiled", error=str(e)[:200])
@@ -686,28 +893,37 @@ class ServingRuntime:
         conv = None if want_raw else self._booster.objective_.convert_output
         # interpret off-TPU: parity machinery stays testable everywhere
         interp = jax.default_backend() != "tpu"
-        t = time.perf_counter()
-        out = compiled_predict(Xd, st.plan_planes, st.plan_gidx,
-                               ex["value_hi"], ex["value_lo"], cls,
-                               meta=st.plan_meta, n_class=K,
-                               convert=conv, interpret=interp)
-        clock.add("dispatch", time.perf_counter() - t)
         n = Xc.shape[0]
-        if want_raw:
+
+        def _device():
+            # dispatch + D2H under one watchdog deadline: a wedged
+            # kernel is abandoned and surfaces as DeviceTimeoutError,
+            # which the except in `_compiled` treats like any device
+            # failure (degrade + open the breaker)
+            FAULTS.inject("compiled.traverse")
             t = time.perf_counter()
-            hi = np.asarray(jax.device_get(out[0]))
-            lo = np.asarray(jax.device_get(out[1]))
+            out = compiled_predict(Xd, st.plan_planes, st.plan_gidx,
+                                   ex["value_hi"], ex["value_lo"], cls,
+                                   meta=st.plan_meta, n_class=K,
+                                   convert=conv, interpret=interp)
+            clock.add("dispatch", time.perf_counter() - t)
+            if want_raw:
+                t = time.perf_counter()
+                hi = np.asarray(jax.device_get(out[0]))
+                lo = np.asarray(jax.device_get(out[1]))
+                clock.add("d2h", time.perf_counter() - t)
+                telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                    hi.nbytes + lo.nbytes)
+                raw = ((hi.astype(np.uint64) << np.uint64(32))
+                       | lo).view(np.float64)
+                return FAULTS.inject("serve.d2h.compiled", raw)
+            t = time.perf_counter()
+            o = np.asarray(jax.device_get(out))
             clock.add("d2h", time.perf_counter() - t)
-            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
-                hi.nbytes + lo.nbytes)
-            raw = ((hi.astype(np.uint64) << np.uint64(32))
-                   | lo).view(np.float64)
-            return raw[:n]
-        t = time.perf_counter()
-        o = np.asarray(jax.device_get(out))
-        clock.add("d2h", time.perf_counter() - t)
-        telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
-        return o[:n]
+            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
+            return FAULTS.inject("serve.d2h.compiled", o)
+
+        return self._supervisors["compiled"].call(_device)[:n]
 
     # ----------------------------------------------- rung 1: device sum
     def _device_sum(self, X: np.ndarray, ex: Dict, want_raw: bool,
@@ -724,6 +940,7 @@ class ServingRuntime:
                         clock)
                     for lo in range(0, X.shape[0], self.max_batch_rows)]
         except Exception as e:
+            self._breakers["device_sum"].record_failure()
             telemetry.REGISTRY.counter("serve.device_errors").inc()
             telemetry.event("serve.device_error", model=self.name,
                             path="device_sum", error=str(e)[:200])
@@ -747,25 +964,30 @@ class ServingRuntime:
         arrays["value_lo"] = ex["value_lo"]
         K = ex["num_class"]
         conv = None if want_raw else self._booster.objective_.convert_output
-        t = time.perf_counter()
-        out = _EXACT_JIT(arrays, Xd, n_class=K, convert=conv)
-        clock.add("dispatch", time.perf_counter() - t)
         n = Xc.shape[0]
-        if want_raw:
+
+        def _device():
+            FAULTS.inject("serve.dispatch.device_sum")
             t = time.perf_counter()
-            hi = np.asarray(jax.device_get(out[0]))
-            lo = np.asarray(jax.device_get(out[1]))
+            out = _EXACT_JIT(arrays, Xd, n_class=K, convert=conv)
+            clock.add("dispatch", time.perf_counter() - t)
+            if want_raw:
+                t = time.perf_counter()
+                hi = np.asarray(jax.device_get(out[0]))
+                lo = np.asarray(jax.device_get(out[1]))
+                clock.add("d2h", time.perf_counter() - t)
+                telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                    hi.nbytes + lo.nbytes)
+                raw = ((hi.astype(np.uint64) << np.uint64(32))
+                       | lo).view(np.float64)
+                return FAULTS.inject("serve.d2h.device_sum", raw)
+            t = time.perf_counter()
+            o = np.asarray(jax.device_get(out))
             clock.add("d2h", time.perf_counter() - t)
-            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
-                hi.nbytes + lo.nbytes)
-            raw = ((hi.astype(np.uint64) << np.uint64(32))
-                   | lo).view(np.float64)
-            return raw[:n]
-        t = time.perf_counter()
-        o = np.asarray(jax.device_get(out))
-        clock.add("d2h", time.perf_counter() - t)
-        telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
-        return o[:n]
+            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
+            return FAULTS.inject("serve.d2h.device_sum", o)
+
+        return self._supervisors["device_sum"].call(_device)[:n]
 
     # ------------------------------------------- rungs 2+3: slots, host
     def _raw(self, X: np.ndarray, st: _ServeState,
@@ -777,7 +999,16 @@ class ServingRuntime:
         K = ex["num_class"]
         n = X.shape[0]
         raw = np.zeros((n, K), np.float64)
-        slots = self._device_slots(X, ex, clock) if trees else None
+        slots = None
+        slot_skipped = False
+        if trees:
+            if self._breakers["slot_path"].allow_request():
+                slots = self._device_slots(X, ex, clock)
+            else:
+                # open breaker: the rung already failed recently — skip
+                # it outright (nobody pays a wedged device's deadline
+                # twice) until the half-open re-probe closes it
+                slot_skipped = True
         if clock is not None:
             clock.rung = "slot_path" if slots is not None else "host_walk"
         if trees and slots is None:
@@ -786,7 +1017,8 @@ class ServingRuntime:
             # linear_tree (no stacked planes), forced (X too narrow /
             # empty), probe_fail (device errors on a runtime whose
             # refresh-time parity probes already failed — the smoking
-            # gun for a silently miscompiling device), device_error
+            # gun for a silently miscompiling device), breaker_open
+            # (skipped without an attempt), device_error
             stacked = ex["stacked"]
             if stacked is None:
                 cause = "linear_tree"
@@ -794,6 +1026,8 @@ class ServingRuntime:
                 cause = "forced"
             elif st.probe_failed:
                 cause = "probe_fail"
+            elif slot_skipped:
+                cause = "breaker_open"
             else:
                 cause = "device_error"
             telemetry.REGISTRY.counter("serve.host_walk",
@@ -829,6 +1063,7 @@ class ServingRuntime:
         except Exception as e:
             # probe-wedge lesson: a dead/wedged device must degrade, not
             # 500 — count it and serve from the host walk
+            self._breakers["slot_path"].record_failure()
             telemetry.REGISTRY.counter("serve.device_errors").inc()
             telemetry.event("serve.device_error", model=self.name,
                             error=str(e)[:200])
@@ -847,14 +1082,19 @@ class ServingRuntime:
         clock.add("stage_copy", time.perf_counter() - t)
         arrays = {k: v for k, v in stacked.items()
                   if k not in ("min_features", "value")}
-        t = time.perf_counter()
-        out = _LEAF_JIT(arrays, Xd)
-        clock.add("dispatch", time.perf_counter() - t)
-        t = time.perf_counter()
-        slots = np.asarray(jax.device_get(out))
-        clock.add("d2h", time.perf_counter() - t)
-        telemetry.REGISTRY.counter("serve.d2h_bytes").inc(slots.nbytes)
-        return slots[:, :n]
+
+        def _device():
+            FAULTS.inject("serve.dispatch.slot_path")
+            t = time.perf_counter()
+            out = _LEAF_JIT(arrays, Xd)
+            clock.add("dispatch", time.perf_counter() - t)
+            t = time.perf_counter()
+            slots = np.asarray(jax.device_get(out))
+            clock.add("d2h", time.perf_counter() - t)
+            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(slots.nbytes)
+            return FAULTS.inject("serve.d2h.slot_path", slots)
+
+        return self._supervisors["slot_path"].call(_device)[:, :n]
 
     def _stage32(self, Xc: np.ndarray, b: int):
         """Pad `Xc` into a reused per-(bucket, width) f32 staging

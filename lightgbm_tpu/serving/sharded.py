@@ -85,7 +85,10 @@ class ShardedServingRuntime:
                  name: str = "default",
                  device_sum: str = "auto",
                  compiled: str = "auto",
-                 tile_vmem_kb: float = 512.0):
+                 tile_vmem_kb: float = 512.0,
+                 dispatch_timeout_ms: float = 0.0,
+                 breaker_backoff_s: float = 30.0,
+                 breaker_backoff_max_s: float = 600.0):
         if devices is None:
             devices = resolve_shard_devices(shard_devices)
         if not devices:
@@ -104,7 +107,10 @@ class ShardedServingRuntime:
                            num_iteration=num_iteration,
                            name=f"{name}.r{i}", device_sum=device_sum,
                            compiled=compiled, tile_vmem_kb=tile_vmem_kb,
-                           device=dev)
+                           device=dev,
+                           dispatch_timeout_ms=dispatch_timeout_ms,
+                           breaker_backoff_s=breaker_backoff_s,
+                           breaker_backoff_max_s=breaker_backoff_max_s)
             for i, dev in enumerate(self.devices)]
         self._sched_lock = threading.Lock()
         self._outstanding = [0] * len(self._replicas)   # rows in flight

@@ -98,6 +98,25 @@ RULES: List[Tuple[str, str, str]] = [
     ("*fleet.sampler_errors", "up_is_bad", "counter"),
     ("*fleet.poll_errors", "up_is_bad", "counter"),
     ("*serve.auto_refresh_errors", "up_is_bad", "counter"),
+    # resilience plane (ISSUE 14): a watchdog firing means a device
+    # dispatch blew its deadline, a batcher worker restart means the
+    # serving loop crashed, a gate error means a candidate was rejected
+    # fail-closed without being scored, and retry exhaustion means a
+    # swap storm starved a request — all fail hard on growth.  Breaker
+    # transition/re-probe/recovered counters are the RECOVERY machinery
+    # doing its job (the underlying failure already fails via
+    # serve.device_errors / watchdog.fired), so they move freely.  A
+    # daemon recovering cleanly (resumed / model_restored / an ignored
+    # foreign state) is by design; a CORRUPT state file is a torn-write
+    # bug.  413s are the body cap working, not a serving error.
+    ("*serve.watchdog.fired*", "up_is_bad", "counter"),
+    ("*serve.batcher.worker_restarts", "up_is_bad", "counter"),
+    ("*serve.swap_retry_exhausted", "up_is_bad", "counter"),
+    ("*serve.breaker.*", "ignore", "counter"),
+    ("*fleet.gate.errors", "up_is_bad", "counter"),
+    ("*fleet.recover.state_corrupt", "up_is_bad", "counter"),
+    ("*fleet.recover.*", "ignore", "counter"),
+    ("*serve.http.body_too_large", "ignore", "counter"),
     # control-plane observability (ISSUE 12): burn rate rising means a
     # tenant is eating error budget faster than its SLO allows —
     # timing class (wall-clock-derived: a plain `telemetry diff` fails,

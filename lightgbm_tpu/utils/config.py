@@ -298,6 +298,26 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # the replicas with a least-outstanding-work scheduler.  0 = all
     # visible devices, 1 = the single-device runtime (default)
     "serve_shard_devices": (1, "int", ("shard_devices",)),
+    # ---- resilience plane (lightgbm_tpu/resilience/) ----
+    # watchdog deadline for every device dispatch in the serving ladder
+    # (compiled / device_sum / slot_path): a dispatch that exceeds this
+    # raises DeviceTimeoutError, which the fallback ladder absorbs like
+    # any device error.  0 disables supervision (direct call)
+    "serve_dispatch_timeout_ms": (0.0, "float", ()),
+    # circuit breaker (resilience/breaker.py): initial re-probe backoff
+    # after a rung opens, and the exponential-backoff cap
+    "serve_breaker_backoff_s": (30.0, "float", ()),
+    "serve_breaker_backoff_max_s": (600.0, "float", ()),
+    # HTTP frontend request-body cap (MiB): a Content-Length above this
+    # is rejected with 413 before the body is read
+    "serve_max_body_mb": (32.0, "float", ()),
+    # fault-injection plane (resilience/faults.py): arm injection sites
+    # at load, e.g. "serve.dispatch.*:hang@p=0.1;prefetch.read:error".
+    # Test/chaos-CI surface — empty (default) means zero overhead
+    "fault_spec": ("", "str", ()),
+    # watchdog deadline for mesh collectives (mesh/placement.py
+    # device_put fan-out); 0 disables
+    "mesh_collective_timeout_ms": (0.0, "float", ()),
     # ---- continuous-training fleet (lightgbm_tpu/fleet/) ----
     # trainer daemon (fleet/daemon.py): continue the live booster via
     # init_model once this many NEW rows have landed in the tailed
@@ -318,6 +338,10 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "fleet_gate_max_shift": (0.5, "float", ()),
     # holdout tail rows (newest datastore rows) scored by the metric gate
     "fleet_shadow_rows": (512, "int", ()),
+    # watchdog deadline for one shadow-gate evaluation: a hung gate
+    # fails CLOSED (candidate rejected, live model keeps serving).
+    # 0 disables supervision
+    "fleet_gate_timeout_ms": (0.0, "float", ()),
     # live-traffic reservoir capacity (rows) the registry sampler keeps
     # for the gate's traffic-shift check
     "fleet_sample_ring": (256, "int", ()),

@@ -568,6 +568,104 @@ print("[run_ci] lineage CLI: full ancestry (root -> 2 swaps) + "
       "rejection evidence reconstructed offline from JSONL")
 EOF
 
+# chaos smoke (ISSUE 14): serve the golden model over HTTP with a HANG
+# armed on the device-sum dispatch.  The watchdog must bound the wedged
+# request (serve.watchdog.fired == 1), the ladder must degrade exactly
+# ONE rung (slot_path serves, host_walk untouched), every response must
+# stay byte-identical to booster.predict, and after disarm the breaker's
+# half-open re-probe must restore the rung without a refresh().
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.resilience import FAULTS
+from lightgbm_tpu.serving import ServingClient
+from lightgbm_tpu.serving.http import make_server
+
+def cval(name, **labels):
+    return telemetry.REGISTRY.counter(name, **labels).value
+
+bst = Booster(model_file="tests/data/golden_binary.model.txt")
+X, _ = make_case_data(GOLDEN_CASES["binary"])
+X = X[:64]
+want = bst.predict(X)
+# warmup=True: compiles happen at load time, so the dispatch deadline
+# below only ever has to cover real dispatch — a 5 s deadline vs the
+# 1 h hang horizon is unambiguous.  compiled=off makes device_sum the
+# top rung (the one the fault wedges).
+client = ServingClient(bst, params={
+    "serve_warmup": True, "serve_compiled": "off",
+    "serve_max_wait_ms": 0.0,
+    "serve_dispatch_timeout_ms": 5000.0,
+    "serve_breaker_backoff_s": 2.0})
+rt = client.registry.get("default").runtime
+assert rt.device_sum_active, "device_sum rung must start live"
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{port}"
+
+def http_predict():
+    body = json.dumps({"rows": X.tolist()}).encode()
+    req = urllib.request.Request(
+        f"{base}/predict", data=body,
+        headers={"Content-Type": "application/json"})
+    resp = json.loads(urllib.request.urlopen(req, timeout=120).read())
+    return np.asarray(resp["predictions"], np.float64)
+
+wd0 = cval("serve.watchdog.fired", site="serve.dispatch.device_sum")
+sp0 = cval("serve.slot_path")
+hw0 = sum(cval("serve.host_walk", cause=c)
+          for c in ("device_error", "breaker_open", "disabled"))
+FAULTS.arm("serve.dispatch.device_sum:hang")
+t0 = time.monotonic()
+np.testing.assert_array_equal(http_predict(), want)   # watchdog bounds it
+wedged_s = time.monotonic() - t0
+assert wedged_s < 60.0, f"wedged request not bounded ({wedged_s:.0f}s)"
+np.testing.assert_array_equal(http_predict(), want)   # breaker skips rung
+wd = cval("serve.watchdog.fired", site="serve.dispatch.device_sum") - wd0
+sp = cval("serve.slot_path") - sp0
+hw = sum(cval("serve.host_walk", cause=c)
+         for c in ("device_error", "breaker_open", "disabled")) - hw0
+assert wd == 1, f"watchdog fired {wd}x (want exactly 1: open breaker " \
+    "must SKIP the wedged rung, not re-pay its deadline)"
+assert sp >= 2, f"slot_path served {sp}x (want both degraded requests)"
+assert hw == 0, f"host_walk took {hw} requests — degraded TWO rungs"
+assert rt._breakers["device_sum"].state == "open"
+
+# disarm + elapse the backoff: predicts kick ONE background half-open
+# re-probe which re-proves byte parity and re-closes the breaker
+FAULTS.disarm()
+time.sleep(2.1)
+deadline = time.monotonic() + 60.0
+while rt._breakers["device_sum"].state != "closed":
+    np.testing.assert_array_equal(http_predict(), want)
+    assert time.monotonic() < deadline, \
+        f"breaker never re-closed: {rt._breakers['device_sum'].state}"
+    time.sleep(0.05)
+assert cval("serve.breaker.recovered", rung="device_sum") >= 1
+ds0 = cval("serve.device_sum")
+np.testing.assert_array_equal(http_predict(), want)
+assert cval("serve.device_sum") > ds0, "restored rung not serving"
+
+srv.shutdown()
+srv.server_close()
+client.close()
+print(f"[run_ci] chaos smoke: hang bounded in {wedged_s:.1f}s "
+      "(watchdog x1), degraded exactly one rung (slot_path), "
+      "all responses byte-identical, breaker re-probe restored "
+      "device_sum after disarm")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

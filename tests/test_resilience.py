@@ -1,0 +1,692 @@
+"""Resilience plane (ISSUE 14): fault injection, watchdog-supervised
+dispatch, breaker-gated rung recovery, crash-safe fleet state.
+
+The load-bearing claims:
+
+* FAULTS — the process-global `FaultPlane` parses the spec grammar,
+  honors @p/@n/@after modifiers and fnmatch site globs, and `disarm()`
+  releases every hung thread (no leaked sleepers).
+* WATCHDOG — `Supervisor.call` turns a dispatch that exceeds its
+  deadline into `DeviceTimeoutError` (counted under
+  `serve.watchdog.fired{site=}`), keeps working after an abandoned
+  worker, and is a zero-overhead direct call when the timeout is 0.
+* BREAKER — per-rung circuit breakers open on failure, half-open
+  re-probe after exponential backoff (capped), close only on probe
+  success; a CONTENT mismatch is permanent by design.
+* LADDER under chaos — an injected error/hang/corruption on any
+  serving rung degrades exactly like a real device failure: responses
+  stay byte-identical to `booster.predict` throughout, and a
+  transient fault's rung is RESTORED by the background re-probe after
+  disarm.
+* CRASH-SAFE FLEET — the daemon persists its tail mark / live-model
+  fingerprint / in-flight marker to an atomic `fleet_state.json`
+  (+ `fleet_model.txt` at every swap); a killed-and-restarted daemon
+  resumes a model chain byte-identical to an uninterrupted run.
+* SATELLITES — HTTP body cap (413 before the body is read, 400 on
+  malformed JSON), batcher worker restart after a loop crash, bounded
+  registry retry under a hot-swap storm, prefetch fault surfacing.
+"""
+import http.client
+import json
+import os
+import shutil
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.datastore.prefetch import ShardPrefetcher
+from lightgbm_tpu.datastore.store import ShardStore
+from lightgbm_tpu.engine import train as engine_train
+from lightgbm_tpu.fleet import TrainerDaemon, create_fleet_store
+from lightgbm_tpu.fleet.daemon import MODEL_FILE, STATE_FILE
+from lightgbm_tpu.resilience import (CLOSED, FAULTS, HALF_OPEN, OPEN,
+                                     PERMANENT, CircuitBreaker,
+                                     DeviceTimeoutError, FaultInjected,
+                                     FaultPlane, FaultSpec, Supervisor,
+                                     read_state, write_state)
+from lightgbm_tpu.serving import (ModelRegistry, ServingClient,
+                                  ServingRuntime, ShardedServingRuntime)
+from lightgbm_tpu.serving.batcher import ServingClosedError
+from lightgbm_tpu.serving.http import make_server
+from lightgbm_tpu.utils.log import LightGBMError
+
+pytestmark = pytest.mark.quick
+
+N0, NF = 256, 5
+TRAIN_PARAMS = {"objective": "binary", "num_leaves": 6,
+                "min_data_in_leaf": 8, "learning_rate": 0.2,
+                "verbosity": -1}
+SERVE_PARAMS = {"serve_max_wait_ms": 0.0, "serve_warmup": False}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """Chaos must never leak between tests: the plane is process-global."""
+    FAULTS.disarm()
+    yield
+    FAULTS.disarm()
+
+
+def _data(n=N0, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, NF)
+    y = (X[:, 0] + 0.5 * X[:, 1] + 0.1 * rng.randn(n) > 0) \
+        .astype(np.float64)
+    return np.ascontiguousarray(X), y
+
+
+def _train(X, y, rounds=4, init_model=None, **over):
+    params = dict(TRAIN_PARAMS, **over)
+    return engine_train(params, lgb.Dataset(X, label=y),
+                        num_boost_round=rounds, init_model=init_model)
+
+
+def _cval(name, **labels):
+    return telemetry.REGISTRY.counter(name, **labels).value
+
+
+# ===================================================== fault plane units
+class TestFaultPlane:
+    def test_parse_grammar(self):
+        s = FaultSpec.parse("serve.d2h.*:corrupt@p=0.5@n=3@after=2")
+        assert s.pattern == "serve.d2h.*" and s.mode == "corrupt"
+        assert s.p == 0.5 and s.n == 3 and s.after == 2
+        s2 = FaultSpec.parse("compiled.traverse:delay:0.05")
+        assert s2.mode == "delay" and s2.arg == 0.05
+        with pytest.raises(ValueError):
+            FaultSpec.parse("no-mode-here")
+        with pytest.raises(ValueError):
+            FaultSpec.parse("site:explode")
+        with pytest.raises(ValueError):
+            FaultSpec.parse("site:error@bogus=1")
+
+    def test_error_and_counting(self):
+        fp = FaultPlane(env="")
+        fp.arm("a.b:error")
+        assert fp.inject("other.site") is None      # no match, no-op
+        with pytest.raises(FaultInjected):
+            fp.inject("a.b")
+        assert fp.fired["a.b:error"] == 1
+        assert fp.fired_at("a.") == 1
+
+    def test_n_and_after_modifiers(self):
+        fp = FaultPlane(env="")
+        fp.arm("x:error@after=2@n=1")
+        fp.inject("x")
+        fp.inject("x")                              # first 2 pass
+        with pytest.raises(FaultInjected):
+            fp.inject("x")                          # 3rd fires
+        fp.inject("x")                              # n=1 exhausted
+        assert fp.fired["x:error"] == 1
+
+    def test_glob_sites_and_accumulation(self):
+        fp = FaultPlane(env="")
+        fp.arm("serve.dispatch.*:error")
+        fp.arm("prefetch.read:error")               # accumulates
+        assert len(fp.specs()) == 2
+        with pytest.raises(FaultInjected):
+            fp.inject("serve.dispatch.device_sum")
+        with pytest.raises(FaultInjected):
+            fp.inject("serve.dispatch.slot_path")
+        with pytest.raises(FaultInjected):
+            fp.inject("prefetch.read")
+        fp.disarm()
+        assert not fp.active()
+        fp.inject("prefetch.read")                  # disarmed: no-op
+
+    def test_corrupt_flips_copy_not_original(self):
+        fp = FaultPlane(env="")
+        fp.arm("d2h:corrupt")
+        orig = np.arange(4, dtype=np.float64)
+        keep = orig.copy()
+        bad = fp.inject("d2h", orig)
+        assert not np.array_equal(bad, orig)
+        np.testing.assert_array_equal(orig, keep)   # in-place never
+        assert fp.inject("d2h", None) is None       # payload-free: no-op
+
+    def test_disarm_releases_hang(self):
+        fp = FaultPlane(env="")
+        fp.arm("slow:hang")
+        released = threading.Event()
+
+        def hang():
+            fp.inject("slow")
+            released.set()
+
+        t = threading.Thread(target=hang, daemon=True)
+        t.start()
+        time.sleep(0.05)
+        assert not released.is_set()                # genuinely parked
+        fp.disarm()
+        assert released.wait(5.0)
+        t.join(5.0)
+
+    def test_env_var_arming(self, monkeypatch):
+        monkeypatch.setenv("LGBM_FAULTS", "a:error,b:delay:0.001")
+        fp = FaultPlane()
+        assert {s.pattern for s in fp.specs()} == {"a", "b"}
+
+
+# ====================================================== supervisor units
+class TestSupervisor:
+    def test_zero_timeout_is_direct(self):
+        sup = Supervisor("t.direct", 0.0)
+        assert not sup.enabled
+        assert sup.call(lambda a, b: a + b, 2, 3) == 5
+
+    def test_result_and_exception_propagate(self):
+        sup = Supervisor("t.prop", 5000.0)
+        assert sup.call(lambda: 42) == 42
+        with pytest.raises(KeyError):
+            sup.call(dict().__getitem__, "missing")
+
+    def test_timeout_raises_and_counts_then_recovers(self):
+        sup = Supervisor("t.hang", 100.0)
+        fired0 = _cval("serve.watchdog.fired", site="t.hang")
+        ev = threading.Event()
+        with pytest.raises(DeviceTimeoutError):
+            sup.call(ev.wait, 30.0)
+        assert _cval("serve.watchdog.fired", site="t.hang") == fired0 + 1
+        ev.set()                                    # free the zombie
+        # a fresh worker lane serves the NEXT call normally
+        assert sup.call(lambda: "ok") == "ok"
+
+    def test_timeout_error_is_lightgbm_error(self):
+        assert issubclass(DeviceTimeoutError, LightGBMError)
+
+
+# ========================================================= breaker units
+class TestCircuitBreaker:
+    def test_full_lifecycle_with_injected_clock(self):
+        now = [0.0]
+        br = CircuitBreaker("t.rung", backoff_s=10.0, backoff_max_s=25.0,
+                            clock=lambda: now[0])
+        assert br.state == CLOSED and br.allow_request()
+        br.record_failure()
+        assert br.state == OPEN and not br.allow_request()
+        assert not br.begin_probe()                 # backoff not elapsed
+        now[0] = 10.0
+        assert br.begin_probe()
+        assert br.state == HALF_OPEN
+        assert not br.begin_probe()                 # one claimant only
+        br.record_failure()                         # probe failed
+        assert br.state == OPEN
+        now[0] = 25.0
+        assert not br.begin_probe()                 # doubled: 10 -> 20
+        now[0] = 30.0
+        assert br.begin_probe()
+        br.record_failure()
+        now[0] = 54.0                               # 20 -> 25 (capped)
+        assert not br.begin_probe()
+        now[0] = 55.0
+        assert br.begin_probe()
+        br.record_success()
+        assert br.state == CLOSED and br.failures == 0
+        br.record_failure()
+        now[0] = 65.0                               # backoff reset to 10
+        assert br.begin_probe()
+
+    def test_mismatch_is_permanent_until_reset(self):
+        br = CircuitBreaker("t.mis", backoff_s=0.0, clock=lambda: 1e9)
+        br.record_mismatch()
+        assert br.state == PERMANENT
+        assert not br.begin_probe()                 # waiting never helps
+        br.record_failure()                         # stays permanent
+        assert br.state == PERMANENT
+        br.reset()                                  # a refresh re-probes
+        assert br.state == CLOSED
+
+
+# ===================================================== atomic state file
+class TestStateFile:
+    def test_roundtrip_and_corruption(self, tmp_path):
+        p = str(tmp_path / "st.json")
+        assert read_state(p) is None                # absent
+        write_state(p, {"a": 1, "nested": {"b": [1, 2]}})
+        assert read_state(p) == {"a": 1, "nested": {"b": [1, 2]}}
+        blob = open(p, "r").read().replace('"a": 1', '"a": 2')
+        open(p, "w").write(blob)                    # crc now wrong
+        assert read_state(p) is None
+        open(p, "w").write("{truncated")
+        assert read_state(p) is None
+
+
+# ============================================ serving ladder under chaos
+class TestServingChaos:
+    def _runtime(self, **kw):
+        X, y = _data()
+        bst = _train(X, y)
+        kw.setdefault("compiled", "off")
+        rt = ServingRuntime(bst, **kw)
+        return bst, X, rt
+
+    def test_error_fault_degrades_byte_identical(self):
+        bst, X, rt = self._runtime()
+        assert rt.device_sum_active
+        want = bst.predict(X, raw_score=True)
+        FAULTS.arm("serve.dispatch.device_sum:error")
+        sp0 = _cval("serve.slot_path")
+        got = rt.predict(X, raw_score=True)
+        np.testing.assert_array_equal(got, want)
+        assert _cval("serve.slot_path") > sp0       # degraded one rung
+        assert rt._breakers["device_sum"].state == OPEN
+        # breaker open: the rung is SKIPPED, not re-attempted
+        fired = FAULTS.fired_at("serve.dispatch.device_sum")
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        assert FAULTS.fired_at("serve.dispatch.device_sum") == fired
+
+    def test_hang_fault_watchdog_fires_then_breaker_recovers(self):
+        # the deadline must clear first-dispatch jit compiles (the
+        # refresh-time probes run supervised too) while staying far
+        # below the 1 h hang horizon
+        bst, X, rt = self._runtime(dispatch_timeout_ms=3000.0,
+                                   breaker_backoff_s=0.05)
+        assert rt.device_sum_active
+        want = bst.predict(X, raw_score=True)
+        wd0 = _cval("serve.watchdog.fired",
+                    site="serve.dispatch.device_sum")
+        FAULTS.arm("serve.dispatch.device_sum:hang")
+        t0 = time.monotonic()
+        got = rt.predict(X, raw_score=True)         # watchdog bounds it
+        assert time.monotonic() - t0 < 30.0
+        np.testing.assert_array_equal(got, want)
+        assert _cval("serve.watchdog.fired",
+                     site="serve.dispatch.device_sum") == wd0 + 1
+        assert rt._breakers["device_sum"].state == OPEN
+        # disarm + elapse the backoff: the next predict kicks ONE
+        # background half-open re-probe, which passes and re-closes
+        FAULTS.disarm()
+        time.sleep(0.06)
+        deadline = time.monotonic() + 30.0
+        while rt._breakers["device_sum"].state != CLOSED:
+            rt.predict(X[:8], raw_score=True)
+            if time.monotonic() > deadline:
+                pytest.fail("breaker never re-closed after disarm: "
+                            f"{rt._breakers['device_sum'].state}")
+            time.sleep(0.01)
+        rec = _cval("serve.breaker.recovered", rung="device_sum")
+        assert rec >= 1
+        ds0 = _cval("serve.device_sum")
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        assert _cval("serve.device_sum") > ds0      # rung restored
+
+    def test_corrupt_probe_goes_permanent(self):
+        X, y = _data()
+        bst = _train(X, y)
+        want = bst.predict(X, raw_score=True)
+        # armed BEFORE construction: the refresh-time parity probe sees
+        # corrupted d2h bytes -> content mismatch -> permanent by design
+        FAULTS.arm("serve.d2h.device_sum:corrupt")
+        rt = ServingRuntime(bst, compiled="off")
+        assert not rt.device_sum_active
+        assert rt._breakers["device_sum"].state == PERMANENT
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        # disarm + waiting can NOT resurrect a mismatched rung
+        FAULTS.disarm()
+        time.sleep(0.02)
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        assert rt._breakers["device_sum"].state == PERMANENT
+        # only a full refresh (new export, fresh probes) re-evaluates
+        rt.refresh()
+        assert rt.device_sum_active
+        assert rt._breakers["device_sum"].state == CLOSED
+        ds0 = _cval("serve.device_sum")
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        assert _cval("serve.device_sum") > ds0
+
+    def test_slot_fault_walks_host_byte_identical(self):
+        bst, X, rt = self._runtime(device_sum="off")
+        want = bst.predict(X, raw_score=True)
+        FAULTS.arm("serve.dispatch.slot_path:error")
+        hw0 = _cval("serve.host_walk", cause="device_error")
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        assert _cval("serve.host_walk", cause="device_error") == hw0 + 1
+        # next request: slot breaker open -> skipped, cause=breaker_open
+        bo0 = _cval("serve.host_walk", cause="breaker_open")
+        np.testing.assert_array_equal(rt.predict(X, raw_score=True), want)
+        assert _cval("serve.host_walk", cause="breaker_open") == bo0 + 1
+
+    def test_sharded_replica_fault_stays_byte_identical(self):
+        X, y = _data()
+        bst = _train(X, y)
+        want = bst.predict(X, raw_score=True)
+        srt = ShardedServingRuntime(bst, shard_devices=2,
+                                    max_batch_rows=64, compiled="off")
+        FAULTS.arm("serve.dispatch.device_sum:error")
+        np.testing.assert_array_equal(srt.predict(X, raw_score=True),
+                                      want)
+
+
+# ================================================ prefetch fault (sat 4)
+class TestPrefetchChaos:
+    def test_midstream_fault_surfaces_original_error(self, tmp_path):
+        X, y = _data(300)
+        d = str(tmp_path / "store")
+        create_fleet_store(d, X, y, shard_rows=64)
+        store = ShardStore.open(d)
+        assert store.n_shards >= 4
+        FAULTS.arm("prefetch.read:error@after=2")
+        pf = ShardPrefetcher(store, payload="bins", depth=2)
+        n_before = threading.active_count()
+        got_rows = 0
+        with pytest.raises(LightGBMError, match="injected fault"):
+            for _k, _row0, block in pf:
+                got_rows += block.shape[-1]
+        assert 0 < got_rows < store.n_rows          # genuinely mid-stream
+        # the producer daemon is gone — no leaked reader thread
+        deadline = time.monotonic() + 10.0
+        while any(t.name == "lgbm-tpu-datastore-prefetch" and t.is_alive()
+                  for t in threading.enumerate()):
+            if time.monotonic() > deadline:
+                pytest.fail("prefetch reader thread leaked")
+            time.sleep(0.01)
+        assert threading.active_count() <= n_before + 1
+
+
+# =========================================== fleet chaos + crash safety
+def _fleet(tmp_path, sub="store", registry=False, n=N0, **params):
+    X, y = _data(n)
+    d = str(tmp_path / sub)
+    create_fleet_store(d, X, y, shard_rows=128)
+    base = _train(X, y)
+    reg = None
+    if registry:
+        reg = ModelRegistry(dict(SERVE_PARAMS))
+        reg.load("default", base)
+    p = dict({"fleet_retrain_rows": 64, "fleet_rounds": 2,
+              "fleet_shadow_rows": 64}, **params)
+    daemon = TrainerDaemon(d, reg, base,
+                           train_params=dict(TRAIN_PARAMS), params=p)
+    return d, base, reg, daemon
+
+
+class TestFleetGateChaos:
+    def test_gate_error_fails_closed(self, tmp_path):
+        d, base, _, daemon = _fleet(tmp_path)
+        X2, y2 = _data(64, seed=3)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        FAULTS.arm("fleet.gate:error")
+        ge0 = _cval("fleet.gate.errors")
+        assert daemon.step() is True
+        assert _cval("fleet.gate.errors") == ge0 + 1
+        assert daemon.rejects == 1 and daemon.swaps == 0
+        assert daemon.live_booster is base          # live model untouched
+        # and the persisted verdict records the fail-closed rejection
+        st = read_state(os.path.join(d, STATE_FILE))
+        assert st["last_gate"]["passed"] is False
+        assert "gate error" in st["last_gate"]["reason"]
+
+    def test_gate_hang_fails_closed_via_watchdog(self, tmp_path):
+        d, base, _, daemon = _fleet(tmp_path, fleet_gate_timeout_ms=200.0)
+        X2, y2 = _data(64, seed=4)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        FAULTS.arm("fleet.gate:hang")
+        wd0 = _cval("serve.watchdog.fired", site="fleet.gate")
+        t0 = time.monotonic()
+        assert daemon.step() is True
+        assert time.monotonic() - t0 < 30.0
+        assert _cval("serve.watchdog.fired", site="fleet.gate") == wd0 + 1
+        assert daemon.rejects == 1 and daemon.live_booster is base
+
+    def test_poll_survives_injected_fault(self, tmp_path):
+        d, _, _, daemon = _fleet(tmp_path, fleet_poll_ms=5,
+                                 fleet_max_retrains=1)
+        X2, y2 = _data(64, seed=5)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        # the first poll dies with a NON-LightGBMError (FaultInjected
+        # is a plain RuntimeError); the loop must survive it and
+        # retrain successfully on a later poll
+        FAULTS.arm("fleet.poll:error@n=1")
+        pe0 = _cval("fleet.poll_errors")
+        daemon.start()
+        daemon.join(timeout=120)
+        daemon.stop()
+        assert daemon.retrains == 1
+        assert _cval("fleet.poll_errors") >= pe0
+
+
+class TestFleetCrashSafety:
+    def _chain(self, tmp_path, sub, interrupt):
+        """Run base -> swap -> swap over identical appends; when
+        `interrupt`, the daemon is killed and REBUILT (from the stale
+        base booster, as a restarted process would) between the two."""
+        d, base, _, daemon = _fleet(tmp_path, sub=sub)
+        a1 = _data(64, seed=11)
+        a2 = _data(64, seed=12)
+        ShardStore.open(d).append_rows(
+            a1[0], label=a1[1].astype(np.float32))
+        assert daemon.step() is True
+        assert daemon.swaps == 1, "first continuation must gate-pass"
+        if interrupt:
+            del daemon                              # kill -9 equivalent
+            daemon = TrainerDaemon(d, None, base,
+                                   train_params=dict(TRAIN_PARAMS),
+                                   params={"fleet_retrain_rows": 64,
+                                           "fleet_rounds": 2,
+                                           "fleet_shadow_rows": 64})
+            # recovery reloaded the post-swap model from fleet_model.txt
+            assert daemon.swaps == 1
+        ShardStore.open(d).append_rows(
+            a2[0], label=a2[1].astype(np.float32))
+        assert daemon.step() is True
+        assert daemon.swaps == 2
+        return daemon.live_booster.model_to_string()
+
+    def test_kill_and_restart_chain_byte_identical(self, tmp_path):
+        want = self._chain(tmp_path, "uninterrupted", interrupt=False)
+        rec0 = _cval("fleet.recover.model_restored")
+        got = self._chain(tmp_path, "interrupted", interrupt=True)
+        assert _cval("fleet.recover.model_restored") == rec0 + 1
+        assert got == want, \
+            "restarted daemon's chain diverged from uninterrupted run"
+
+    def test_restart_same_model_resumes_tail_mark(self, tmp_path):
+        d, base, _, daemon = _fleet(tmp_path)
+        X2, y2 = _data(64, seed=21)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        assert daemon.step() is True and daemon.swaps == 1
+        live = daemon.live_booster
+        mark = daemon.trained_rows
+        # 32 more rows land, then the process dies BEFORE retraining
+        X3, y3 = _data(32, seed=22)
+        ShardStore.open(d).append_rows(X3, label=y3.astype(np.float32))
+        del daemon
+        rec0 = _cval("fleet.recover.resumed")
+        daemon = TrainerDaemon(d, None, live,
+                               train_params=dict(TRAIN_PARAMS),
+                               params={"fleet_retrain_rows": 64,
+                                       "fleet_rounds": 2})
+        assert _cval("fleet.recover.resumed") == rec0 + 1
+        # the persisted mark, NOT the current row count: the 32
+        # appended-but-untrained rows still count toward the threshold
+        assert daemon.trained_rows == mark
+        X4, y4 = _data(32, seed=23)
+        ShardStore.open(d).append_rows(X4, label=y4.astype(np.float32))
+        assert daemon.step() is True                # 32+32 >= 64
+
+    def test_corrupt_state_starts_fresh(self, tmp_path):
+        d, base, _, daemon = _fleet(tmp_path)
+        X2, y2 = _data(64, seed=31)
+        ShardStore.open(d).append_rows(X2, label=y2.astype(np.float32))
+        assert daemon.step() is True
+        del daemon
+        path = os.path.join(d, STATE_FILE)
+        blob = open(path).read()
+        open(path, "w").write(blob[:len(blob) // 2])    # torn write
+        sc0 = _cval("fleet.recover.state_corrupt")
+        daemon = TrainerDaemon(d, None, base,
+                               train_params=dict(TRAIN_PARAMS),
+                               params={"fleet_retrain_rows": 64})
+        assert _cval("fleet.recover.state_corrupt") == sc0 + 1
+        assert daemon.live_booster is base          # fresh start
+        assert daemon.trained_rows == ShardStore.open(d).n_rows
+
+    def test_foreign_state_ignored(self, tmp_path):
+        d, base, _, daemon = _fleet(tmp_path)
+        del daemon
+        write_state(os.path.join(d, STATE_FILE),
+                    {"model": "someone-else", "fingerprint": "xyz",
+                     "trained_rows": 1})
+        ig0 = _cval("fleet.recover.ignored")
+        daemon = TrainerDaemon(d, None, base,
+                               train_params=dict(TRAIN_PARAMS),
+                               params={"fleet_retrain_rows": 64})
+        assert _cval("fleet.recover.ignored") == ig0 + 1
+        assert daemon.trained_rows == ShardStore.open(d).n_rows
+
+
+# ======================================================= HTTP cap (sat 1)
+class TestHTTPBodyCap:
+    @pytest.fixture()
+    def server(self):
+        X, y = _data()
+        bst = _train(X, y)
+        client = ServingClient(
+            bst, params=dict(SERVE_PARAMS, serve_max_body_mb=1),
+            name="default")
+        srv = make_server(client, "127.0.0.1", 0)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            yield srv.server_address[1], bst, X
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            client.close()
+
+    def test_oversized_content_length_is_413_unread(self, server):
+        port, _, _ = server
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            # declare a 64 MiB body but send NOTHING: the cap must
+            # reject on the header alone, before any read
+            conn.putrequest("POST", "/predict")
+            conn.putheader("Content-Type", "application/json")
+            conn.putheader("Content-Length", str(64 * 1024 * 1024))
+            conn.endheaders()
+            resp = conn.getresponse()
+            assert resp.status == 413
+            body = json.loads(resp.read())
+            assert "serve_max_body_mb" in body["error"]
+        finally:
+            conn.close()
+
+    def test_malformed_json_is_400(self, server):
+        port, _, _ = server
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        try:
+            conn.request("POST", "/predict", body=b"{not json",
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 400
+            assert "bad request" in json.loads(resp.read())["error"]
+        finally:
+            conn.close()
+
+    def test_under_cap_request_still_serves(self, server):
+        port, bst, X = server
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request("POST", "/predict",
+                         body=json.dumps(
+                             {"rows": X[:4].tolist(),
+                              "raw_score": True}).encode(),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            preds = np.asarray(json.loads(resp.read())["predictions"])
+            np.testing.assert_array_equal(
+                preds, bst.predict(X[:4], raw_score=True))
+        finally:
+            conn.close()
+
+
+# ============================================ batcher worker guard (sat 2)
+class TestBatcherWorkerGuard:
+    def test_loop_crash_fails_batch_and_restarts_worker(self):
+        X, y = _data()
+        bst = _train(X, y)
+        reg = ModelRegistry(dict(SERVE_PARAMS))
+        reg.load("default", bst)
+        want = bst.predict(X[:8], raw_score=True)
+        try:
+            wr0 = _cval("serve.batcher.worker_restarts")
+            FAULTS.arm("serve.flush:error@n=1")
+            with pytest.raises(ServingClosedError,
+                               match="worker crashed"):
+                reg.predict(X[:8], raw_score=True, timeout=60)
+            assert _cval("serve.batcher.worker_restarts") == wr0 + 1
+            # the restarted worker keeps serving, byte-identical
+            np.testing.assert_array_equal(
+                reg.predict(X[:8], raw_score=True, timeout=60), want)
+        finally:
+            reg.close()
+
+
+# ============================================== bounded swap retry (sat 3)
+class TestSwapRetryBound:
+    def test_hot_swap_storm_exhausts_cleanly(self):
+        X, y = _data()
+        bst = _train(X, y)
+        reg = ModelRegistry(dict(SERVE_PARAMS))
+        reg.load("default", bst)
+
+        class _AlwaysClosing:
+            calls = 0
+
+            def predict(self, *a, **k):
+                _AlwaysClosing.calls += 1
+                raise ServingClosedError("swapped mid-dispatch")
+
+        class _SwapDict(dict):
+            # every lookup returns a FRESH closing entry: the registry
+            # sees "a successor is live" forever — a swap storm
+            def get(self, k, default=None):
+                return _AlwaysClosing() if k == "default" else default
+
+        try:
+            storm = _SwapDict(reg._models)
+            reg._models = storm
+            ex0 = _cval("serve.swap_retry_exhausted")
+            with pytest.raises(ServingClosedError, match="giving up"):
+                reg.predict(X[:4], raw_score=True, timeout=30)
+            assert _cval("serve.swap_retry_exhausted") == ex0 + 1
+            assert _AlwaysClosing.calls == 8        # bounded, not forever
+        finally:
+            reg._models = dict(storm)
+            reg.close()
+
+    def test_single_swap_mid_dispatch_still_retries(self):
+        # the existing behavior the bound must NOT break: ONE close with
+        # a live successor retries transparently
+        X, y = _data()
+        bst = _train(X, y)
+        reg = ModelRegistry(dict(SERVE_PARAMS))
+        reg.load("default", bst)
+        want = bst.predict(X[:4], raw_score=True)
+        real = reg.get("default")
+        raised = {"n": 0}
+
+        class _OnceClosing:
+            def predict(self, *a, **k):
+                raised["n"] += 1
+                raise ServingClosedError("swapped")
+
+        class _OnceDict(dict):
+            def get(self, k, default=None):
+                if k == "default" and raised["n"] == 0:
+                    return _OnceClosing()
+                return real if k == "default" else default
+
+        try:
+            reg._models = _OnceDict()
+            got = reg.predict(X[:4], raw_score=True, timeout=60)
+            np.testing.assert_array_equal(got, want)
+            assert raised["n"] == 1
+        finally:
+            reg._models = {"default": real}
+            reg.close()

@@ -8,6 +8,11 @@ informational keys "backend", "partial", "auc").
 prints one JSON line with a `kernel` block — per-impl ms/pass + fused
 speedups — watched by the telemetry-diff sentinel's timing rules.
 
+`--streaming` adds a `streaming` block after the main measurement:
+rounds/s through the shard-streamed engine vs the assembled device
+matrix at the bench shape, shard passes, prefetch stall ratio and the
+device-staging watermark (byte identity asserted in-process).
+
 Baseline anchor (documented; see BASELINE.md "Our target"): the target is
 the reference's **CUDA learner** on Higgs-10.5M (BASELINE.json: ">=1.5x
 CUDA rounds/sec, equal AUC").  No exact public CUDA-learner table exists, so
@@ -151,7 +156,7 @@ TPU_RECORD = {"value": 2.956, "auc": 0.8978, "n": 2_000_000,
 def _emit(rounds_per_sec: float, n_rows: int, backend: str,
           partial: bool, auc=None, pred=None, probe=None,
           telemetry=None, flight=None, pipeline=None,
-          serving=None) -> None:
+          serving=None, streaming=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -201,6 +206,13 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         # per-request p50/p99 latency + rows/s through the micro-batched
         # runtime — diff.py classes these as timing metrics
         line["serving"] = serving
+    if streaming is not None:
+        # streamed-vs-assembled training comparison (@streaming line,
+        # --streaming mode): rounds/s both routes, shard passes, stall
+        # ratio and the device-staging watermark — diff.py fails hard
+        # on a peak_device_mb rise and watches the throughputs as
+        # timing metrics
+        line["streaming"] = streaming
     if backend.startswith("cpu-fallback"):
         line["tpu_record"] = TPU_RECORD
     print(json.dumps(line), flush=True)
@@ -341,6 +353,10 @@ def _run_orchestrator() -> None:
         # closed-loop serving bench rides after the predict bench; the
         # flag travels by env because the worker argv is fixed
         env["BENCH_SERVE"] = "1"
+    if "--streaming" in sys.argv:
+        # shard-streamed vs assembled training comparison (same env
+        # travel as --serve)
+        env["BENCH_STREAMING"] = "1"
 
     worker_timeout = max(60.0, _remaining() - 20)
     _log(f"starting worker: n={n} rounds={rounds} backend={backend_tag} "
@@ -357,6 +373,7 @@ def _run_orchestrator() -> None:
     worker_flight = None
     worker_pipeline = None
     worker_serving = None
+    worker_streaming = None
     platform = backend_tag
     deadline = time.time() + worker_timeout
     try:
@@ -433,6 +450,13 @@ def _run_orchestrator() -> None:
                             line.split(None, 1)[1])
                     except (ValueError, IndexError):
                         pass
+                elif line.startswith("@streaming "):
+                    # streamed-vs-assembled training comparison
+                    try:
+                        worker_streaming = json.loads(
+                            line.split(None, 1)[1])
+                    except (ValueError, IndexError):
+                        pass
     finally:
         try:
             proc.kill()
@@ -445,14 +469,14 @@ def _run_orchestrator() -> None:
         _emit(final, n, platform, partial=False, auc=auc, pred=pred,
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
-              serving=worker_serving)
+              serving=worker_serving, streaming=worker_streaming)
     elif chunks:
         tot_r = sum(c[0] for c in chunks)
         tot_s = sum(c[1] for c in chunks)
         _emit(tot_r / tot_s, n, platform, partial=True, auc=auc, pred=pred,
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
-              serving=worker_serving)
+              serving=worker_serving, streaming=worker_streaming)
     else:
         # nothing measured — still emit a parseable line (value 0) so the
         # round records an explicit failure instead of rc=124/None
@@ -460,7 +484,7 @@ def _run_orchestrator() -> None:
         _emit(0.0, n, platform + "-failed", partial=True,
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
-              serving=worker_serving)
+              serving=worker_serving, streaming=worker_streaming)
 
 
 # --------------------------------------------------------------------------
@@ -888,6 +912,71 @@ def _run_worker() -> None:
                  f"({batch} rows x {iters} requests)")
         except Exception as e:  # pragma: no cover
             _log(f"serving bench failed: {e}")
+
+    # streamed-training bench (--streaming): rounds/s through the
+    # shard-streamed engine vs the assembled device matrix at the bench
+    # shape, plus the pass count and the prefetch stall ratio — the
+    # honest price of HBM-free training in one BENCH JSON block.  One
+    # warmup round each keeps compile out of the timed window; both
+    # routes ride the same spilled store so the comparison isolates
+    # streaming itself.
+    if os.environ.get("BENCH_STREAMING"):
+        try:
+            sr = int(os.environ.get("BENCH_STREAM_ROUNDS", 8))
+            reg = telemetry.REGISTRY
+            # ~8 shards whatever the bench shape: the default budget
+            # would fit small fallback datasets in ONE shard and the
+            # stream degenerates to a re-upload loop
+            sp = {"objective": "binary", "verbosity": -1,
+                  "external_memory": True,
+                  "datastore_shard_rows": max(1024, len(X) // 8),
+                  **BENCH_CONFIG}
+
+            def _timed_run(mode):
+                b = Booster(params={**sp, "streaming_train": mode},
+                            train_set=lgb.Dataset(X, label=y))
+                b.update_many(1)             # warmup incl. compile
+                t0 = time.time()
+                b.update_many(sr)
+                return b, sr / max(time.time() - t0, 1e-9)
+
+            p0 = reg.counter("stream.shard_passes").value
+            h0 = reg.counter("datastore.prefetch.hit").value
+            s0 = reg.counter("datastore.prefetch.stall").value
+            bst_a, a_rps = _timed_run("off")
+            bst_s, s_rps = _timed_run("on")
+            passes = int(reg.counter("stream.shard_passes").value - p0)
+            hits = reg.counter("datastore.prefetch.hit").value - h0
+            stalls = reg.counter("datastore.prefetch.stall").value - s0
+            strip = (lambda t: "\n".join(
+                l for l in t.splitlines() if not l.startswith("[")))
+            blk = {"rounds": sr,
+                   "assembled_rounds_per_sec": round(a_rps, 3),
+                   "streamed_rounds_per_sec": round(s_rps, 3),
+                   "streamed_vs_assembled":
+                       float(f"{s_rps / max(a_rps, 1e-9):.3g}"),
+                   "shard_passes": passes,
+                   "shards": int(reg.gauge("stream.shards").value),
+                   "stall_ratio":
+                       round(stalls / max(hits + stalls, 1), 4),
+                   "peak_device_mb":
+                       reg.gauge("stream.peak_device_mb").value,
+                   "byte_identical":
+                       strip(bst_a.model_to_string())
+                       == strip(bst_s.model_to_string())}
+            assert blk["byte_identical"], \
+                "streamed bench model diverged from assembled"
+            print("@streaming " + json.dumps(blk, separators=(",", ":")),
+                  flush=True)
+            _log(f"streaming bench: {blk['streamed_rounds_per_sec']} "
+                 f"rounds/s streamed vs "
+                 f"{blk['assembled_rounds_per_sec']} assembled "
+                 f"({blk['streamed_vs_assembled']}x) over "
+                 f"{passes} shard passes, stall ratio "
+                 f"{blk['stall_ratio']}, peak device "
+                 f"{blk['peak_device_mb']} MB")
+        except Exception as e:  # pragma: no cover
+            _log(f"streaming bench failed: {e}")
     _stream_telemetry()
     _stream_flight(bst)
     telemetry.TRACER.flush()

@@ -78,6 +78,13 @@ class _DeviceData:
         # matrices — bins_fm/bundle_fm assemble lazily by STREAMING shards
         # to the device (datastore/assemble.py), not via a full host copy
         self._store = getattr(ds, "datastore", None)
+        # one accounting object for every prefetcher this dataset spawns
+        # (bins + bundle assembly, sharded placement): hit/stall totals
+        # and the residency watermark accumulate per RUN, not per pass
+        self._pf_stats = None
+        if self._store is not None:
+            from .datastore.prefetch import PrefetchRunStats
+            self._pf_stats = PrefetchRunStats()
         self._for_train = for_train
         self._bins_fm = None
         if ds.bin_data is not None:
@@ -149,7 +156,8 @@ class _DeviceData:
         from .datastore.assemble import assemble_feature_major
         depth = Config(self._ds.params or {}).datastore_prefetch
         return assemble_feature_major(self._store, payload=payload,
-                                      prefetch_depth=depth)
+                                      prefetch_depth=depth,
+                                      run_stats=self._pf_stats)
 
     @property
     def bins_fm(self):
@@ -343,6 +351,11 @@ class Booster:
                      "external_memory", "datastore_dir",
                      "datastore_shard_rows", "datastore_budget_mb",
                      "datastore_prefetch")}}
+        if str(self.config.streaming_train or "auto").lower() == "on":
+            # streaming_train="on" implies the external-memory spill: the
+            # shard store IS the stream source, so it must exist before
+            # _DeviceData constructs the dataset
+            train_set.params["external_memory"] = True
         self.train_set = train_set
         self._dd = _DeviceData(train_set)
         self.objective_: Optional[ObjectiveFunction] = \
@@ -1029,13 +1042,15 @@ class Booster:
         # fire once, after the cache check
         kind, shards, n_dev, dcn, use_2level, _ = self._learner_topology()
         if kind == "serial":
+            self._mesh = None
+            self._learner_cache_key = None
+            if self._setup_streaming():
+                return
             # external-memory sets keep _train_bins unresolved here: the
             # first train.chunk span assembles it (_ensure_train_bins), so
             # the per-shard H2D spans land inside the pipeline window
-            self._mesh = None
             self._train_bins = None if self._dd.datastore_pending else (
                 self._dd.bundle_fm if bundled else self._dd.bins_fm)
-            self._learner_cache_key = None
             return
         # reset_parameter (lr schedules) calls this every iteration — reuse
         # the compiled grower and placed bins when nothing changed
@@ -1059,12 +1074,24 @@ class Booster:
             log.warning(f"tree_learner={kind} requested but only one device "
                         "is visible; using the serial learner")
             self._mesh = None
+            self._learner_cache_key = key
+            if self._setup_streaming():
+                return
             # external-memory: defer the assembly into the first
             # train.chunk span, exactly like the serial early-return
             self._train_bins = None if self._dd.datastore_pending else (
                 self._dd.bundle_fm if bundled else self._dd.bins_fm)
-            self._learner_cache_key = key
             return
+        self._streaming = None
+        if str(cfg.streaming_train or "auto").lower() == "on":
+            telemetry.REGISTRY.counter("fallback.events").inc()
+            telemetry.event("fallback.stream_downgrade",
+                            reasons=[f"tree_learner={kind}"])
+            log.warning("streaming_train=on is not supported with "
+                        f"tree_learner={kind} (shard-streamed training is "
+                        "serial-only; distributed learners stream shards "
+                        "once at placement instead) — training on the "
+                        "placed device matrix")
         from .mesh import get_mesh, get_mesh_2level
         from .parallel.learner import make_distributed_grower, \
             place_training_data
@@ -1089,7 +1116,8 @@ class Booster:
                 payload="bundle" if bundled else "bins",
                 pad_features=pad_features,
                 prefetch_depth=cfg.datastore_prefetch,
-                collective_timeout_ms=cfg.mesh_collective_timeout_ms)
+                collective_timeout_ms=cfg.mesh_collective_timeout_ms,
+                run_stats=self._dd._pf_stats)
         else:
             if self._dd.datastore_pending:
                 log.warning("tree_learner=feature with external_memory "
@@ -1103,16 +1131,91 @@ class Booster:
                 pad_features=pad_features)
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
-            self._dd.num_feature, self._dd.num_data, wave=wave)
+            self._dd.num_feature, self._dd.num_data, wave=wave,
+            det_reduce=bool(self.config.deterministic_reduce))
         self._learner_cache_key = key
         log.info(f"tree_learner={kind}: training sharded over "
                  f"{shards} device(s)")
+
+    def _setup_streaming(self) -> bool:
+        """Engage the shard-streamed grower (lightgbm_tpu/streaming) for
+        serial training: `streaming_train="on"` always (downgrade warns),
+        `"auto"` only when the assembled device matrix would exceed
+        `datastore_budget_mb` — the point where the budget stops being
+        the real memory ceiling.  Returns True when the streamed engine
+        is installed as `self._grower` (train bins never assemble)."""
+        cfg = self.config
+        mode = str(cfg.streaming_train or "auto").lower()
+        if mode not in ("auto", "on", "off"):
+            raise LightGBMError(
+                f"Unknown streaming_train {mode!r} "
+                "(expected 'auto', 'on' or 'off')")
+        self._streaming = None
+        if mode == "off":
+            return False
+        from .streaming import (streaming_downgrade_reasons,
+                                streaming_spec)
+        store = self._dd.store if self._dd.datastore_pending else None
+        spec = streaming_spec(self._grower_spec, self._grow_policy)
+        reasons = streaming_downgrade_reasons(spec, store)
+        if self._boost_mode == "dart":
+            reasons.append("boosting=dart (drop replay traverses the "
+                           "resident train bins)")
+        if cfg.linear_tree:
+            reasons.append("linear_tree (leaf fits read the raw matrix)")
+        if mode == "auto":
+            if store is None:
+                return False
+            budget = float(cfg.datastore_budget_mb) * 2 ** 20
+            if store.total_bytes("bins") <= budget:
+                return False      # the assembled matrix fits the budget
+            if reasons:
+                # the user's budget WILL be exceeded by assembly — say so
+                telemetry.REGISTRY.counter("fallback.events").inc()
+                telemetry.event("fallback.stream_downgrade",
+                                reasons=reasons)
+                log.warning(
+                    "the assembled bin matrix exceeds datastore_budget_mb"
+                    f"={cfg.datastore_budget_mb} but streamed training is "
+                    "not supported with " + "; ".join(reasons)
+                    + " — assembling anyway (device memory is the "
+                    "ceiling)")
+                return False
+        elif reasons:
+            telemetry.REGISTRY.counter("fallback.events").inc()
+            telemetry.event("fallback.stream_downgrade", reasons=reasons)
+            log.warning("streaming_train=on is not supported with "
+                        + "; ".join(reasons)
+                        + " — using in-memory training (device memory is "
+                        "the ceiling, not datastore_budget_mb)")
+            return False
+        depth = int(cfg.streaming_prefetch_depth or cfg.datastore_prefetch)
+        key = (spec, depth)
+        if getattr(self, "_stream_cache_key", None) != key:
+            from .streaming import StreamingWaveGrower
+            # the dataset's run-wide accounting object: streamed waves
+            # and any assembly/placement prefetchers publish ONE
+            # hit/stall total and one residency watermark per run
+            self._stream_engine = StreamingWaveGrower(
+                spec, store, prefetch_depth=depth,
+                run_stats=self._dd._pf_stats)
+            self._stream_cache_key = key
+            log.info(
+                f"streaming_train: shard-streamed training engaged "
+                f"({store.n_shards} shards x ~{store.shard_rows} rows; "
+                f"bins never materialize on device)")
+        self._streaming = self._stream_engine
+        self._grower = self._stream_engine
+        self._train_bins = None
+        return True
 
     def _ensure_train_bins(self) -> None:
         """Resolve a lazily-deferred training matrix (external-memory
         serial path).  Called inside the surrounding train.chunk span so
         the one-time shard-streaming assembly shows up as nested
         train.shard spans; later calls are no-ops."""
+        if getattr(self, "_streaming", None) is not None:
+            return  # streamed training: bins never assemble
         if self._train_bins is not None or getattr(self, "_dd", None) is None:
             return
         self._train_bins = self._dd.bundle_fm \
@@ -1672,6 +1775,9 @@ class Booster:
         cfg = self.config
         ok = (self._fobj is None and self.objective_ is not None
               and self._boost_mode in ("gbdt", "rf")
+              # streamed training is host-driven per wave — it cannot run
+              # inside a fused device-side chunk
+              and getattr(self, "_streaming", None) is None
               # CEGB coupled penalties mutate per-model host state;
               # linear-leaf ridge fits run on the host raw matrix;
               # stateful objectives (position-debiased lambdarank) update

@@ -43,13 +43,19 @@ def _stitch_fn():
 
 
 def assemble_feature_major(store, payload: str = "bins",
-                           prefetch_depth: int = 2):
+                           prefetch_depth: int = 2, run_stats=None):
     """Stream `payload` shards from `store` into one [F|G, N] device array.
 
     Returns the assembled jnp array.  Telemetry: per-shard `train.shard`
     spans, `datastore.prefetch.{hit,stall}` counters and the
     `datastore.peak_resident_mb` gauge (host bytes held by the
     prefetch pipeline at its widest).
+
+    `run_stats` (a `PrefetchRunStats`) makes the accounting survive this
+    prefetcher: repeated assemblies within one training run (bins +
+    bundle, grower rebuilds) accumulate hit/stall totals there and the
+    gauge publishes the RUN maximum residency instead of whichever
+    assembly happened to run last.
     """
     import jax.numpy as jnp
 
@@ -65,9 +71,21 @@ def assemble_feature_major(store, payload: str = "bins",
 
     hit = telemetry.REGISTRY.counter("datastore.prefetch.hit")
     stall = telemetry.REGISTRY.counter("datastore.prefetch.stall")
+
+    def on_hit():
+        hit.inc()
+        if run_stats is not None:
+            run_stats.hit()
+
+    def on_stall():
+        stall.inc()
+        if run_stats is not None:
+            run_stats.stall()
+
+    if run_stats is not None:
+        run_stats.start_pass()
     pf = ShardPrefetcher(store, payload=payload, depth=prefetch_depth,
-                         on_hit=lambda: hit.inc(),
-                         on_stall=lambda: stall.inc())
+                         on_hit=on_hit, on_stall=on_stall)
     try:
         for k, row0, block in pf:
             with telemetry.span("train.shard", shard=k,
@@ -77,7 +95,10 @@ def assemble_feature_major(store, payload: str = "bins",
                 out.block_until_ready()
     finally:
         pf.close()
-        peak_mb = pf.peak_resident_bytes / (1024.0 * 1024.0)
+        peak = pf.peak_resident_bytes
+        if run_stats is not None:
+            run_stats.absorb(pf)
+            peak = run_stats.peak_resident_bytes
         telemetry.REGISTRY.gauge("datastore.peak_resident_mb").set(
-            round(peak_mb, 3))
+            round(peak / (1024.0 * 1024.0), 3))
     return out

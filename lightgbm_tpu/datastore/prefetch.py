@@ -41,6 +41,56 @@ except ImportError:  # same jax-free file-path load
 _DONE = object()
 
 
+class PrefetchRunStats:
+    """Prefetch accounting that SURVIVES the prefetcher.
+
+    Streamed training creates a short-lived `ShardPrefetcher` for every
+    shard pass (several per tree), so per-instance counters would reset
+    per wave and the published gauges would describe only the last pass.
+    One `PrefetchRunStats` owns the accounting for a whole training run:
+    hit/stall totals accumulate across instances (wire `hit`/`stall` as
+    the prefetcher's callbacks), `start_pass` counts full-datastore
+    sweeps, and `absorb(pf)` folds a closing prefetcher's peak host
+    residency into the run maximum — the streaming steady state, not
+    the last wave's transient.
+
+    Like the prefetcher itself this class is telemetry-free (jax-free
+    import matrix); callers mirror the totals into gauges/counters.
+    """
+
+    __slots__ = ("hits", "stalls", "passes", "peak_resident_bytes",
+                 "_on_hit", "_on_stall")
+
+    def __init__(self, on_hit: Optional[Callable[[], None]] = None,
+                 on_stall: Optional[Callable[[], None]] = None):
+        self.hits = 0
+        self.stalls = 0
+        self.passes = 0
+        self.peak_resident_bytes = 0
+        self._on_hit = on_hit or (lambda: None)
+        self._on_stall = on_stall or (lambda: None)
+
+    def hit(self) -> None:
+        self.hits += 1
+        self._on_hit()
+
+    def stall(self) -> None:
+        self.stalls += 1
+        self._on_stall()
+
+    def start_pass(self) -> None:
+        self.passes += 1
+
+    def absorb(self, pf: "ShardPrefetcher") -> None:
+        if pf.peak_resident_bytes > self.peak_resident_bytes:
+            self.peak_resident_bytes = pf.peak_resident_bytes
+
+    @property
+    def stall_ratio(self) -> float:
+        asked = self.hits + self.stalls
+        return self.stalls / asked if asked else 0.0
+
+
 class ShardPrefetcher:
     """Iterate (shard index, row0, block) with a bounded read-ahead."""
 

@@ -30,7 +30,8 @@ from ..utils.log import LightGBMError
 from .compat import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["padded_feature_count", "padded_row_count",
-           "record_placement", "collective_span", "place_from_datastore"]
+           "record_placement", "collective_span", "place_from_datastore",
+           "stream_shard_plan"]
 
 
 def padded_feature_count(num_feature: int, shards: int) -> int:
@@ -94,11 +95,51 @@ def collective_span(name: str, **attrs):
     return _CollectiveTimer(full, span(full, **attrs))
 
 
+def stream_shard_plan(store, mesh: Mesh = None):
+    """Pinned shard read order for streamed training.
+
+    Serial (``mesh=None``): the whole datastore in ascending shard
+    order — ONE canonical order, because streamed f32 histogram
+    accumulation is order-sensitive and byte-identity to the assembled
+    matrix requires exactly the storage row order.
+
+    With a mesh: one plan per device (row-major over the flat device
+    list, same row mapping as ``place_from_datastore``), each covering
+    only the rows that device owns — shards straddling a device
+    boundary appear in both plans with a shard-relative row selection,
+    so a data-parallel learner can re-stream per device without ever
+    assembling its block.  Tail padding rows (beyond ``store.n_rows``)
+    are absent from every plan; the caller pads state, not bins.
+    """
+    if mesh is None:
+        return [(k, None) for k in range(store.n_shards)]
+    S_total = 1
+    for a in tuple(mesh.axis_names):
+        S_total *= int(mesh.shape[a])
+    rows_per = padded_row_count(store.n_rows, S_total) // S_total
+    plans = []
+    for d_i in range(S_total):
+        lo, hi = d_i * rows_per, (d_i + 1) * rows_per
+        plan = []
+        for k in range(store.n_shards):
+            row0 = store.row0_of(k)
+            rk = store.rows_of(k)
+            a, b = max(row0, lo), min(row0 + rk, hi)
+            if b <= a:
+                continue
+            rel = None if (a == row0 and b == row0 + rk) \
+                else np.arange(a - row0, b - row0)
+            plan.append((k, rel))
+        plans.append(plan)
+    return plans
+
+
 def place_from_datastore(store, mesh: Mesh, kind: str,
                          payload: str = "bins",
                          pad_features: bool = True,
                          prefetch_depth: int = 2,
-                         collective_timeout_ms: float = 0.0):
+                         collective_timeout_ms: float = 0.0,
+                         run_stats=None):
     """Stream datastore shards straight into per-device row blocks.
 
     The sharded equivalent of ``datastore.assemble.assemble_feature_
@@ -139,9 +180,21 @@ def place_from_datastore(store, mesh: Mesh, kind: str,
 
     hit = telemetry.REGISTRY.counter("datastore.prefetch.hit")
     stall = telemetry.REGISTRY.counter("datastore.prefetch.stall")
+
+    def on_hit():
+        hit.inc()
+        if run_stats is not None:
+            run_stats.hit()
+
+    def on_stall():
+        stall.inc()
+        if run_stats is not None:
+            run_stats.stall()
+
+    if run_stats is not None:
+        run_stats.start_pass()
     pf = ShardPrefetcher(store, payload=payload, depth=prefetch_depth,
-                         on_hit=lambda: hit.inc(),
-                         on_stall=lambda: stall.inc())
+                         on_hit=on_hit, on_stall=on_stall)
     it = iter(pf)
     cur = None  # carried (row0, block) straddling a device boundary
     bufs = []
@@ -186,9 +239,14 @@ def place_from_datastore(store, mesh: Mesh, kind: str,
                     bufs.append(sup.call(_put))
         finally:
             pf.close()
-            peak_mb = pf.peak_resident_bytes / (1024.0 * 1024.0)
+            peak = pf.peak_resident_bytes
+            if run_stats is not None:
+                # run-max, not this placement's transient — repeated
+                # placements in one run must not reset the watermark
+                run_stats.absorb(pf)
+                peak = run_stats.peak_resident_bytes
             telemetry.REGISTRY.gauge("datastore.peak_resident_mb").set(
-                round(peak_mb, 3))
+                round(peak / (1024.0 * 1024.0), 3))
     placed = jax.make_array_from_single_device_arrays(
         (f_pad, n_pad), NamedSharding(mesh, P(None, axes)), bufs)
     record_placement(placed)

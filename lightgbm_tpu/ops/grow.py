@@ -426,7 +426,8 @@ def ic_allowed_from_used(feat: Dict[str, Array], used: Array) -> Array:
 
 @functools.lru_cache(maxsize=64)
 def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
-                n_shards: int = 1):
+                n_shards: int = 1, det_reduce: bool = False,
+                num_data: int = 0):
     """Build (and cache) the jitted grow function for a static spec.
 
     With `axis_name`, the grower becomes a DISTRIBUTED tree learner; call it
@@ -508,6 +509,22 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
     axis_last = axes_all[-1] if axes_all else None
     axes_dcn = axes_all[:-1] if axes_all else ()
     block = axis_name is not None and mode in ("data_rs", "feature")
+    # deterministic fixed-order reduction (ROADMAP 1a): replay the SERIAL
+    # accumulation order across shards — histograms fold shard-by-shard
+    # around a ring in ascending shard order (the streamed-carry entries
+    # of ops/histogram.py guarantee fold == one-pass bitwise), and root
+    # sums reduce the gathered row vector with the serial expression —
+    # so every round's tree is byte-identical to the serial grower and
+    # multi-round sharded training cannot drift.  Single data axis only;
+    # voting/feature keep their own merge semantics.
+    det = bool(det_reduce) and axes_all is not None \
+        and len(axes_all) == 1 and mode in ("data", "data_rs") \
+        and n_shards > 1 and num_data > 0
+    if det_reduce and axes_all is not None and not det:
+        from ..utils import log
+        log.info(f"deterministic_reduce: unsupported topology "
+                 f"(mode={mode}, axes={axes_all}, shards={n_shards}, "
+                 f"num_data={num_data}) — keeping the tree-psum reduction")
     if block and axes_dcn and mode == "feature":
         raise ValueError("feature-parallel over a 2-level mesh is not "
                          "supported; use the data strategy")
@@ -572,10 +589,86 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
                                              debug=spec.debug_checks)
         one_slot = jnp.zeros((1,), jnp.int32)
 
+        if det:
+            # ring-chained deterministic histogram: shard t folds its
+            # local rows onto the carry received from shard t-1, so the
+            # scatter-add sequence is exactly the serial one-pass order
+            # over rows 0..num_data.  Pad rows (weight 0, absent from the
+            # serial program) key to a dropped extra column instead of
+            # adding a bit-flipping +0.0 to live cells.
+            row0_g = jax.lax.axis_index(axis_last) * N
+            det_valid = row0_g + jnp.arange(N) < num_data
+            det_cols = jnp.where(det_valid[None, :],
+                                 hist_bins.astype(jnp.int32), HB)
+            det_perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+            packed_fam = spec.hist_impl in ("packed", "pallas_q")
+            if packed_fam:
+                from .histogram import (hist_stream_packed_finalize,
+                                        hist_stream_packed_init,
+                                        hist_stream_packed_update)
+
+            def det_hist(mask_rows):
+                Fh = hist_bins.shape[0]
+                if packed_fam:
+                    chl = spec.packed_const_hess_level
+                    lid = jnp.where(mask_rows & det_valid, 0, -1)\
+                        .astype(jnp.int32)
+
+                    def fold(acc):
+                        return hist_stream_packed_update(
+                            acc, hist_bins, payload, lid, one_slot, HB,
+                            feat["qscales"][0], feat["qscales"][1],
+                            const_hess_level=chl)
+
+                    recv = hist_stream_packed_init(Fh, 1, HB, chl)
+                    mine = recv
+                    for t in range(n_shards):
+                        mine = fold(recv)
+                        if t < n_shards - 1:
+                            recv = {k: jax.lax.ppermute(v, axis_last,
+                                                        det_perm)
+                                    for k, v in mine.items()}
+                    full = {k: jax.lax.all_gather(
+                                v, axis_last)[n_shards - 1]
+                            for k, v in mine.items()}
+                    h = hist_stream_packed_finalize(
+                        full, Fh, 1, HB, feat["qscales"][0],
+                        feat["qscales"][1], const_hess_level=chl)[0]
+                else:
+                    d_full = jnp.where(mask_rows[:, None], payload, 0.0)
+
+                    def fold(acc):
+                        def channel(a_c, vals):
+                            return jax.vmap(
+                                lambda a_f, col: a_f.at[col].add(vals))(
+                                    a_c, det_cols)
+                        return jnp.stack([channel(acc[c], d_full[:, c])
+                                          for c in range(3)])
+
+                    recv = jnp.zeros((3, Fh, HB + 1), jnp.float32)
+                    mine = recv
+                    for t in range(n_shards):
+                        mine = fold(recv)
+                        if t < n_shards - 1:
+                            recv = jax.lax.ppermute(mine, axis_last,
+                                                    det_perm)
+                    full = jax.lax.all_gather(
+                        mine, axis_last)[n_shards - 1]
+                    h = jnp.stack([full[0], full[1], full[2]],
+                                  axis=-1)[:, :HB]
+                if mode == "data_rs":
+                    Fb_h = h.shape[0] // n_shards
+                    h = jax.lax.dynamic_slice_in_dim(
+                        h, jax.lax.axis_index(axis_last) * Fb_h, Fb_h,
+                        axis=0)
+                return h
+
         def hist_of(mask_rows):
             # named scopes feed XProf/Perfetto timelines (SURVEY §5: the
             # reference only has USE_TIMETAG chrono counters)
             with jax.named_scope("histogram"):
+                if det:
+                    return det_hist(mask_rows)
                 if spec.hist_impl == "pallas":
                     lid = jnp.where(mask_rows, 0, -1).astype(jnp.int32)
                     h = pallas_histogram_multi_rows(
@@ -684,15 +777,26 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
         # ---- root ----
         root_mask = jnp.ones((N,), dtype=bool)
         hist0 = hist_of(root_mask)
-        root_g = payload[:, 0].sum()
-        root_h = payload[:, 1].sum()
-        root_c = payload[:, 2].sum()
-        if axis_name is not None and mode != "feature":
-            # ref: DataParallelTreeLearner::BeforeTrain root-stat Allreduce
-            # (feature mode holds all rows on every shard — already global)
-            root_g = jax.lax.psum(root_g, axes_all)
-            root_h = jax.lax.psum(root_h, axes_all)
-            root_c = jax.lax.psum(root_c, axes_all)
+        if det:
+            # deterministic root stats: gather the rows back into storage
+            # order (pad tail sliced off) and reduce with the serial
+            # grower's own expression — no psum of per-shard partials
+            gp = jax.lax.all_gather(payload, axis_last, axis=0,
+                                    tiled=True)[:num_data]
+            root_g = gp[:, 0].sum()
+            root_h = gp[:, 1].sum()
+            root_c = gp[:, 2].sum()
+        else:
+            root_g = payload[:, 0].sum()
+            root_h = payload[:, 1].sum()
+            root_c = payload[:, 2].sum()
+            if axis_name is not None and mode != "feature":
+                # ref: DataParallelTreeLearner::BeforeTrain root-stat
+                # Allreduce (feature mode holds all rows on every shard —
+                # already global)
+                root_g = jax.lax.psum(root_g, axes_all)
+                root_h = jax.lax.psum(root_h, axes_all)
+                root_c = jax.lax.psum(root_c, axes_all)
         root_out = clamp_output(root_g, root_h)
         if spec.n_ic_groups:
             # only features inside some constraint group may ever split

@@ -58,7 +58,11 @@ from .grow import (DeviceTree, GrowerSpec, _split_to_arrays,
                    make_feature_blocks, make_node_samplers,
                    rebase_and_merge_block_split, split_go_left)
 from ..analysis.contracts import contract
-from .histogram import leaf_histogram_multi, leaf_histogram_packed_multi
+from .histogram import (hist_stream_finalize, hist_stream_init,
+                        hist_stream_packed_finalize,
+                        hist_stream_packed_init,
+                        hist_stream_packed_update, hist_stream_update,
+                        leaf_histogram_multi, leaf_histogram_packed_multi)
 from .split import (NEG_INF, decide_from_candidates, find_best_split,
                     leaf_output, merge_split_results, smooth_output)
 
@@ -86,7 +90,8 @@ def wave_sizes(spec: GrowerSpec):
 
 @functools.lru_cache(maxsize=64)
 def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
-                     n_shards: int = 1):
+                     n_shards: int = 1, det_reduce: bool = False,
+                     num_data: int = 0):
     """Build (and cache) the jitted wave grower for a static spec.
 
     Same contract as `ops.grow.make_grower`; with `axis_name` the grower
@@ -141,6 +146,20 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
     block = axes_all is not None and mode == "data_rs"
     axis_last = axes_all[-1] if axes_all else None
     axes_dcn = axes_all[:-1] if axes_all else ()
+    # deterministic fixed-order reduction (ROADMAP 1a) — same contract
+    # as the strict grower: wave histograms fold shard-by-shard around a
+    # ring in ascending shard order (the streamed-carry entries of
+    # ops/histogram.py make the fold bitwise-equal to the one-pass
+    # multi-leaf builders) and root sums reduce the gathered rows with
+    # the serial expression, so multi-round sharded wave training stays
+    # byte-identical to serial.  Single data axis only.
+    det = bool(det_reduce) and axes_all is not None \
+        and len(axes_all) == 1 and n_shards > 1 and num_data > 0
+    if det_reduce and axes_all is not None and not det:
+        from ..utils import log
+        log.info(f"deterministic_reduce: unsupported topology "
+                 f"(mode={mode}, axes={axes_all}, shards={n_shards}, "
+                 f"num_data={num_data}) — keeping the tree-psum reduction")
     if block and spec.bundled:
         raise ValueError("EFB bundling requires mode='data' for the "
                          "distributed wave grower (bundle columns do not "
@@ -222,12 +241,69 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         else:
             bfeat, bmono = feat, mono
 
+        if det:
+            det_perm = [(i, (i + 1) % n_shards) for i in range(n_shards)]
+            det_packed = hist_fam in ("packed", "pallas_q")
+
+            def det_hist_multi(leaf_id, slots):
+                """Ring-chained deterministic wave histogram: bitwise the
+                serial `hist_multi` (pad rows carry leaf_id -1 and match
+                no slot, so they never touch live cells)."""
+                Fh = bins_fm.shape[0]
+                S = slots.shape[0]
+                if det_packed:
+                    chl = spec.packed_const_hess_level
+
+                    def fold(acc):
+                        return hist_stream_packed_update(
+                            acc, bins_fm, payload, leaf_id, slots, HB,
+                            feat["qscales"][0], feat["qscales"][1],
+                            const_hess_level=chl)
+
+                    recv = hist_stream_packed_init(Fh, S, HB, chl)
+                    mine = recv
+                    for t in range(n_shards):
+                        mine = fold(recv)
+                        if t < n_shards - 1:
+                            recv = {k: jax.lax.ppermute(v, axis_last,
+                                                        det_perm)
+                                    for k, v in mine.items()}
+                    full = {k: jax.lax.all_gather(
+                                v, axis_last)[n_shards - 1]
+                            for k, v in mine.items()}
+                    h = hist_stream_packed_finalize(
+                        full, Fh, S, HB, feat["qscales"][0],
+                        feat["qscales"][1], const_hess_level=chl)
+                else:
+                    def fold(acc):
+                        return hist_stream_update(acc, bins_fm, payload,
+                                                  leaf_id, slots, HB)
+
+                    recv = hist_stream_init(Fh, S, HB)
+                    mine = recv
+                    for t in range(n_shards):
+                        mine = fold(recv)
+                        if t < n_shards - 1:
+                            recv = jax.lax.ppermute(mine, axis_last,
+                                                    det_perm)
+                    full = jax.lax.all_gather(
+                        mine, axis_last)[n_shards - 1]
+                    h = hist_stream_finalize(full, Fh, S, HB)
+                if block:
+                    Fb_h = h.shape[1] // n_shards
+                    h = jax.lax.dynamic_slice_in_dim(
+                        h, jax.lax.axis_index(axis_last) * Fb_h, Fb_h,
+                        axis=1)
+                return h
+
         def hist_multi(leaf_id, slots):
             """[S, F|G|Fb, HB, 3] histograms of the listed leaf slots in
             one batched sweep; pad slots (value LB) yield zeros.  Under
             data_rs the returned feature axis is this shard's summed
             block (psum_scatter over ICI + psum over DCN)."""
             with jax.named_scope("histogram_wave"):
+                if det:
+                    return det_hist_multi(leaf_id, slots)
                 if hist_fam == "pallas":
                     h = pallas_histogram_multi_rows(
                         bins_fm, pw_prep, leaf_id, slots, HB,
@@ -368,16 +444,35 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         # [W, N] slot compare + reduce at COMPILE time (observed: 10.3 s
         # fold stall per chunk program at N=100k — BENCH_r03 tail); the
         # barrier trades that for a trivial runtime zeros-fill
-        leaf_id0 = jax.lax.optimization_barrier(
-            jnp.zeros((N,), jnp.int32))
+        if det:
+            # pad rows (beyond num_data) start at leaf -1: they match no
+            # histogram slot and no partition descriptor, so the det
+            # chain never replays a +0.0 the serial program doesn't have
+            row0_g = jax.lax.axis_index(axis_last) * N
+            det_valid = row0_g + jnp.arange(N) < num_data
+            leaf_id0 = jax.lax.optimization_barrier(
+                jnp.where(det_valid, 0, -1).astype(jnp.int32))
+        else:
+            leaf_id0 = jax.lax.optimization_barrier(
+                jnp.zeros((N,), jnp.int32))
         root_slots = jnp.full((W,), LB, jnp.int32).at[0].set(0)
-        root_g = payload[:, 0].sum()
-        root_h = payload[:, 1].sum()
-        root_c = payload[:, 2].sum()
-        if axes_all is not None:
-            root_g = jax.lax.psum(root_g, axes_all)
-            root_h = jax.lax.psum(root_h, axes_all)
-            root_c = jax.lax.psum(root_c, axes_all)
+        if det:
+            # deterministic root stats: gather the rows back into storage
+            # order (pad tail sliced off) and reduce with the serial
+            # grower's own expression — no psum of per-shard partials
+            gp = jax.lax.all_gather(payload, axis_last, axis=0,
+                                    tiled=True)[:num_data]
+            root_g = gp[:, 0].sum()
+            root_h = gp[:, 1].sum()
+            root_c = gp[:, 2].sum()
+        else:
+            root_g = payload[:, 0].sum()
+            root_h = payload[:, 1].sum()
+            root_c = payload[:, 2].sum()
+            if axes_all is not None:
+                root_g = jax.lax.psum(root_g, axes_all)
+                root_h = jax.lax.psum(root_h, axes_all)
+                root_c = jax.lax.psum(root_c, axes_all)
         root_out = clamp_output(root_g, root_h)
         if spec.n_ic_groups:
             # only features inside some constraint group may ever split
@@ -771,7 +866,9 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
         st = jax.lax.while_loop(cond, body, state)
 
         if LB > L:
-            nodes_f, leaves_f, leaf_id_f, n_splits = _prune_tail(st)
+            nodes_f, leaves_f, leaf_id_f, n_splits = prune_wave_tail(
+                st, LB=LB, L=L, n_forced=n_forced,
+                clamp_output=clamp_output)
             nl_f = n_splits + 1
             slot = jnp.arange(L)
             active = slot < nl_f
@@ -808,112 +905,117 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
             leaf_id=st["leaf_id"],
         )
 
-    def _prune_tail(st):
-        """Prune the LB-leaf wave tree back to L leaves (classic
-        grow-then-prune): iteratively remove the lowest-gain split whose
-        both children are leaves, restore each pruned parent's leaf
-        stats/output from its recorded node sums, then compact the split
-        log to [L-1] — preserving the DeviceTree encoding invariant
-        (right child of split k = leaf slot k+1) by renumbering slots.
-
-        Only reachable with monotone constraints and path smoothing OFF
-        (the booster gates `wave_overgrow`): a restored parent's output
-        is the plain closed form of its (g, h) sums.
-        """
-        nd = st["nodes"]
-        n = st["step"]
-        idx = jnp.arange(LB - 1)
-        sl = nd["split_leaf"]
-        target = jnp.minimum(n, L - 1)
-
-        # forced splits are NEVER prune candidates — the forced-split
-        # contract outranks gain-based pruning.  They occupy the BFS
-        # prefix (indices < the applied forced count), clamped to the
-        # prune target so an absurdly deep forced chain cannot make the
-        # prune loop unsatisfiable.
-        if n_forced:
-            forced_floor = jnp.minimum(st["forced_n"], target)
-        else:
-            forced_floor = jnp.int32(0)
-
-        def pcond(ps):
-            return ps["n_alive"] > target
-
-        def pbody(ps):
-            alive = ps["alive"]
-            # split i's children are both leaves iff no LATER alive
-            # split targets its left slot (sl[i]) or right slot (i+1)
-            later = alive[None, :] & (idx[None, :] > idx[:, None])
-            hit = (sl[None, :] == sl[:, None]) \
-                | (sl[None, :] == idx[:, None] + 1)
-            removable = alive & ~jnp.any(later & hit, axis=1) \
-                & (idx >= forced_floor)
-            cand = jnp.where(removable, nd["split_gain"], jnp.inf)
-            r = jnp.argmin(cand).astype(jnp.int32)
-            b = sl[r]
-            # the parent becomes a leaf again — restore from node sums
-            return dict(
-                alive=alive.at[r].set(False),
-                n_alive=ps["n_alive"] - 1,
-                leaf_out=ps["leaf_out"].at[b].set(
-                    clamp_output(nd["internal_g"][r],
-                                 nd["internal_h"][r])),
-                leaf_g=ps["leaf_g"].at[b].set(nd["internal_g"][r]),
-                leaf_h=ps["leaf_h"].at[b].set(nd["internal_h"][r]),
-                leaf_c=ps["leaf_c"].at[b].set(nd["internal_cnt"][r]),
-            )
-
-        ps = jax.lax.while_loop(pcond, pbody, dict(
-            alive=idx < n, n_alive=n, leaf_out=st["leaf_out"],
-            leaf_g=st["leaf_g"], leaf_h=st["leaf_h"],
-            leaf_c=st["leaf_c"]))
-        alive = ps["alive"]
-
-        # ---- compact the log: new index k <- old index old_of_new[k] ----
-        new_idx = jnp.cumsum(alive.astype(jnp.int32)) - 1         # [LB-1]
-        old_of_new = jnp.zeros((L - 1,), jnp.int32)\
-            .at[jnp.where(alive, new_idx, L)].set(idx, mode="drop")
-        # big slot s survives iff s == 0 or its creator split is alive;
-        # otherwise its rows belong to the nearest surviving ancestor
-        slot_alive = jnp.concatenate([jnp.ones((1,), bool), alive])
-        parent_slot = jnp.concatenate([jnp.zeros((1,), jnp.int32), sl])
-
-        def resolve(_, t):
-            return jnp.where(slot_alive[t], t, parent_slot[t])
-
-        anc = jax.lax.fori_loop(0, LB, resolve,
-                                jnp.arange(LB, dtype=jnp.int32))   # [LB]
-        new_slot = jnp.concatenate(
-            [jnp.zeros((1,), jnp.int32), new_idx + 1])[anc]        # [LB]
-
-        def g(a):
-            return a[old_of_new]
-
-        n_splits = target
-        valid = jnp.arange(L - 1) < n_splits
-        nodes_f = dict(
-            split_leaf=jnp.where(valid, new_slot[g(sl)], 0),
-            split_feature=jnp.where(valid, g(nd["split_feature"]), 0),
-            threshold_bin=jnp.where(valid, g(nd["threshold_bin"]), 0),
-            default_left=jnp.where(valid, g(nd["default_left"]), False),
-            split_is_cat=jnp.where(valid, g(nd["split_is_cat"]), False),
-            split_cat_mask=jnp.where(valid[:, None],
-                                     g(nd["split_cat_mask"]), False),
-            split_gain=jnp.where(valid, g(nd["split_gain"]), 0.0),
-            internal_g=jnp.where(valid, g(nd["internal_g"]), 0.0),
-            internal_h=jnp.where(valid, g(nd["internal_h"]), 0.0),
-            internal_cnt=jnp.where(valid, g(nd["internal_cnt"]), 0.0),
-        )
-        # final leaf slot k: big slot 0 for k = 0, else the right child
-        # of the kept split with new index k-1
-        big_of = jnp.zeros((L,), jnp.int32)\
-            .at[jnp.where(alive, new_idx + 1, L)].set(idx + 1,
-                                                      mode="drop")
-        leaves_f = dict(out=ps["leaf_out"][big_of],
-                        g=ps["leaf_g"][big_of],
-                        h=ps["leaf_h"][big_of],
-                        c=ps["leaf_c"][big_of])
-        leaf_id_f = new_slot[st["leaf_id"]]
-        return nodes_f, leaves_f, leaf_id_f, n_splits
-
     return jax.jit(grow)
+
+
+def prune_wave_tail(st, *, LB, L, n_forced, clamp_output):
+    """Prune the LB-leaf wave tree back to L leaves (classic
+    grow-then-prune): iteratively remove the lowest-gain split whose
+    both children are leaves, restore each pruned parent's leaf
+    stats/output from its recorded node sums, then compact the split
+    log to [L-1] — preserving the DeviceTree encoding invariant
+    (right child of split k = leaf slot k+1) by renumbering slots.
+
+    Only reachable with monotone constraints and path smoothing OFF
+    (the booster gates `wave_overgrow`): a restored parent's output
+    is the plain closed form of its (g, h) sums.
+
+    Module-level (closure-free) so the streaming engine's host-driven
+    finalize program can reuse it verbatim — the in-memory and streamed
+    growers must prune identically for byte-identity to hold.
+    """
+    nd = st["nodes"]
+    n = st["step"]
+    idx = jnp.arange(LB - 1)
+    sl = nd["split_leaf"]
+    target = jnp.minimum(n, L - 1)
+
+    # forced splits are NEVER prune candidates — the forced-split
+    # contract outranks gain-based pruning.  They occupy the BFS
+    # prefix (indices < the applied forced count), clamped to the
+    # prune target so an absurdly deep forced chain cannot make the
+    # prune loop unsatisfiable.
+    if n_forced:
+        forced_floor = jnp.minimum(st["forced_n"], target)
+    else:
+        forced_floor = jnp.int32(0)
+
+    def pcond(ps):
+        return ps["n_alive"] > target
+
+    def pbody(ps):
+        alive = ps["alive"]
+        # split i's children are both leaves iff no LATER alive
+        # split targets its left slot (sl[i]) or right slot (i+1)
+        later = alive[None, :] & (idx[None, :] > idx[:, None])
+        hit = (sl[None, :] == sl[:, None]) \
+            | (sl[None, :] == idx[:, None] + 1)
+        removable = alive & ~jnp.any(later & hit, axis=1) \
+            & (idx >= forced_floor)
+        cand = jnp.where(removable, nd["split_gain"], jnp.inf)
+        r = jnp.argmin(cand).astype(jnp.int32)
+        b = sl[r]
+        # the parent becomes a leaf again — restore from node sums
+        return dict(
+            alive=alive.at[r].set(False),
+            n_alive=ps["n_alive"] - 1,
+            leaf_out=ps["leaf_out"].at[b].set(
+                clamp_output(nd["internal_g"][r],
+                             nd["internal_h"][r])),
+            leaf_g=ps["leaf_g"].at[b].set(nd["internal_g"][r]),
+            leaf_h=ps["leaf_h"].at[b].set(nd["internal_h"][r]),
+            leaf_c=ps["leaf_c"].at[b].set(nd["internal_cnt"][r]),
+        )
+
+    ps = jax.lax.while_loop(pcond, pbody, dict(
+        alive=idx < n, n_alive=n, leaf_out=st["leaf_out"],
+        leaf_g=st["leaf_g"], leaf_h=st["leaf_h"],
+        leaf_c=st["leaf_c"]))
+    alive = ps["alive"]
+
+    # ---- compact the log: new index k <- old index old_of_new[k] ----
+    new_idx = jnp.cumsum(alive.astype(jnp.int32)) - 1         # [LB-1]
+    old_of_new = jnp.zeros((L - 1,), jnp.int32)\
+        .at[jnp.where(alive, new_idx, L)].set(idx, mode="drop")
+    # big slot s survives iff s == 0 or its creator split is alive;
+    # otherwise its rows belong to the nearest surviving ancestor
+    slot_alive = jnp.concatenate([jnp.ones((1,), bool), alive])
+    parent_slot = jnp.concatenate([jnp.zeros((1,), jnp.int32), sl])
+
+    def resolve(_, t):
+        return jnp.where(slot_alive[t], t, parent_slot[t])
+
+    anc = jax.lax.fori_loop(0, LB, resolve,
+                            jnp.arange(LB, dtype=jnp.int32))   # [LB]
+    new_slot = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), new_idx + 1])[anc]        # [LB]
+
+    def g(a):
+        return a[old_of_new]
+
+    n_splits = target
+    valid = jnp.arange(L - 1) < n_splits
+    nodes_f = dict(
+        split_leaf=jnp.where(valid, new_slot[g(sl)], 0),
+        split_feature=jnp.where(valid, g(nd["split_feature"]), 0),
+        threshold_bin=jnp.where(valid, g(nd["threshold_bin"]), 0),
+        default_left=jnp.where(valid, g(nd["default_left"]), False),
+        split_is_cat=jnp.where(valid, g(nd["split_is_cat"]), False),
+        split_cat_mask=jnp.where(valid[:, None],
+                                 g(nd["split_cat_mask"]), False),
+        split_gain=jnp.where(valid, g(nd["split_gain"]), 0.0),
+        internal_g=jnp.where(valid, g(nd["internal_g"]), 0.0),
+        internal_h=jnp.where(valid, g(nd["internal_h"]), 0.0),
+        internal_cnt=jnp.where(valid, g(nd["internal_cnt"]), 0.0),
+    )
+    # final leaf slot k: big slot 0 for k = 0, else the right child
+    # of the kept split with new index k-1
+    big_of = jnp.zeros((L,), jnp.int32)\
+        .at[jnp.where(alive, new_idx + 1, L)].set(idx + 1,
+                                                  mode="drop")
+    leaves_f = dict(out=ps["leaf_out"][big_of],
+                    g=ps["leaf_g"][big_of],
+                    h=ps["leaf_h"][big_of],
+                    c=ps["leaf_c"][big_of])
+    leaf_id_f = new_slot[st["leaf_id"]]
+    return nodes_f, leaves_f, leaf_id_f, n_splits

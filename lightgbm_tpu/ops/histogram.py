@@ -259,3 +259,137 @@ def leaf_histogram_packed_multi(bins_fm: Array, payload: Array,
 
     out = jax.vmap(per_feature)(cols.reshape(F, T, PACKED_TILE))
     return out.reshape(F, S + 1, max_bin, 3)[:, :S].transpose(1, 0, 2, 3)
+
+
+# ---------------------------------------------------------------------------
+# Streamed carry accumulation (init / update / finalize)
+# ---------------------------------------------------------------------------
+# These decompose the one-pass builders above so a host loop can fold
+# datastore shards into a wave histogram without materialising the full
+# [F, N] bin matrix.  Bitwise contract with the one-pass builders:
+#
+#   * f32 family — `segment_sum` lowers to an in-order scatter-add, so a
+#     per-shard `carry.at[cols].add(vals)` applied in pinned shard order
+#     performs the exact same sequence of float adds per (leaf, bin)
+#     cell as `leaf_histogram_multi` over the concatenated rows.
+#   * packed family — carries are int32; modular integer addition is
+#     fully associative, so any shard/tile grouping yields identical
+#     totals as long as each tile keeps the 16-bit hessian lane from
+#     overflowing (the same PACKED_TILE bound the one-pass builder uses).
+#
+# `finalize` applies the identical trailing conversion expressions, so
+# equal carries produce bit-equal [S, F, max_bin, 3] histograms.
+
+
+def hist_stream_init(F: int, slots_n: int, max_bin: int) -> Array:
+    """Zero f32 carry for the segment_sum family: [3, F, (S+1)*max_bin]."""
+    return jnp.zeros((3, F, (slots_n + 1) * max_bin), jnp.float32)
+
+
+def hist_stream_update(acc: Array, bins_fm: Array, payload: Array,
+                       leaf_id: Array, slots: Array, max_bin: int) -> Array:
+    """Fold one shard's rows into the f32 carry.
+
+    ``bins_fm``/``payload``/``leaf_id`` hold the shard's rows only; the
+    shard's internal row order plus the caller's pinned shard order
+    reproduce the accumulation order of ``leaf_histogram_multi``.
+    """
+    pos = slot_positions(leaf_id, slots)               # [n] in [0, S]
+    cols = bins_fm.astype(jnp.int32) + (pos * max_bin)[None, :]
+
+    def channel(acc_c: Array, vals: Array) -> Array:
+        def per_feature(a_f, col):
+            return a_f.at[col].add(vals)
+        return jax.vmap(per_feature)(acc_c, cols)
+
+    return jnp.stack([channel(acc[c], payload[:, c]) for c in range(3)])
+
+
+def hist_stream_finalize(acc: Array, F: int, slots_n: int,
+                         max_bin: int) -> Array:
+    """Carry -> [S, F, max_bin, 3], matching leaf_histogram_multi."""
+    S = slots_n
+    out = jnp.stack([acc[0], acc[1], acc[2]], axis=-1)  # [F, NS, 3]
+    return out.reshape(F, S + 1, max_bin, 3)[:, :S].transpose(1, 0, 2, 3)
+
+
+def hist_stream_packed_init(F: int, slots_n: int, max_bin: int,
+                            const_hess_level: int = 0) -> dict:
+    """Zero int32 carries for the packed family."""
+    NS = (slots_n + 1) * max_bin
+    acc = {"g": jnp.zeros((F, NS), jnp.int32),
+           "h": jnp.zeros((F, NS), jnp.int32)}
+    if const_hess_level == 0:
+        acc["c"] = jnp.zeros((F, NS), jnp.int32)
+    return acc
+
+
+def hist_stream_packed_update(acc: dict, bins_fm: Array, payload: Array,
+                              leaf_id: Array, slots: Array, max_bin: int,
+                              s_g: float, s_h: float,
+                              const_hess_level: int = 0) -> dict:
+    """Fold one shard's rows into the packed int32 carries.
+
+    Tiles within the shard exactly like the one-pass builder so the
+    16-bit hessian lane can never overflow mid-tile; the per-tile
+    unpacked g/h sums are then exact integers, and integer addition
+    across shards is association-free.
+    """
+    F, n = bins_fm.shape
+    S = slots.shape[0]
+    NS = (S + 1) * max_bin
+    pos = slot_positions(leaf_id, slots)
+    gq = jnp.round(payload[:, 0] / s_g).astype(jnp.int32)
+    hq = jnp.round(payload[:, 1] / s_h).astype(jnp.int32)
+    if const_hess_level > 0:
+        hq = jnp.where(hq > 0, const_hess_level, 0)
+    packed = (gq << 16) + hq
+
+    T = -(-n // PACKED_TILE)
+    pad = T * PACKED_TILE - n
+    cols = bins_fm.astype(jnp.int32) + (pos * max_bin)[None, :]
+    if pad:
+        packed = jnp.pad(packed, (0, pad))
+        cols = jnp.pad(cols, ((0, 0), (0, pad)),
+                       constant_values=S * max_bin)
+    pt = packed.reshape(T, PACKED_TILE)
+    wt = None
+    if const_hess_level == 0:
+        w = payload[:, 2].astype(jnp.int32)
+        if pad:
+            w = jnp.pad(w, (0, pad))
+        wt = w.reshape(T, PACKED_TILE)
+
+    def per_feature(colf: Array):
+        def per_tile(ids, vals):
+            return jax.ops.segment_sum(vals, ids, num_segments=NS)
+        ph = jax.vmap(per_tile)(colf, pt)              # [T, NS] packed i32
+        h_f = ph & 0xFFFF
+        g_f = (ph - h_f) >> 16
+        if const_hess_level == 0:
+            cnt = jax.vmap(per_tile)(colf, wt).sum(axis=0)
+        else:
+            cnt = jnp.zeros((NS,), jnp.int32)
+        return g_f.sum(axis=0), h_f.sum(axis=0), cnt
+
+    g_s, h_s, c_s = jax.vmap(per_feature)(cols.reshape(F, T, PACKED_TILE))
+    out = {"g": acc["g"] + g_s, "h": acc["h"] + h_s}
+    if const_hess_level == 0:
+        out["c"] = acc["c"] + c_s
+    return out
+
+
+def hist_stream_packed_finalize(acc: dict, F: int, slots_n: int,
+                                max_bin: int, s_g: float, s_h: float,
+                                const_hess_level: int = 0) -> Array:
+    """Packed carries -> [S, F, max_bin, 3], matching the one-pass builder."""
+    S = slots_n
+    h_sum = acc["h"]
+    if const_hess_level > 0:
+        cnt = h_sum // const_hess_level
+    else:
+        cnt = acc["c"]
+    out = jnp.stack([acc["g"].astype(jnp.float32) * s_g,
+                     h_sum.astype(jnp.float32) * s_h,
+                     cnt.astype(jnp.float32)], axis=-1)  # [F, NS, 3]
+    return out.reshape(F, S + 1, max_bin, 3)[:, :S].transpose(1, 0, 2, 3)

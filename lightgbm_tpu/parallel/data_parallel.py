@@ -68,7 +68,8 @@ def shard_dataset(bins_nf: np.ndarray, label: np.ndarray, mesh: Mesh,
 @functools.lru_cache(maxsize=32)
 def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
                             grad_fn: Callable, learning_rate: float,
-                            axis: str = "data"):
+                            axis: str = "data", det_reduce: bool = True,
+                            num_data: int = 0):
     """One full boosting iteration as a single SPMD program.
 
     Memoized on (spec, mesh, grad_fn, lr, axis): the factory returns a
@@ -85,8 +86,16 @@ def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
     Returns step(score, label, weight, bins_fm, feat, allowed)
     -> (new_score, DeviceTree) with the tree arrays replicated across
     shards and score/leaf_id sharded.
+
+    `det_reduce` (default ON, ROADMAP 1a) pins the histogram/root-stat
+    accumulation order to the serial grower's, so round-2+ models are
+    byte-identical to serial; it needs the REAL row count (`num_data`,
+    pre-padding) to keep pad rows out of the pinned order — without it
+    the grower keeps the legacy tree-psum reduction.
     """
-    grow = make_grower(spec, axis_name=axis)
+    grow = make_grower(spec, axis_name=axis,
+                       n_shards=int(mesh.shape[axis]),
+                       det_reduce=det_reduce, num_data=num_data)
     lr = learning_rate
 
     def step(score, label, weight, bins_fm, feat, allowed):

@@ -80,7 +80,7 @@ def resolve_tree_learner(name: str, bundled: bool = False,
 @functools.lru_cache(maxsize=32)
 def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
                             num_feature: int, num_data: int,
-                            wave: bool = False):
+                            wave: bool = False, det_reduce: bool = True):
     """Grower with the serial signature, running SPMD over `mesh`.
 
     Expects `bins_fm` already padded + placed by `place_training_data`
@@ -132,12 +132,14 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
         grow = make_wave_grower(spec,
                                 axis_name=axes if len(axes) > 1
                                 else axes[0],
-                                mode=mode, n_shards=S_last)
+                                mode=mode, n_shards=S_last,
+                                det_reduce=det_reduce, num_data=num_data)
     else:
         grow = make_grower(spec,
                            axis_name=axes if len(axes) > 1 else axes[0],
                            mode=mode,
-                           n_shards=S_total if mode == "voting" else S_last)
+                           n_shards=S_total if mode == "voting" else S_last,
+                           det_reduce=det_reduce, num_data=num_data)
 
     row_sp = P(axes) if mode != "feature" else P(None)
     tree_specs = DeviceTree(

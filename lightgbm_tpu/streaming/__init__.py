@@ -1,0 +1,11 @@
+"""Shard-streamed training — `datastore_budget_mb` as the ONLY ceiling.
+
+The engine decomposes wave growth into per-shard device programs so the
+binned matrix never materializes on device; see engine.py for the
+byte-identity argument and the pass-count cost model.
+"""
+from .engine import (StreamingWaveGrower, streaming_downgrade_reasons,
+                     streaming_spec)
+
+__all__ = ["StreamingWaveGrower", "streaming_downgrade_reasons",
+           "streaming_spec"]

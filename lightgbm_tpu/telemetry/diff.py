@@ -239,6 +239,28 @@ RULES: List[Tuple[str, str, str]] = [
     # scheduling makes the exact count jittery); hits, spill volume and
     # shard count are workload bookkeeping; the resident watermark is a
     # budget signal but inherits the same scheduling jitter
+    # streamed training (ISSUE 15): the device-residency watermark is
+    # computed from accumulator/shard-block array SIZES (deterministic,
+    # counter class — it IS the budget contract, growth fails hard);
+    # stalls inherit the prefetch thread-scheduling jitter (timing
+    # class); shard-pass / shards-read counts are workload bookkeeping
+    # (pass count moves with tree shape), and the shard-count gauge is
+    # dataset identity
+    ("*stream.peak_device_mb", "up_is_bad", "counter"),
+    ("*stream.stalls", "up_is_bad", "timing"),
+    ("*stream.shard_passes", "ignore", "counter"),
+    ("*stream.shards_read", "ignore", "counter"),
+    ("*stream.shards", "ignore", "counter"),
+    # the bench `streaming` block (--streaming): both throughputs and
+    # the streamed/assembled ratio are wall-clock; the stall ratio is
+    # prefetch-scheduling jitter (timing); the device watermark is the
+    # budget contract (deterministic, fails hard); pass/shard counts
+    # are workload identity at a fixed bench shape
+    ("streaming.*rounds_per_sec", "down_is_bad", "timing"),
+    ("streaming.streamed_vs_assembled", "down_is_bad", "timing"),
+    ("streaming.stall_ratio", "up_is_bad", "timing"),
+    ("streaming.peak_device_mb", "up_is_bad", "counter"),
+    ("streaming.*", "ignore", "counter"),
     ("*datastore.prefetch.stall", "up_is_bad", "timing"),
     ("*datastore.prefetch.hit", "ignore", "counter"),
     ("*datastore.spill_bytes", "ignore", "counter"),

@@ -115,6 +115,13 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "datastore_shard_rows": (0, "int", ()),
     "datastore_budget_mb": (64.0, "float", ()),
     "datastore_prefetch": (2, "int", ()),
+    # streamed training (lightgbm_tpu/streaming): "auto" streams when the
+    # assembled device matrix would exceed datastore_budget_mb; "on"
+    # forces streaming (implies external_memory); "off" never streams
+    "streaming_train": ("auto", "str", ()),
+    # shard read-ahead depth for re-streaming passes; 0 inherits
+    # datastore_prefetch
+    "streaming_prefetch_depth": (0, "int", ()),
     "header": (False, "bool", ("has_header",)),
     "label_column": ("", "str", ("label",)),
     "weight_column": ("", "str", ("weight",)),
@@ -169,6 +176,11 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "auc_mu_weights": ([], "vec_double", ()),
     # ---- network ----
     "num_machines": (1, "int", ("num_machine",)),
+    # deterministic fixed-order histogram/score reduction for data-parallel
+    # training: chains per-shard partial sums in shard order (ring
+    # ppermute) instead of psum, so multi-round sharded models are
+    # byte-identical to serial; false restores the faster tree-psum
+    "deterministic_reduce": (True, "bool", ()),
     "local_listen_port": (12400, "int", ("local_port", "port")),
     "time_out": (120, "int", ()),
     "machine_list_filename": ("", "str", ("machine_list_file", "machine_list", "mlist")),

@@ -277,6 +277,8 @@ EOF
 # external-memory smoke: a dataset ~4x the datastore budget trains via
 # the spilled shard store and must be byte-identical to the in-memory
 # model, with the prefetch pipeline's host residency inside the budget
+# (streaming_train pinned off: this smoke covers the ASSEMBLE route;
+# the streamed route has its own smoke right below)
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 import lightgbm_tpu as lgb
@@ -291,7 +293,8 @@ params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
           "min_data_in_leaf": 20}
 mem = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=4)
 ext = lgb.train({**params, "external_memory": True,
-                 "datastore_budget_mb": budget_mb},
+                 "datastore_budget_mb": budget_mb,
+                 "streaming_train": "off"},
                 lgb.Dataset(X, label=y), num_boost_round=4)
 strip = lambda s: "\n".join(l for l in s.splitlines()
                             if not l.startswith("["))
@@ -305,6 +308,44 @@ assert g["datastore.peak_resident_mb"] <= budget_mb, \
 print(f"[run_ci] external-memory smoke: byte parity over "
       f"{int(g['datastore.shards'])} shards, peak resident "
       f"{g['datastore.peak_resident_mb']} MB <= {budget_mb} MB budget")
+EOF
+
+# streaming smoke (ISSUE 15): the same 4x-over-budget dataset with
+# streaming_train at its "auto" default must ENGAGE the shard-streamed
+# engine (the bin matrix never materializes on device), stay
+# byte-identical to the in-memory model, and keep device bin residency
+# (stream.peak_device_mb — the double-buffered shard staging) inside
+# the budget the assembled matrix would blow through
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu.telemetry import REGISTRY
+
+rng = np.random.default_rng(9)
+n, f = 20000, 52                      # ~0.99 MB of uint8 bins
+X = rng.standard_normal((n, f))
+y = (X[:, 0] - X[:, 3] + 0.1 * rng.standard_normal(n) > 0).astype(float)
+budget_mb = 0.25
+params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+          "min_data_in_leaf": 20}
+mem = lgb.train(dict(params), lgb.Dataset(X, label=y), num_boost_round=4)
+st = lgb.train({**params, "external_memory": True,
+                "datastore_budget_mb": budget_mb},
+               lgb.Dataset(X, label=y), num_boost_round=4)
+strip = lambda s: "\n".join(l for l in s.splitlines()
+                            if not l.startswith("["))
+assert strip(mem.model_to_string()) == strip(st.model_to_string()), \
+    "streamed model != in-memory model"
+snap = REGISTRY.snapshot()
+passes = snap["counters"].get("stream.shard_passes", 0)
+assert passes > 0, "streaming_train=auto did not engage on over-budget"
+g = snap["gauges"]
+assert 0 < g["stream.peak_device_mb"] <= budget_mb, \
+    f"device staging held {g['stream.peak_device_mb']} MB > {budget_mb} MB"
+assert g["datastore.peak_resident_mb"] <= budget_mb, g
+print(f"[run_ci] streaming smoke: byte parity over {int(passes)} shard "
+      f"passes, peak device {g['stream.peak_device_mb']} MB <= "
+      f"{budget_mb} MB budget")
 EOF
 
 # mesh smoke (PR 10): distributed training + sharded serving on the

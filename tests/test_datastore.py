@@ -158,7 +158,9 @@ def test_budget_bounded_training_end_to_end(tmp_path):
     """The ISSUE acceptance case: dataset >= 4x datastore_budget_mb
     trains with bounded host residency, byte-identical to in-memory, and
     the prefetch overlap shows up as train.shard spans inside the
-    train.chunk window."""
+    train.chunk window.  Pins streaming_train=off: this test exercises
+    the ASSEMBLE route (over-budget datasets now stream by default —
+    tests/test_streaming.py owns that path)."""
     rng = np.random.default_rng(9)
     n, f = 20000, 52
     X = rng.standard_normal((n, f))
@@ -172,6 +174,7 @@ def test_budget_bounded_training_end_to_end(tmp_path):
                     num_boost_round=4)
     ext = lgb.train({**params, "external_memory": True,
                      "datastore_budget_mb": budget_mb,
+                     "streaming_train": "off",
                      "telemetry_sink": sink},
                     lgb.Dataset(X, label=y), num_boost_round=4)
     assert _strip_params(mem.model_to_string()) == \

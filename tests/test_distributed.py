@@ -50,6 +50,11 @@ class TestShardedGrower:
     @pytest.mark.parametrize(
         "shards", [2, pytest.param(8, marks=pytest.mark.slow)])
     def test_sharded_matches_single(self, shards):
+        """Multi-round BYTE-identity to the serial grower (ROADMAP 1a):
+        with the default deterministic fixed-order reduction, every
+        round's tree — leaf values included — and the carried score
+        vector must be bit-equal to serial, so sharded training cannot
+        drift after round 1."""
         X, y = make_data()
         ds = lgb.Dataset(X, label=y)
         ds.construct()
@@ -65,38 +70,52 @@ class TestShardedGrower:
         allowed = jnp.asarray(np.array(
             [not m.is_trivial for m in mappers], dtype=bool))
 
-        # single-device reference tree
+        # single-device multi-round reference; the score update runs
+        # jitted with the sharded step's exact expression (an eager
+        # update re-associates the fused multiply-add)
         grow = make_grower(spec)
         label32 = jnp.asarray(y.astype(np.float32))
-        score0 = jnp.zeros(len(y), jnp.float32)
         ones = jnp.ones(len(y), jnp.float32)
-        g, h = _binary_grad(score0, label32)
-        ref = grow(jnp.asarray(bins.T), g, h, ones, feat, allowed)
 
-        # sharded step
+        @jax.jit
+        def serial_update(score, lv, lid):
+            return score + lv[lid] * 0.1
+
+        score_ref = jnp.zeros(len(y), jnp.float32)
+        refs = []
+        for _ in range(3):
+            g, h = _binary_grad(score_ref, label32)
+            ref = grow(jnp.asarray(bins.T), g, h, ones, feat, allowed)
+            refs.append(ref)
+            score_ref = serial_update(score_ref, ref.leaf_value,
+                                      ref.leaf_id)
+
+        # sharded steps (det_reduce defaults ON; num_data pins pad rows
+        # out of the deterministic accumulation order)
         mesh = get_mesh(shards)
-        step = make_sharded_train_step(spec, mesh, _binary_grad, 0.1)
+        step = make_sharded_train_step(spec, mesh, _binary_grad, 0.1,
+                                       num_data=len(y))
         dev_bins, dev_label, dev_w, n_pad = shard_dataset(bins, y, mesh)
         assert n_pad == 0
         score = jax.device_put(
             np.zeros(len(y), np.float32),
             jax.sharding.NamedSharding(
                 mesh, jax.sharding.PartitionSpec("data")))
-        new_score, tree = step(score, dev_label, dev_w, dev_bins,
+        for r in range(3):
+            score, tree = step(score, dev_label, dev_w, dev_bins,
                                feat, allowed)
-
-        assert int(tree.n_splits) == int(ref.n_splits)
-        np.testing.assert_array_equal(np.asarray(tree.split_feature),
-                                      np.asarray(ref.split_feature))
-        np.testing.assert_array_equal(np.asarray(tree.threshold_bin),
-                                      np.asarray(ref.threshold_bin))
-        np.testing.assert_allclose(np.asarray(tree.leaf_value),
-                                   np.asarray(ref.leaf_value),
-                                   rtol=2e-4, atol=2e-6)
-        # score update matches the single-device gather
-        expected = np.asarray(ref.leaf_value)[np.asarray(ref.leaf_id)] * 0.1
-        np.testing.assert_allclose(np.asarray(new_score), expected,
-                                   rtol=2e-4, atol=2e-6)
+            ref = refs[r]
+            assert int(tree.n_splits) == int(ref.n_splits), f"round {r}"
+            np.testing.assert_array_equal(np.asarray(tree.split_feature),
+                                          np.asarray(ref.split_feature))
+            np.testing.assert_array_equal(np.asarray(tree.threshold_bin),
+                                          np.asarray(ref.threshold_bin))
+            np.testing.assert_array_equal(np.asarray(tree.leaf_value),
+                                          np.asarray(ref.leaf_value))
+            np.testing.assert_array_equal(np.asarray(tree.leaf_id),
+                                          np.asarray(ref.leaf_id))
+        np.testing.assert_array_equal(np.asarray(score),
+                                      np.asarray(score_ref))
 
     @pytest.mark.slow
     def test_multi_iteration_sharded_training(self):

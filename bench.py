@@ -13,6 +13,10 @@ rounds/s through the shard-streamed engine vs the assembled device
 matrix at the bench shape, shard passes, prefetch stall ratio and the
 device-staging watermark (byte identity asserted in-process).
 
+`--spool [dir]` (or BENCH_SPOOL_DIR) attaches both the orchestrator and
+the worker to a cross-process telemetry spool (telemetry/spool.py);
+merge it afterwards with `python -m lightgbm_tpu timeline <dir>`.
+
 Baseline anchor (documented; see BASELINE.md "Our target"): the target is
 the reference's **CUDA learner** on Higgs-10.5M (BASELINE.json: ">=1.5x
 CUDA rounds/sec, equal AUC").  No exact public CUDA-learner table exists, so
@@ -145,6 +149,23 @@ def _event(name: str, **fields) -> None:
         pass
 
 
+def _attach_spool(spool_dir: str) -> None:
+    """Route orchestrator events into the cross-process spool (--spool):
+    spool.py is loaded by FILE PATH like sinks.py above — it is
+    stdlib-only by contract, so this never-imports-jax process stays
+    wedge-proof."""
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lightgbm_tpu", "telemetry", "spool.py")
+        spec = _ilu.spec_from_file_location("_bench_spool", path)
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _telemetry_sinks()
+        _SINKS.append(mod.SpoolSink(spool_dir, role="bench-orchestrator"))
+    except Exception as e:
+        _log(f"spool attach failed (continuing unspooled): {e}")
+
+
 # last end-to-end measurement on REAL TPU hardware (builder session;
 # full provenance in PROFILE.md "round 3c").  Attached as clearly-labeled
 # context when a wedged tunnel forces the CPU fallback, so the round's
@@ -156,7 +177,7 @@ TPU_RECORD = {"value": 2.956, "auc": 0.8978, "n": 2_000_000,
 def _emit(rounds_per_sec: float, n_rows: int, backend: str,
           partial: bool, auc=None, pred=None, probe=None,
           telemetry=None, flight=None, pipeline=None,
-          serving=None, streaming=None) -> None:
+          serving=None, streaming=None, status=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -213,6 +234,10 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         # on a peak_device_mb rise and watches the throughputs as
         # timing metrics
         line["streaming"] = streaming
+    if status is not None:
+        # explicit nothing-measured marker ("no-run"): report.py renders
+        # it verbatim instead of presenting value=0 as a measurement
+        line["status"] = status
     if backend.startswith("cpu-fallback"):
         line["tpu_record"] = TPU_RECORD
     print(json.dumps(line), flush=True)
@@ -357,6 +382,22 @@ def _run_orchestrator() -> None:
         # shard-streamed vs assembled training comparison (same env
         # travel as --serve)
         env["BENCH_STREAMING"] = "1"
+    spool_dir = os.environ.get("BENCH_SPOOL_DIR", "")
+    if "--spool" in sys.argv:
+        # cross-process telemetry spool: orchestrator + worker write
+        # proc-*.jsonl streams into one directory, merged afterwards by
+        # `python -m lightgbm_tpu timeline <dir>`.  Optional dir operand
+        # (`--spool out/spool`); BENCH_SPOOL_DIR env also travels alone
+        i = sys.argv.index("--spool")
+        if i + 1 < len(sys.argv) and not sys.argv[i + 1].startswith("--"):
+            spool_dir = sys.argv[i + 1]
+        spool_dir = spool_dir or "bench_spool"
+    if spool_dir:
+        spool_dir = os.path.abspath(spool_dir)
+        os.makedirs(spool_dir, exist_ok=True)
+        env["BENCH_SPOOL_DIR"] = spool_dir
+        _attach_spool(spool_dir)
+        _log(f"spooling telemetry to {spool_dir}")
 
     worker_timeout = max(60.0, _remaining() - 20)
     _log(f"starting worker: n={n} rounds={rounds} backend={backend_tag} "
@@ -478,13 +519,16 @@ def _run_orchestrator() -> None:
               flight=worker_flight, pipeline=worker_pipeline,
               serving=worker_serving, streaming=worker_streaming)
     else:
-        # nothing measured — still emit a parseable line (value 0) so the
-        # round records an explicit failure instead of rc=124/None
+        # nothing measured — still emit a parseable line (value 0, an
+        # explicit machine-readable status) so the round records an
+        # explicit failure instead of rc=124/None, and telemetry-report
+        # renders `status: no-run` instead of a zero measurement
         _event("worker.no_chunks", backend=platform)
         _emit(0.0, n, platform + "-failed", partial=True,
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
-              serving=worker_serving, streaming=worker_streaming)
+              serving=worker_serving, streaming=worker_streaming,
+              status="no-run")
 
 
 # --------------------------------------------------------------------------
@@ -568,6 +612,11 @@ def _run_worker() -> None:
         # full span stream (dataset.bin / train.chunk / compile_warmup /
         # predict.*) to the same file the orchestrator events go to
         telemetry.TRACER.attach_jsonl(os.environ["BENCH_TELEMETRY_JSONL"])
+    if os.environ.get("BENCH_SPOOL_DIR"):
+        # cross-process spool (--spool): this worker's stream joins the
+        # orchestrator's in the shared spool dir for the timeline CLI
+        from lightgbm_tpu.telemetry.spool import attach_spool
+        attach_spool(os.environ["BENCH_SPOOL_DIR"], role="bench-worker")
 
     # TPU-first growth: wave-batched multi-leaf histograms fill the MXU's
     # 128-row LHS (PROFILE.md round 3c); BENCH_CONFIG picks the AUC-parity
@@ -979,6 +1028,10 @@ def _run_worker() -> None:
             _log(f"streaming bench failed: {e}")
     _stream_telemetry()
     _stream_flight(bst)
+    # self-contained spool entry: the registry snapshot rides the stream
+    # as one `metrics` event, so aggregate() can roll this worker into
+    # the fleet metrics without the BENCH JSON line
+    telemetry.TRACER.emit_metrics_snapshot()
     telemetry.TRACER.flush()
 
 

@@ -313,6 +313,11 @@ class Booster:
             # idempotent per path, so re-init / multiple boosters share one
             # appender
             telemetry.TRACER.attach_jsonl(self.config.telemetry_sink)
+        if self.config.telemetry_spool or self.config.telemetry_spool_dir:
+            # cross-process spool (telemetry/spool.py): same
+            # attach-before-_DeviceData ordering, idempotent per dir
+            telemetry.attach_spool(self.config.telemetry_spool_dir,
+                                   role="trainer")
         self._debug_nans = bool(self.config.tpu_debug_nans)
         if self._debug_nans:
             # numeric-sanitizer mode (ref: cmake/Sanitizer.cmake posture):

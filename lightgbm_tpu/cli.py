@@ -282,6 +282,8 @@ def run(argv: List[str]) -> int:
               " [model=default] [n=5] [--json]\n"
               "       python -m lightgbm_tpu top [url=http://host:port]"
               " [n=8] [--json]\n"
+              "       python -m lightgbm_tpu timeline <spool_dir>"
+              " [--trace out.json] [--json]\n"
               "       python -m lightgbm_tpu compile-plan <model_file>"
               " [serve_tile_vmem_kb=...] [--json]",
               file=sys.stderr)
@@ -310,6 +312,11 @@ def run(argv: List[str]) -> int:
         # /debug/fleet from a running serving process
         from .telemetry.ops import main as top_main
         return top_main(argv[1:])
+    if argv[0] == "timeline":
+        # cross-process spool aggregation (telemetry/spool.py): merged
+        # fleet timeline + optional Chrome-trace export
+        from .telemetry.spool import main as timeline_main
+        return timeline_main(argv[1:])
     if argv[0] == "telemetry-report":
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main

@@ -39,6 +39,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
     sink = (params or {}).get("telemetry_sink")
     if sink:
         telemetry.TRACER.attach_jsonl(str(sink))
+    spool_dir = (params or {}).get("telemetry_spool_dir") or ""
+    if spool_dir or (params or {}).get("telemetry_spool"):
+        telemetry.attach_spool(str(spool_dir), role="trainer")
     # the root telemetry span: Booster construction (dataset.bin), the
     # boosting loop (train.chunk / compile_warmup / eval) all nest inside
     with telemetry.span("train.loop", num_boost_round=num_boost_round,

@@ -437,6 +437,11 @@ def main(argv) -> int:
               file=sys.stderr)
         return 2
     config = Config(dict(params))
+    if config.telemetry_spool or config.telemetry_spool_dir:
+        # cross-process spool (telemetry/spool.py): the fleet daemon's
+        # retrain/gate/swap spans join the shared fleet timeline
+        from ..telemetry.spool import attach_spool
+        attach_spool(config.telemetry_spool_dir, role="fleet-daemon")
     booster = Booster(model_file=model_path)
     client = ServingClient(booster, params=params, name=name)
     log.set_verbosity(config.verbosity)

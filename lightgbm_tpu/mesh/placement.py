@@ -30,8 +30,8 @@ from ..utils.log import LightGBMError
 from .compat import Mesh, NamedSharding, PartitionSpec as P
 
 __all__ = ["padded_feature_count", "padded_row_count",
-           "record_placement", "collective_span", "place_from_datastore",
-           "stream_shard_plan"]
+           "record_placement", "collective_span", "emit_collective_round",
+           "local_device_ids", "place_from_datastore", "stream_shard_plan"]
 
 
 def padded_feature_count(num_feature: int, shards: int) -> int:
@@ -93,6 +93,36 @@ def collective_span(name: str, **attrs):
     from ..telemetry import span
     full = f"mesh.collective.{name}"
     return _CollectiveTimer(full, span(full, **attrs))
+
+
+def local_device_ids(mesh: Mesh):
+    """Global ids of THIS process's devices inside `mesh` — the devices
+    whose collective participation this process can stamp (in a
+    multi-controller SPMD program every process runs the same dispatch,
+    so per-process local stamps tile the whole mesh)."""
+    pidx = jax.process_index()
+    return [int(d.id) for d in mesh.devices.flat
+            if d.process_index == pidx]
+
+
+def emit_collective_round(name: str, device_ids, payload_bytes: int,
+                          round_idx: int, **attrs) -> None:
+    """Stamp one ``mesh.collective.<name>`` point event per local device
+    for one collective round: device id, payload bytes, round counter.
+
+    Host-side only, at dispatch time — telemetry never enters jitted
+    code (graft-lint R005) and nothing here blocks on the device (zero
+    added syncs).  The spool aggregator groups these events by
+    (collective, round) across processes and reads per-device skew from
+    the timestamp spread (telemetry/spool.py `_collective_skew`); the
+    straggler surfaces as ``mesh.skew.device``.  Callers gate on
+    ``TRACER.active`` themselves so the inactive path stays one branch.
+    """
+    from ..telemetry import event
+    for dev in device_ids:
+        event(f"mesh.collective.{name}", device=int(dev),
+              payload_bytes=int(payload_bytes), round=int(round_idx),
+              **attrs)
 
 
 def stream_shard_plan(store, mesh: Mesh = None):

@@ -622,15 +622,20 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
 
                     recv = hist_stream_packed_init(Fh, 1, HB, chl)
                     mine = recv
-                    for t in range(n_shards):
-                        mine = fold(recv)
-                        if t < n_shards - 1:
-                            recv = {k: jax.lax.ppermute(v, axis_last,
-                                                        det_perm)
-                                    for k, v in mine.items()}
-                    full = {k: jax.lax.all_gather(
-                                v, axis_last)[n_shards - 1]
-                            for k, v in mine.items()}
+                    # ring_fold scope: the hop-by-hop ppermute chain is
+                    # what the host-side mesh.collective.ring_fold events
+                    # (parallel/learner.py dispatch) attribute — same
+                    # name on both timelines (ISSUE 16)
+                    with jax.named_scope("ring_fold"):
+                        for t in range(n_shards):
+                            mine = fold(recv)
+                            if t < n_shards - 1:
+                                recv = {k: jax.lax.ppermute(v, axis_last,
+                                                            det_perm)
+                                        for k, v in mine.items()}
+                        full = {k: jax.lax.all_gather(
+                                    v, axis_last)[n_shards - 1]
+                                for k, v in mine.items()}
                     h = hist_stream_packed_finalize(
                         full, Fh, 1, HB, feat["qscales"][0],
                         feat["qscales"][1], const_hess_level=chl)[0]
@@ -647,13 +652,14 @@ def make_grower(spec: GrowerSpec, axis_name: str = None, mode: str = "data",
 
                     recv = jnp.zeros((3, Fh, HB + 1), jnp.float32)
                     mine = recv
-                    for t in range(n_shards):
-                        mine = fold(recv)
-                        if t < n_shards - 1:
-                            recv = jax.lax.ppermute(mine, axis_last,
-                                                    det_perm)
-                    full = jax.lax.all_gather(
-                        mine, axis_last)[n_shards - 1]
+                    with jax.named_scope("ring_fold"):
+                        for t in range(n_shards):
+                            mine = fold(recv)
+                            if t < n_shards - 1:
+                                recv = jax.lax.ppermute(mine, axis_last,
+                                                        det_perm)
+                        full = jax.lax.all_gather(
+                            mine, axis_last)[n_shards - 1]
                     h = jnp.stack([full[0], full[1], full[2]],
                                   axis=-1)[:, :HB]
                 if mode == "data_rs":

@@ -262,15 +262,19 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
 
                     recv = hist_stream_packed_init(Fh, S, HB, chl)
                     mine = recv
-                    for t in range(n_shards):
-                        mine = fold(recv)
-                        if t < n_shards - 1:
-                            recv = {k: jax.lax.ppermute(v, axis_last,
-                                                        det_perm)
-                                    for k, v in mine.items()}
-                    full = {k: jax.lax.all_gather(
-                                v, axis_last)[n_shards - 1]
-                            for k, v in mine.items()}
+                    # ring_fold scope pairs the device trace with the
+                    # host-side mesh.collective.ring_fold dispatch events
+                    # (ISSUE 16 per-device collective timeline)
+                    with jax.named_scope("ring_fold"):
+                        for t in range(n_shards):
+                            mine = fold(recv)
+                            if t < n_shards - 1:
+                                recv = {k: jax.lax.ppermute(v, axis_last,
+                                                            det_perm)
+                                        for k, v in mine.items()}
+                        full = {k: jax.lax.all_gather(
+                                    v, axis_last)[n_shards - 1]
+                                for k, v in mine.items()}
                     h = hist_stream_packed_finalize(
                         full, Fh, S, HB, feat["qscales"][0],
                         feat["qscales"][1], const_hess_level=chl)
@@ -281,13 +285,14 @@ def make_wave_grower(spec: GrowerSpec, axis_name=None, mode: str = "data",
 
                     recv = hist_stream_init(Fh, S, HB)
                     mine = recv
-                    for t in range(n_shards):
-                        mine = fold(recv)
-                        if t < n_shards - 1:
-                            recv = jax.lax.ppermute(mine, axis_last,
-                                                    det_perm)
-                    full = jax.lax.all_gather(
-                        mine, axis_last)[n_shards - 1]
+                    with jax.named_scope("ring_fold"):
+                        for t in range(n_shards):
+                            mine = fold(recv)
+                            if t < n_shards - 1:
+                                recv = jax.lax.ppermute(mine, axis_last,
+                                                        det_perm)
+                        full = jax.lax.all_gather(
+                            mine, axis_last)[n_shards - 1]
                     h = hist_stream_finalize(full, Fh, S, HB)
                 if block:
                     Fb_h = h.shape[1] // n_shards

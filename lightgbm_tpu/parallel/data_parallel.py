@@ -20,6 +20,7 @@ with two psums per split riding ICI.
 from __future__ import annotations
 
 import functools
+import itertools
 from typing import Callable, Optional, Tuple
 
 import jax
@@ -28,6 +29,7 @@ import numpy as np
 
 from ..mesh.compat import Mesh, NamedSharding, PartitionSpec as P, \
     shard_map
+from ..mesh.placement import emit_collective_round, local_device_ids
 from ..ops.grow import DeviceTree, GrowerSpec, make_grower
 
 Array = jax.Array
@@ -123,4 +125,27 @@ def make_sharded_train_step(spec: GrowerSpec, mesh: Mesh,
                   P(None), P(None)),
         out_specs=(P(axis), tree_specs),
         check_vma=False)
-    return jax.jit(sharded)
+    jitted = jax.jit(sharded)
+    # per-device collective timeline (ISSUE 16): one point event per
+    # LOCAL device per training round, stamped host-side at dispatch —
+    # this is the path a multi-controller gloo cluster runs
+    # (tests/mh_worker.py), so the spool aggregator sees every rank's
+    # devices and can name the straggler.  Host-computed payload:
+    # the det ring-fold carry is [3, F, HB+1] f32 per hop.  R005: no
+    # telemetry inside the shard_map body; zero added syncs.
+    coll_name = "ring_fold" if det_reduce else "hist_psum"
+    hb = (spec.bundle_max_bin if spec.bundled else spec.max_bin)
+    rounds = itertools.count()
+
+    def dispatched(score, label, weight, bins_fm, feat, allowed):
+        from ..telemetry import TRACER
+        if not TRACER.active:
+            return jitted(score, label, weight, bins_fm, feat, allowed)
+        # .shape is metadata — no transfer, no sync
+        payload_bytes = 3 * int(bins_fm.shape[0]) * (hb + 1) * 4
+        emit_collective_round(coll_name, local_device_ids(mesh),
+                              payload_bytes, next(rounds),
+                              shards=int(mesh.shape[axis]))
+        return jitted(score, label, weight, bins_fm, feat, allowed)
+
+    return dispatched

@@ -36,10 +36,12 @@ import functools
 import jax
 import jax.numpy as jnp
 
+import itertools
+
 from ..mesh.compat import Mesh, NamedSharding, PartitionSpec as P, \
     shard_map
-from ..mesh.placement import padded_feature_count, padded_row_count, \
-    record_placement
+from ..mesh.placement import emit_collective_round, local_device_ids, \
+    padded_feature_count, padded_row_count, record_placement
 from ..ops.grow import DeviceTree, GrowerSpec, make_grower
 from ..utils import log
 
@@ -179,7 +181,29 @@ def make_distributed_grower(spec: GrowerSpec, mesh: Mesh, kind: str,
             dev = dev._replace(leaf_id=dev.leaf_id[:num_data])
         return dev
 
-    return jax.jit(padded)
+    jitted = jax.jit(padded)
+    # per-device collective timeline (ISSUE 16): stamp one
+    # mesh.collective.<name> point event per local device per dispatch
+    # round, host-side around the jitted call (graft-lint R005 keeps
+    # telemetry out of the SPMD body; named_scopes inside ops/grow*.py
+    # label the device trace instead).  Payload = the ring-fold carry
+    # (det_reduce: [3, F, HB+1] f32 per hop) or the full-histogram psum.
+    # Zero added device syncs: events ride the async dispatch.
+    coll_name = "ring_fold" if det_reduce else "hist_psum"
+    hb = (spec.bundle_max_bin if spec.bundled else spec.max_bin)
+    payload_bytes = 3 * (num_feature + f_extra) * (hb + 1) * 4
+    rounds = itertools.count()
+
+    def dispatched(*args):
+        from ..telemetry import TRACER
+        if not TRACER.active:
+            return jitted(*args)
+        emit_collective_round(coll_name, local_device_ids(mesh),
+                              payload_bytes, next(rounds),
+                              mode=mode, shards=S_total)
+        return jitted(*args)
+
+    return dispatched
 
 
 def place_training_data(bins_fm, mesh: Mesh, kind: str,

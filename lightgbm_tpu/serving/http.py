@@ -259,6 +259,11 @@ def main(argv: List[str]) -> int:
               "[serve_queue_depth=...]", file=sys.stderr)
         return 2
     config = Config(params)
+    if config.telemetry_spool or config.telemetry_spool_dir:
+        # cross-process spool (telemetry/spool.py): the serving frontend
+        # contributes its span/event stream to the shared fleet timeline
+        from ..telemetry.spool import attach_spool
+        attach_spool(config.telemetry_spool_dir, role="serving-http")
     client = ServingClient(model_path, params=params, name=name)
     # loading the model restored its embedded params — training-time
     # verbosity=-1 must not mute the serve CLI's own announce line

@@ -49,6 +49,7 @@ bandwidth buys back device memory.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Dict, List, Optional
 
 import jax
@@ -164,6 +165,7 @@ class StreamingWaveGrower:
         REGISTRY.gauge("wave.fused").set(0)
         REGISTRY.gauge("stream.shards").set(store.n_shards)
         self.peak_device_bytes = 0
+        self._tree_idx = -1     # bumped per __call__ (one call = one tree)
         self._build_programs()
 
     # ------------------------------------------------------------ programs
@@ -566,10 +568,19 @@ class StreamingWaveGrower:
         self._finalize = finalize
 
     # ------------------------------------------------------------ streaming
-    def _stream(self):
+    def _stream(self, prof=None):
         """Yield (rows, row0, device_block) over the pinned shard plan
         with double-buffered staging accounting: at most the current +
-        previous blocks are device-resident at once."""
+        previous blocks are device-resident at once.
+
+        `prof` (the per-pass profile dict, see `_pass`) accumulates the
+        two producer-side stall stages: `prefetch_wait_s` is the host
+        time blocked in the prefetcher's `next()` (disk + decode behind
+        the bounded queue), `h2d_s` the `jnp.asarray` staging call.
+        Generator timing is exact by construction: the interval between
+        our `yield` and the consumer's next `next()` — the device-fold
+        dispatch — never lands in either bucket.
+        """
         self.stats.start_pass()
         REGISTRY.counter("stream.shard_passes").inc()
 
@@ -587,9 +598,20 @@ class StreamingWaveGrower:
                              on_hit=on_hit, on_stall=on_stall)
         shards_read = REGISTRY.counter("stream.shards_read")
         prev_bytes = 0
+        it = iter(pf)
         try:
-            for _k, row0, block in pf:
+            while True:
+                t0 = time.perf_counter()
+                try:
+                    _k, row0, block = next(it)
+                except StopIteration:
+                    break
+                t1 = time.perf_counter()
                 dev = jnp.asarray(block)
+                t2 = time.perf_counter()
+                if prof is not None:
+                    prof["prefetch_wait_s"] += t1 - t0
+                    prof["h2d_s"] += t2 - t1
                 staged = block.nbytes + prev_bytes
                 if staged > self.peak_device_bytes:
                     self.peak_device_bytes = staged
@@ -607,6 +629,35 @@ class StreamingWaveGrower:
             REGISTRY.gauge("datastore.peak_resident_mb").set(
                 round(self.stats.peak_resident_bytes / 2**20, 3))
 
+    # ------------------------------------------------------------ profiler
+    @staticmethod
+    def _pass_profile():
+        return {"prefetch_wait_s": 0.0, "h2d_s": 0.0,
+                "device_fold_s": 0.0, "host_harvest_s": 0.0}
+
+    @staticmethod
+    def _pass_close(sp, prof, t_start, **ids) -> None:
+        """Stamp one pass's stall attribution onto its `stream.pass`
+        span and the always-on `stream.pass.*` histograms.
+
+        The four stages are DISJOINT host-side sub-intervals of the pass
+        (prefetch-wait and H2D inside `_stream`, device-fold around each
+        per-shard program dispatch, host-harvest around the accumulator
+        finalize), so their sum is ≤ the pass wall time by construction
+        — the invariant the CI spool smoke asserts.  Timing wraps the
+        ASYNC dispatch calls, never a device sync (graft-lint R005 /
+        zero-added-syncs): on a real accelerator device-fold is dispatch
+        time and the tail of device work drains into whichever stage
+        blocks next, which is exactly the host's-eye stall view the
+        timeline renders.
+        """
+        wall = time.perf_counter() - t_start
+        sp.set(wall_s=round(wall, 6),
+               **{k: round(v, 6) for k, v in prof.items()}, **ids)
+        REGISTRY.histogram("stream.pass.wall").observe(wall)
+        for k, v in prof.items():
+            REGISTRY.histogram("stream.pass." + k[:-2]).observe(v)
+
     # ------------------------------------------------------------ __call__
     def __call__(self, bins_fm, grad, hess, sample_weight, feat, allowed
                  ) -> DeviceTree:
@@ -618,21 +669,33 @@ class StreamingWaveGrower:
             grad, hess, sample_weight)
         N = payload.shape[0]
         leaf_id = jnp.zeros((N,), jnp.int32)
+        self._tree_idx += 1
+        tree = self._tree_idx
+        wave_idx = 0
+        shards = len(self.plan)
 
         # ---- root pass: one full-datastore sweep at wave call shape ----
-        with telemetry.span("stream.pass", phase="root"):
+        with telemetry.span("stream.pass", phase="root") as sp:
+            prof, t_pass = self._pass_profile(), time.perf_counter()
             root_slots = jnp.full((W,), LB, jnp.int32).at[0].set(0)
             acc = self._acc_init()
-            for rows, row0, dev in self._stream():
+            for rows, row0, dev in self._stream(prof):
+                t_f = time.perf_counter()
                 acc = self._accum_prog(rows)(
                     acc, dev, payload, leaf_id, row0, root_slots, qs)
+                prof["device_fold_s"] += time.perf_counter() - t_f
+            t_h = time.perf_counter()
             hist0 = self._acc_finalize(acc, qs)[0]
+            prof["host_harvest_s"] += time.perf_counter() - t_h
+            self._pass_close(sp, prof, t_pass, tree=tree, wave=0,
+                             shards=shards)
         state, allowed_eff = self._root_find(hist0, root_g, root_h,
                                              root_c, feat, allowed)
 
         # ---- wave loop (host-driven; cond mirrors the in-memory one) ----
         while (int(state["step"]) < LB - 1
                and float(jnp.max(state["leaf_gain"])) > 0.0):
+            wave_idx += 1
             s1, desc = self._pick(
                 {k: state[k] for k in self._carry_keys + LEAF_KEYS},
                 feat)
@@ -640,20 +703,35 @@ class StreamingWaveGrower:
                 # capacity reached mid-wave: the committed picks still
                 # partition rows (leaf_id feeds the score update), but
                 # no new histograms are needed — partition-only pass
-                with telemetry.span("stream.pass", phase="partition"):
-                    for rows, row0, dev in self._stream():
+                with telemetry.span("stream.pass",
+                                    phase="partition") as sp:
+                    prof, t_pass = self._pass_profile(), \
+                        time.perf_counter()
+                    for rows, row0, dev in self._stream(prof):
+                        t_f = time.perf_counter()
                         leaf_id = self._part_prog(rows)(
                             dev, leaf_id, row0, desc, feat)
+                        prof["device_fold_s"] += \
+                            time.perf_counter() - t_f
+                    self._pass_close(sp, prof, t_pass, tree=tree,
+                                     wave=wave_idx, shards=shards)
                 state = {k: s1[k] for k in
                          self._carry_keys + LEAF_KEYS}
                 break
-            with telemetry.span("stream.pass", phase="wave"):
+            with telemetry.span("stream.pass", phase="wave") as sp:
+                prof, t_pass = self._pass_profile(), time.perf_counter()
                 acc = self._acc_init()
-                for rows, row0, dev in self._stream():
+                for rows, row0, dev in self._stream(prof):
+                    t_f = time.perf_counter()
                     acc, leaf_id = self._wave_prog(rows)(
                         acc, dev, payload, leaf_id, row0, desc, feat,
                         qs)
+                    prof["device_fold_s"] += time.perf_counter() - t_f
+                t_h = time.perf_counter()
                 small_h = self._acc_finalize(acc, qs)
+                prof["host_harvest_s"] += time.perf_counter() - t_h
+                self._pass_close(sp, prof, t_pass, tree=tree,
+                                 wave=wave_idx, shards=shards)
             hist, leaf_upd = self._find_children(
                 state["hist"], s1, small_h, feat, allowed_eff)
             state = {k: s1[k] for k in self._carry_keys}

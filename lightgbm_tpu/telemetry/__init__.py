@@ -18,8 +18,11 @@ bench.py already does for utils/env.py.)
 """
 from .metrics import (Counter, Gauge, Histogram, HISTOGRAM_BOUNDS,
                       MetricsRegistry, REGISTRY, Timing, write_prometheus)
-from .sinks import JsonlSink, MemorySink, Sink, iso_ts, make_event, read_jsonl
+from .sinks import (JsonlSink, MemorySink, Sink, iso_ts, make_event,
+                    read_jsonl, read_jsonl_counted)
 from .spans import NOOP, Span, TRACER, Tracer, event, span
+from .spool import (SpoolSink, aggregate as aggregate_spool, attach_spool,
+                    chrome_trace, render_timeline)
 from .report import render, summarize
 from .recorder import (FlightRecorder, install_compile_listener,
                        memory_watermarks, poll_jit_caches, sample_memory,
@@ -36,7 +39,10 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "HISTOGRAM_BOUNDS", "MetricsRegistry",
     "REGISTRY", "Timing", "write_prometheus",
     "JsonlSink", "MemorySink", "Sink", "iso_ts", "make_event", "read_jsonl",
+    "read_jsonl_counted",
     "NOOP", "Span", "TRACER", "Tracer", "event", "span",
+    "SpoolSink", "aggregate_spool", "attach_spool", "chrome_trace",
+    "render_timeline",
     "render", "summarize",
     "FlightRecorder", "install_compile_listener", "memory_watermarks",
     "poll_jit_caches", "sample_memory", "throughput_report", "tree_stats",

@@ -137,9 +137,19 @@ RULES: List[Tuple[str, str, str]] = [
     ("*serve.drift.max_psi", "up_is_bad", "counter"),
     ("*serve.drift.*", "ignore", "counter"),
     ("*ledger.records", "ignore", "counter"),
+    # mesh skew (PR 12 within-process ratio; ISSUE 16 fleet scope): the
+    # skew magnitudes are wall-clock-derived (timing class — a growing
+    # lag means a device is pulling away); the straggler/device INDEX is
+    # identity, not magnitude
     ("*mesh.skew.p99_ratio", "up_is_bad", "timing"),
-    ("*mesh.skew.*", "ignore", "counter"),
+    ("*mesh.skew.straggler", "ignore", "counter"),
+    ("*mesh.skew.device", "ignore", "counter"),
+    ("*mesh.skew.*", "up_is_bad", "timing"),
     ("*mesh.collective.*", "ignore", "timing"),
+    # telemetry spool (ISSUE 16): pure bookkeeping — attach counts and
+    # per-process spool stats move with deployment shape, never a
+    # training/serving regression by themselves
+    ("*spool.*", "ignore", "counter"),
     ("*fleet.tenant.*", "ignore", "counter"),
     ("*fleet.*", "ignore", "counter"),
     # serving: the bench `serving` block's latency percentiles /
@@ -248,6 +258,13 @@ RULES: List[Tuple[str, str, str]] = [
     # dataset identity
     ("*stream.peak_device_mb", "up_is_bad", "counter"),
     ("*stream.stalls", "up_is_bad", "timing"),
+    # streaming-pass profiler (ISSUE 16): per-stage attribution
+    # histograms (prefetch-wait / H2D / device-fold / host-harvest) are
+    # wall-clock — a rising prefetch_wait p99 means the read-ahead
+    # stopped hiding disk latency; pass counts are workload identity
+    ("*stream.pass.*.count", "ignore", "counter"),
+    ("*stream.pass.prefetch_wait*", "up_is_bad", "timing"),
+    ("*stream.pass.*", "up_is_bad", "timing"),
     ("*stream.shard_passes", "ignore", "counter"),
     ("*stream.shards_read", "ignore", "counter"),
     ("*stream.shards", "ignore", "counter"),

@@ -35,6 +35,7 @@ from typing import Any, Dict, List, Optional
 
 from .ledger import LEDGER, ancestry, rejections
 from .metrics import REGISTRY
+from .spool import SPOOL_DIRS, aggregate as _aggregate_spool
 
 DEFAULT_URL = "http://127.0.0.1:8080/debug/fleet"
 
@@ -133,7 +134,7 @@ def fleet_snapshot(limit: int = 8) -> Dict[str, Any]:
         }
     collectives = {n: t for n, t in snap.get("timings", {}).items()
                    if n.startswith("mesh.collective.")}
-    return {
+    out = {
         "ledger": {"records": len(LEDGER),
                    "tail": recs[max(0, len(recs) - limit):]},
         "lineage": lineage,
@@ -142,6 +143,20 @@ def fleet_snapshot(limit: int = 8) -> Dict[str, Any]:
         "mesh": {**_replica_block(snap.get("histograms", {})),
                  "collectives": collectives},
     }
+    # cross-process spool roll-up (spool.py): when this process is
+    # attached to a spool directory, /debug/fleet serves the merged
+    # fleet view — process table, per-collective skew + straggler
+    # device, streaming-pass attribution — minus the raw event stream
+    # (that's the timeline CLI's job)
+    spools = {}
+    for d in SPOOL_DIRS:
+        try:
+            spools[d] = _aggregate_spool(d, keep_events=False)
+        except OSError as e:
+            spools[d] = {"error": str(e)}
+    if spools:
+        out["spool"] = spools
+    return out
 
 
 # -------------------------------------------------------------- render
@@ -197,6 +212,24 @@ def render_top(snap: Dict[str, Any]) -> str:
             lines.append(f"    {n}: {t['count']} calls, "
                          f"mean {t['mean_s'] * 1e3:.3f} ms, "
                          f"max {t['max_s'] * 1e3:.3f} ms")
+    for d, sp in sorted(snap.get("spool", {}).items()):
+        if sp.get("error"):
+            lines.append(f"  spool {d}: unreadable ({sp['error']})")
+            continue
+        lines.append(f"  spool {d}: {len(sp.get('processes', []))} "
+                     f"processes, {sp.get('n_events', 0)} events"
+                     + (f", {sp['torn_lines']} torn line(s)"
+                        if sp.get("torn_lines") else ""))
+        for p in sp.get("processes", []):
+            lines.append(f"    {p.get('role', '?')} rank "
+                         f"{p.get('rank', '?')} pid {p.get('pid', '?')}"
+                         f": {p.get('events', 0)} events")
+        for name, c in sorted(sp.get("collectives", {}).items()):
+            lines.append(f"    collective {name}: straggler device "
+                         f"{c['straggler']} (skew ratio "
+                         f"{c['skew_ratio']})")
+        if sp.get("straggler") is not None:
+            lines.append(f"    mesh.skew.device: {sp['straggler']}")
     return "\n".join(lines)
 
 

@@ -38,6 +38,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
     phases: Dict[str, Dict[str, Any]] = {}
     point_events: Dict[str, int] = {}
     trace_groups: Dict[str, List[float]] = {}
+    unknown: Dict[str, int] = {}
     snapshot: Optional[Dict[str, Any]] = None
     root_total = 0.0
     for rec in events:
@@ -75,6 +76,14 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
             except (TypeError, ValueError):
                 e2e = 0.0
             trace_groups.setdefault(key, []).append(e2e)
+        elif kind == "spool":
+            # spool headers (spool.py) carry process identity for the
+            # timeline aggregator, not phase timing — ignore silently
+            pass
+        else:
+            # forward-compat: an unknown `ev` kind (newer writer, older
+            # reader) is counted and skipped, never a crash
+            unknown[str(kind)] = unknown.get(str(kind), 0) + 1
     traces: Dict[str, Dict[str, Any]] = {}
     for key, vals in sorted(trace_groups.items()):
         vals.sort()
@@ -101,6 +110,7 @@ def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
         "events": point_events,
         "traces": traces,
         "metrics": snapshot,
+        "unknown": unknown,
     }
 
 
@@ -148,8 +158,18 @@ def render(summary: Dict[str, Any]) -> str:
     """Render a summary dict as a fixed-width text table."""
     lines: List[str] = []
     phases = summary["phases"]
+    if summary["n_events"] == 0:
+        # an empty/truncated artifact (a MULTICHIP_r0*.json from a run
+        # that never happened, a zero-byte sink) must say so explicitly
+        # instead of rendering a silent empty table
+        return "status: no-run (no parseable telemetry records)"
     lines.append(f"events: {summary['n_events']}   "
                  f"top-level span time: {summary['root_total_s']:.3f}s")
+    unknown = summary.get("unknown") or {}
+    if unknown:
+        kinds = ", ".join(f"{k} x{n}" for k, n in sorted(unknown.items()))
+        lines.append(f"warning: skipped {sum(unknown.values())} record(s) "
+                     f"of unknown ev kind ({kinds})")
     if phases:
         lines.append("")
         header = (f"{'phase':<34} {'count':>6} {'total_s':>10} "
@@ -201,6 +221,31 @@ def main(argv: Optional[List[str]] = None) -> int:
     except OSError as e:
         print(f"telemetry-report: cannot read {path}: {e}", file=sys.stderr)
         return 2
+    import os as _os
+    base = _os.path.basename(path)
+    if not events:
+        # empty or fully-truncated artifact (a MULTICHIP_r0*.json from a
+        # run that never happened): explicit status, successful exit —
+        # "nothing ran" is an answer, not a parse error
+        print(f"{base} status: no-run (empty or truncated artifact)")
+        return 0
+    if not any("ev" in r for r in events):
+        # bench/acceptance artifacts (BENCH_r0*.json / MULTICHIP_r0*.json)
+        # hold plain records, not telemetry events: report whether any
+        # record carries an actual measurement block
+        ran = [r for r in events if "value" in r]
+        if not ran:
+            causes = sorted({str(r.get("skipped") or r.get("tail", "")
+                                 or f"rc={r.get('rc', '?')}")[:60]
+                             for r in events})
+            print(f"{base} status: no-run (no BENCH measurement blocks "
+                  f"in {len(events)} record(s); "
+                  + "; ".join(c for c in causes if c) + ")")
+            return 0
+        for r in ran:
+            print(f"{base}: {r.get('name', 'bench')} = "
+                  f"{r.get('value')} {r.get('unit', '')}".rstrip())
+        return 0
     print(render(summarize(events)))
     return 0
 

@@ -20,7 +20,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def make_event(ev: str, name: str, **fields: Any) -> Dict[str, Any]:
@@ -102,7 +102,15 @@ def read_jsonl(path: str) -> List[Dict[str, Any]]:
     """Parse a JSONL event file, skipping unparseable lines (a killed
     writer may leave one truncated tail line — that must not take the
     whole report down)."""
+    return read_jsonl_counted(path)[0]
+
+
+def read_jsonl_counted(path: str) -> "Tuple[List[Dict[str, Any]], int]":
+    """`read_jsonl` variant that also counts the skipped lines: the spool
+    aggregator must report torn/partially-written records (a spool file
+    from a killed rank), not silently swallow them."""
     out: List[Dict[str, Any]] = []
+    skipped = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -111,7 +119,10 @@ def read_jsonl(path: str) -> List[Dict[str, Any]]:
             try:
                 rec = json.loads(line)
             except json.JSONDecodeError:
+                skipped += 1
                 continue
             if isinstance(rec, dict):
                 out.append(rec)
-    return out
+            else:
+                skipped += 1
+    return out, skipped

@@ -425,6 +425,14 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # Prometheus text-exposition dump of the metrics registry, written at
     # the end of engine.train() (node-exporter textfile collector format)
     "telemetry_prometheus": ("", "str", ()),
+    # cross-process telemetry spool (telemetry/spool.py): when enabled,
+    # this process appends its event stream into the shared spool
+    # directory as proc-<host>-<pid>-<rank>.jsonl with a clock-anchor
+    # header; merge with `python -m lightgbm_tpu timeline <dir>`.
+    # telemetry_spool=true with an empty dir uses ./lgbm_tpu_spool;
+    # setting telemetry_spool_dir implies telemetry_spool
+    "telemetry_spool": (False, "bool", ()),
+    "telemetry_spool_dir": ("", "str", ()),
     # training flight recorder (telemetry/recorder.py): opt-in ring-
     # buffered per-round diagnostics — tree depth/leaf counts, split-gain
     # quantiles, top split features, grad/hess aggregates, fallback

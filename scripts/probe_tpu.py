@@ -15,9 +15,16 @@ Record fields:
   stdout_tail / stderr_tail   last 2000 chars each (backend init logs ride
                 in stderr because TF_CPP_MIN_LOG_LEVEL=0 + JAX verbose
                 logging are forced in the child env)
-  stages        per-stage durations {import_jax, client_init (PJRT
-                claim/grant), device_enumerate, compile_and_run} — present
-                for hangs too, truncated at the stage that wedged
+  stages        per-stage durations {tunnel_connect, import_jax,
+                client_init (PJRT claim/grant), device_enumerate,
+                compile_and_run} — present for hangs too, truncated at
+                the stage that wedged.  `tunnel_connect` is PARENT-side:
+                a bounded TCP connect to the first PALLAS_AXON_POOL_IPS
+                endpoint BEFORE the child spawns, so a wedged tunnel is
+                its own probe stage instead of an anonymous child hang
+  cause         "tunnel_wedged" when the parent-side connect timed out —
+                the child is then never spawned (it would hang in
+                uninterruptible C++ and burn the whole probe budget)
   env           the axon-relevant env vars the child saw
 
 Usage:
@@ -34,6 +41,7 @@ from __future__ import annotations
 import argparse
 import importlib.util
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -109,6 +117,46 @@ def parse_stages(stdout: str) -> dict:
     return stages
 
 
+#: default axon worker port when a PALLAS_AXON_POOL_IPS entry carries no
+#: explicit one (the TPU runtime's conventional gRPC port)
+AXON_DEFAULT_PORT = 8471
+
+
+def tunnel_endpoint(env: dict):
+    """(host, port) of the first PALLAS_AXON_POOL_IPS entry
+    ("host[:port]", comma/space separated), or None when no remote
+    tunnel is configured (nothing to pre-probe)."""
+    raw = (env.get("PALLAS_AXON_POOL_IPS") or "").replace(",", " ").split()
+    if not raw:
+        return None
+    host, _, port = raw[0].partition(":")
+    try:
+        return host, int(port) if port else AXON_DEFAULT_PORT
+    except ValueError:
+        return host, AXON_DEFAULT_PORT
+
+
+def tunnel_probe(env: dict, budget: float):
+    """Parent-side bounded TCP connect to the axon endpoint — the
+    wedged-tunnel pre-stage.  Returns (status, secs): "ok" (endpoint
+    accepted), "wedged" (connect TIMED OUT — the syn went nowhere, the
+    exact signature of the tunnel that hangs jax backend init in
+    uninterruptible C++), "refused" (fast deterministic failure — the
+    child will fail fast too, so it still runs and records the real
+    error), or (None, 0.0) when no tunnel is configured."""
+    ep = tunnel_endpoint(env)
+    if ep is None:
+        return None, 0.0
+    t0 = time.time()
+    try:
+        with socket.create_connection(ep, timeout=budget):
+            return "ok", round(time.time() - t0, 3)
+    except (socket.timeout, TimeoutError):
+        return "wedged", round(time.time() - t0, 3)
+    except OSError:
+        return "refused", round(time.time() - t0, 3)
+
+
 def probe(timeout: float, label: str) -> bool:
     env = dict(os.environ)
     # force backend init logging into the child's stderr
@@ -120,6 +168,24 @@ def probe(timeout: float, label: str) -> bool:
         "timeout_sec": timeout,
         "env": {k: env.get(k) for k in AXON_KEYS if k in env},
     }
+    # wedged-tunnel pre-stage: bounded connect BEFORE the child spawn,
+    # so a dead tunnel is named in the record (cause=tunnel_wedged) and
+    # the uninterruptible child hang is skipped entirely
+    t_status, t_secs = tunnel_probe(env, min(5.0, max(timeout / 4, 1.0)))
+    if t_status is not None:
+        rec["stages"] = {"tunnel_connect": t_secs}
+        if t_status == "wedged":
+            rec.update(outcome="hung", cause="tunnel_wedged",
+                       elapsed_sec=t_secs)
+            ep = tunnel_endpoint(env)
+            rec["stderr_tail"] = (f"parent-side connect to "
+                                  f"{ep[0]}:{ep[1]} timed out after "
+                                  f"{t_secs}s; child not spawned")
+            return _finish(rec)
+        if t_status == "refused":
+            # deterministic fast failure — the child still runs (it
+            # fails fast too and records the real backend error)
+            rec["cause"] = "tunnel_refused"
     t0 = time.time()
     try:
         r = subprocess.run([sys.executable, "-c", CHILD_CODE],
@@ -128,7 +194,8 @@ def probe(timeout: float, label: str) -> bool:
         rec["elapsed_sec"] = round(time.time() - t0, 2)
         rec["stdout_tail"] = r.stdout[-2000:]
         rec["stderr_tail"] = r.stderr[-2000:]
-        rec["stages"] = parse_stages(r.stdout)
+        rec["stages"] = {**rec.get("stages", {}),
+                         **parse_stages(r.stdout)}
         ok_line = next((l for l in r.stdout.splitlines()
                         if l.startswith("@ok ")), None)
         if r.returncode == 0 and ok_line:
@@ -151,16 +218,24 @@ def probe(timeout: float, label: str) -> bool:
         # completed stages narrow the hang to one phase: e.g. stages
         # showing client_init but not device_enumerate pins the wedge on
         # PJRT device enumeration, not the claim/grant handshake
-        rec["stages"] = parse_stages(out_full)
+        rec["stages"] = {**rec.get("stages", {}),
+                         **parse_stages(out_full)}
     except OSError as e:
         rec["elapsed_sec"] = round(time.time() - t0, 2)
         rec.update(outcome="spawn-failed", error=str(e))
+    return _finish(rec)
 
+
+def _finish(rec: dict) -> bool:
+    """Append the record to PROBE_LOG.jsonl and print the human gloss;
+    shared by the child-probe path and the tunnel_wedged short-circuit."""
     sink = _sinks.JsonlSink(LOG_PATH)
     sink.emit(rec)
     sink.close()
     ok = rec["outcome"] == "ok"
-    print(f"[probe] {rec['outcome']} in {rec['elapsed_sec']}s"
+    print(f"[probe] {rec['outcome']}"
+          + (f" ({rec['cause']})" if rec.get("cause") else "")
+          + f" in {rec['elapsed_sec']}s"
           + (f" — {rec.get('platform')}x{rec.get('n_devices')}" if ok else "")
           + f" (logged to {os.path.basename(LOG_PATH)})",
           file=sys.stderr, flush=True)

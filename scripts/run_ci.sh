@@ -348,6 +348,70 @@ print(f"[run_ci] streaming smoke: byte parity over {int(passes)} shard "
       f"{budget_mb} MB budget")
 EOF
 
+# spool smoke (ISSUE 16): streamed training plus one served predict with
+# the cross-process telemetry spool attached, then the jax-free timeline
+# CLI must aggregate the spool, export a loadable Chrome trace, and the
+# streaming-pass stall attribution must respect its disjoint-subinterval
+# contract (stage sum <= pass wall, 5% clock-sanity slack).  The full
+# matrix (2-process gloo aggregation, byte identity, straggler naming)
+# lives in tests/test_spool.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+import numpy as np
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.serving import ServingClient
+
+spool = tempfile.mkdtemp(prefix="ci_spool_")
+rng = np.random.default_rng(11)
+n, f = 20000, 52
+X = rng.standard_normal((n, f))
+y = (X[:, 0] - X[:, 3] + 0.1 * rng.standard_normal(n) > 0).astype(float)
+st = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                "min_data_in_leaf": 20, "external_memory": True,
+                "datastore_budget_mb": 0.25, "streaming_train": "on",
+                "telemetry_spool_dir": spool},
+               lgb.Dataset(X, label=y), num_boost_round=4)
+# one served predict: the spool attach is process-global, so the serve
+# spans land in the same proc-*.jsonl as the training passes
+client = ServingClient(st, params={"serve_warmup": False})
+got = client.predict(X[:64])
+client.close()
+assert got.shape == (64,), got.shape
+telemetry.TRACER.emit_metrics_snapshot()
+telemetry.TRACER.flush()
+
+trace_path = os.path.join(spool, "trace.json")
+r = subprocess.run([sys.executable, "-m", "lightgbm_tpu", "timeline",
+                    spool, "--trace", trace_path],
+                   capture_output=True, text=True)
+assert r.returncode == 0, r.stderr[-2000:]
+with open(trace_path) as fh:
+    trace = json.load(fh)
+assert trace["traceEvents"], "empty chrome trace"
+
+from lightgbm_tpu.telemetry.spool import aggregate
+agg = aggregate(spool)
+stream = agg["stream"]
+assert stream["passes"] > 0, "no stream.pass spans spooled"
+assert stream["attributed_s"] <= stream["wall_s"] * 1.05, \
+    (f"stage attribution {stream['attributed_s']}s exceeds pass wall "
+     f"{stream['wall_s']}s — sub-intervals are no longer disjoint")
+serve_spans = [e for e in agg["events"] if e.get("ev") == "span"
+               and str(e.get("name", "")).startswith("serve.")]
+assert serve_spans, "served predict left no serve.* spans in the spool"
+print(f"[run_ci] spool smoke: timeline over "
+      f"{len(agg['processes'])} process(es), {stream['passes']} streamed "
+      f"passes, attributed {stream['attributed_s']:.3f}s <= wall "
+      f"{stream['wall_s']:.3f}s, chrome trace "
+      f"{len(trace['traceEvents'])} events")
+EOF
+
 # mesh smoke (PR 10): distributed training + sharded serving on the
 # virtual 8-device mesh.  One data-parallel training round must be
 # byte-identical to the serial learner (one round pins the psum

@@ -70,6 +70,17 @@ def build_snapshot(rounds: int, rel_tol: float,
     lgb.train({**params, "flight_recorder": False,
                "external_memory": True, "datastore_shard_rows": 512},
               lgb.Dataset(X, label=y), num_boost_round=4)
+    # streaming segment (ISSUE 16): a short shard-streamed run so the
+    # baseline carries the stream.* gauges/counters and the
+    # stream.pass.* attribution histograms.  Pass counts and shard
+    # geometry are data-determined; the histogram percentiles are
+    # wall-clock and timing-class in diff.RULES (stream.pass.*.count is
+    # ignore-class, so a pass-count change only fails through the
+    # stream.shard_passes counter it already fails through)
+    lgb.train({**params, "flight_recorder": False,
+               "external_memory": True, "datastore_shard_rows": 512,
+               "streaming_train": "on"},
+              lgb.Dataset(X, label=y), num_boost_round=4)
     # sharded serving segment: one pinned replica per visible device
     # (1 on the CPU CI box) so the baseline carries the
     # serve.replicas / serve.replica.<i>.* / stripe-imbalance names

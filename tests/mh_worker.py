@@ -31,6 +31,15 @@ def main() -> None:
                                        shard_dataset)
 
     assert jax.process_count() == nproc
+
+    spool_dir = os.environ.get("LGBM_TPU_SPOOL_DIR")
+    if spool_dir:
+        # cross-process telemetry spool: each rank contributes its own
+        # proc-*.jsonl (role gloo-rank, rank = process_id) so the parent
+        # test can aggregate a REAL 2-process timeline
+        from lightgbm_tpu.telemetry.spool import attach_spool
+        attach_spool(spool_dir, role="gloo-rank", rank=pid)
+
     bins, y, spec, feat, allowed = g._toy_problem(n=512, f=8)
 
     def grad_fn(score, label):
@@ -55,6 +64,11 @@ def main() -> None:
              threshold_bin=np.asarray(tree.threshold_bin),
              leaf_value=np.asarray(tree.leaf_value),
              n_devices=jax.device_count())
+    if spool_dir:
+        from lightgbm_tpu.telemetry import TRACER
+        TRACER.emit_metrics_snapshot()
+        TRACER.flush()
+
     print(f"proc {pid}: OK, {int(tree.n_splits)} splits over "
           f"{jax.device_count()} devices", flush=True)
 

@@ -21,10 +21,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _spawn_cluster(tmp_path, port: int, nproc: int = 2,
-                   local_devices: int = 4, timeout: int = 600):
+                   local_devices: int = 4, timeout: int = 600,
+                   extra_env: dict = None):
     sys.path.insert(0, REPO)
     from lightgbm_tpu.utils.env import cleaned_cpu_env
     env = cleaned_cpu_env(os.environ, local_devices)
+    env.update(extra_env or {})
     worker = os.path.join(REPO, "tests", "mh_worker.py")
     procs = [subprocess.Popen(
         [sys.executable, worker, str(i), str(nproc), str(port),

@@ -9,11 +9,18 @@ docs/STATIC_ANALYSIS.md for the rule catalogue and baseline workflow.
 from .contracts import (ContractError, contract, enable_runtime_checks,
                         runtime_checks_enabled)
 from .engine import Finding, LintEngine
+from .lockwitness import (LockOrderError, WitnessLock,
+                          enable_lock_witness, lock_witness_enabled,
+                          make_lock, reset_lock_witness, witness_edges)
+from .race import race_rules
 from .rules import default_rules
 
 __all__ = ["contract", "ContractError", "enable_runtime_checks",
            "runtime_checks_enabled", "Finding", "LintEngine",
-           "default_rules", "main"]
+           "default_rules", "race_rules", "main",
+           "LockOrderError", "WitnessLock", "make_lock",
+           "enable_lock_witness", "lock_witness_enabled",
+           "reset_lock_witness", "witness_edges"]
 
 
 def main(argv=None) -> int:

@@ -7,6 +7,7 @@ Exit codes: 0 clean (or everything suppressed by the baseline),
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 from typing import Optional, Sequence
 
@@ -21,15 +22,20 @@ def build_parser() -> argparse.ArgumentParser:
                     "telemetry purity)")
     p.add_argument("paths", nargs="*",
                    help="files/dirs to lint (default: the package)")
+    p.add_argument("--race", action="store_true",
+                   help="run the graft-race concurrency/determinism "
+                        "pack (R006-R010) against race_baseline.json "
+                        "instead of the default rules")
     p.add_argument("--format", choices=("text", "json"),
                    default="text",
                    help="text (default) or telemetry-event JSONL")
     p.add_argument("--update-baseline", action="store_true",
-                   help="rewrite lint_baseline.json from the current "
+                   help="rewrite the baseline from the current "
                         "findings (keeps notes on kept entries)")
     p.add_argument("--baseline", default=None,
                    help="baseline path (default: <root>/"
-                        "lint_baseline.json)")
+                        "lint_baseline.json, or <root>/"
+                        "race_baseline.json with --race)")
     p.add_argument("--root", default=None,
                    help="repo root (default: the checkout containing "
                         "this package)")
@@ -44,7 +50,17 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(
         list(argv) if argv is not None else None)
-    engine = LintEngine(root=args.root, baseline_path=args.baseline)
+    rules = None
+    tool = "graft-lint"
+    if args.race:
+        from .race import RACE_BASELINE_NAME, race_rules
+        rules = race_rules()
+        tool = "graft-race"
+    engine = LintEngine(root=args.root, rules=rules,
+                        baseline_path=args.baseline)
+    if args.race and args.baseline is None:
+        engine.baseline_path = os.path.join(engine.root,
+                                            RACE_BASELINE_NAME)
     findings = engine.run(args.paths or None)
 
     if args.update_baseline:
@@ -75,7 +91,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                      f"{'y' if len(stale) == 1 else 'ies'} "
                      "(run --update-baseline)")
     tail = f" ({', '.join(notes)})" if notes else ""
-    print(f"graft-lint: {len(new)} new finding(s){tail}",
+    print(f"{tool}: {len(new)} new finding(s){tail}",
           file=sys.stderr)
     if stale and args.strict_baseline:
         for fp in stale:

@@ -1,0 +1,206 @@
+"""Runtime lock-order witness: the dynamic half of graft-race.
+
+The static pass (analysis/race.py R006) proves the absence of
+lock-order cycles over the acquisition edges it can SEE; this module
+watches the edges that actually happen.  Threaded subsystems create
+their coarse-grained locks through :func:`make_lock`, which hands out a
+``WitnessLock`` — a drop-in ``threading.Lock`` wrapper that, when armed
+via the ``debug_locks`` param, records every acquisition into one
+process-global partial order:
+
+    acquiring B while holding A  =>  edge A -> B
+
+(vector-clock-lite: no per-thread clocks, just the global happens-
+inside-order relation).  The first acquisition that would close a
+cycle — B taken under A anywhere after A was ever taken under B —
+raises :class:`LockOrderError` *before* touching the real lock,
+carrying BOTH stacks: the current one and the stack recorded when the
+opposite edge was first observed.  A latent deadlock therefore fails
+loudly on the first inverted acquisition, not on the unlucky
+interleaving that would actually wedge two threads.
+
+Granularity is the lock's *role* ("serving.registry._swap_lock"), not
+the instance: every instance of a class shares one order node, so the
+witness enforces the design's ordering discipline rather than one
+process's lucky schedule.  Re-acquiring a role already held by the
+current thread is also a hard error — these are plain (non-reentrant)
+locks, so the instance-level case is a guaranteed self-deadlock.
+
+Disarmed (the default), ``acquire`` costs one dict lookup over the raw
+lock — cheap enough that the wrapped subsystem locks (registry swap,
+breaker, prefetcher, scheduler; never the per-metric telemetry locks,
+which are leaf-only by design) keep it in production builds.
+
+STDLIB-ONLY by design, like the rest of ``analysis/``: threading +
+traceback, importable from jax-free processes.
+"""
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Dict, List, Optional, Set, Tuple
+
+__all__ = ["LockOrderError", "WitnessLock", "make_lock",
+           "enable_lock_witness", "lock_witness_enabled",
+           "reset_lock_witness", "witness_edges"]
+
+
+class LockOrderError(RuntimeError):
+    """Two locks were acquired in opposite orders somewhere in this
+    process — a latent deadlock.  Raised on the acquisition that closes
+    the cycle, before the real lock is touched."""
+
+
+_STATE = {"enabled": False}
+
+#: role -> roles acquired while it was held (the observed partial order)
+_GRAPH: Dict[str, Set[str]] = {}
+#: (a, b) -> formatted stack of the first time b was taken under a
+_EDGE_STACKS: Dict[Tuple[str, str], str] = {}
+#: guards _GRAPH/_EDGE_STACKS; held only for dict ops + a bounded DFS,
+#: and NEVER while any witnessed lock is being acquired or released
+_META = threading.Lock()
+
+_TLS = threading.local()
+
+
+def enable_lock_witness(on: bool = True) -> None:
+    """Arm (or disarm) order recording process-wide.  Sticky, like
+    ``enable_runtime_checks``: every ``debug_locks=true`` component arms
+    it and nothing disarms it behind their back."""
+    _STATE["enabled"] = bool(on)
+
+
+def lock_witness_enabled() -> bool:
+    return _STATE["enabled"]
+
+
+def reset_lock_witness() -> None:
+    """Forget every recorded edge (tests: isolate one scenario's order
+    from the process history).  Does not change armed state."""
+    with _META:
+        _GRAPH.clear()
+        _EDGE_STACKS.clear()
+
+
+def witness_edges() -> Dict[str, Set[str]]:
+    """Snapshot of the observed order graph (diagnostics/tests)."""
+    with _META:
+        return {a: set(bs) for a, bs in _GRAPH.items()}
+
+
+def _held() -> List[str]:
+    held = getattr(_TLS, "held", None)
+    if held is None:
+        held = _TLS.held = []
+    return held
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """Shortest observed-order path src -> ... -> dst (caller holds
+    _META), or None."""
+    if src == dst:
+        return [src]
+    prev: Dict[str, str] = {}
+    frontier = [src]
+    seen = {src}
+    while frontier:
+        nxt: List[str] = []
+        for a in frontier:
+            for b in _GRAPH.get(a, ()):
+                if b in seen:
+                    continue
+                prev[b] = a
+                if b == dst:
+                    path = [b]
+                    while path[-1] != src:
+                        path.append(prev[path[-1]])
+                    return path[::-1]
+                seen.add(b)
+                nxt.append(b)
+        frontier = nxt
+    return None
+
+
+def _record_acquire(name: str) -> None:
+    held = _held()
+    if name in held:
+        raise LockOrderError(
+            f"lock witness: re-acquiring {name!r} already held by this "
+            f"thread (held: {' -> '.join(held)}) — non-reentrant lock, "
+            f"guaranteed self-deadlock\n\ncurrent stack:\n"
+            + "".join(traceback.format_stack(limit=16)))
+    if not held:
+        return
+    with _META:
+        # closing edge check: does `name` already reach any held lock?
+        for h in held:
+            path = _find_path(name, h)
+            if path is None:
+                continue
+            first = _EDGE_STACKS.get((path[0], path[1]), "<unrecorded>")
+            raise LockOrderError(
+                "lock witness: lock-order inversion — acquiring "
+                f"{name!r} while holding {h!r}, but the opposite order "
+                f"{' -> '.join(path)} was already observed\n\n"
+                f"current stack (wants {h} -> {name}):\n"
+                + "".join(traceback.format_stack(limit=16))
+                + f"\nfirst stack for {path[0]} -> {path[1]}:\n{first}")
+        for h in held:
+            if name not in _GRAPH.setdefault(h, set()):
+                _GRAPH[h].add(name)
+                _EDGE_STACKS[(h, name)] = "".join(
+                    traceback.format_stack(limit=16))
+
+
+class WitnessLock:
+    """``threading.Lock`` wrapper that feeds the order witness.
+
+    Same surface as the raw lock (``acquire``/``release``/``locked``/
+    context manager), so it is a drop-in for every ``with self._lock:``
+    site.  All witness work happens BEFORE the raw acquire — a
+    violation raises instead of (maybe) deadlocking.
+    """
+
+    __slots__ = ("name", "_raw")
+
+    def __init__(self, name: str):
+        self.name = str(name)
+        self._raw = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if _STATE["enabled"]:
+            _record_acquire(self.name)
+            got = self._raw.acquire(blocking, timeout)
+            if got:
+                _held().append(self.name)
+            return got
+        return self._raw.acquire(blocking, timeout)
+
+    def release(self) -> None:
+        if _STATE["enabled"]:
+            held = _held()
+            if self.name in held:
+                held.remove(self.name)
+        self._raw.release()
+
+    def locked(self) -> bool:
+        return self._raw.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        state = "locked" if self._raw.locked() else "unlocked"
+        return f"<WitnessLock {self.name} {state}>"
+
+
+def make_lock(name: str) -> WitnessLock:
+    """Create a witnessed lock under role `name` (dotted, stable across
+    versions: "serving.registry._swap_lock").  The threaded subsystems
+    call this instead of ``threading.Lock()`` for every lock that can
+    nest with another."""
+    return WitnessLock(name)

@@ -1,0 +1,1345 @@
+"""graft-race rule set: concurrency & determinism hazards (R006-R010).
+
+The fleet is thread-heavy — batcher workers, prefetcher daemons, swap
+locks, breaker re-probe threads, spool writers — and its two hard
+invariants (no deadlock under chaos, byte-identical models/responses
+everywhere) were previously guarded only by hand-written tests.  This
+pack makes both statically checkable:
+
+  R006  lock-order cycles over the whole-program lock-acquisition
+        graph (attribute-resolved ``with self._lock:`` sites,
+        interprocedural through the call-graph closure), plus lock
+        re-acquisition through a call chain (self-deadlock on the
+        non-reentrant locks this codebase uses);
+  R007  access to ``# guarded-by: <lock>`` annotated state without the
+        named lock held — the annotation IS the contract: once state
+        declares its lock, every unlocked write/iteration in the owning
+        class (or module, for module-level state) is a finding;
+  R008  thread lifecycle: non-daemon threads with no reachable
+        ``join()``, and ``acquire()`` outside ``with``/try-finally;
+  R009  determinism hazards on device-feeding paths: iteration over
+        sets (hash order) feeding ordered consumers, ``np.argsort``
+        without a stable kind, float accumulation over unordered
+        collections;
+  R010  blocking work inside a held-lock region — R001-class host
+        syncs, ``time.sleep``, thread joins, event waits, blocking
+        queue ops (the swap-lock stall class).
+
+Like R001-R005 the rules are deliberately high-precision: only locks
+created through ``threading.Lock/RLock`` or ``lockwitness.make_lock``
+participate, only annotated state is guarded, and anything that still
+misfires is suppressed through the checked-in ``race_baseline.json``
+(one justification note per entry) rather than by weakening a rule.
+
+The five rules share one ``_RaceProgram`` — a whole-program model built
+during the engine's collect phase (every module is scanned before any
+check runs), holding per-function lock events, call sites with their
+held-lock sets, guarded-state accesses, and thread constructions.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .engine import Finding, ModuleContext, dotted_name
+
+__all__ = ["race_rules", "RACE_RULES", "RACE_BASELINE_NAME",
+           "R006LockOrder", "R007GuardedBy", "R008ThreadLifecycle",
+           "R009Determinism", "R010SyncUnderLock"]
+
+RACE_BASELINE_NAME = "race_baseline.json"
+
+#: container-mutating method names that count as writes to the receiver
+_MUTATORS = {"append", "appendleft", "add", "extend", "insert", "pop",
+             "popleft", "popitem", "remove", "discard", "clear",
+             "update", "setdefault"}
+
+#: order-sensitive consumers of an iterable (R007 reads / R009 feeds)
+_ITER_FUNCS = {"sorted", "list", "tuple", "iter", "enumerate", "dict",
+               "sum", "reversed"}
+
+#: guarded-by tokens that are documentation-only (no lock to enforce)
+_GUARD_DOC_TOKENS = {"atomic", "owner", "worker", "init-only",
+                     "single-writer", "caller", "immutable"}
+
+_GUARD_RE = re.compile(r"#.*?guarded-by:\s*([A-Za-z0-9_.\-]+)")
+_ATTR_DECL_RE = re.compile(r"self\.(\w+)\s*(?::[^=]+)?=")
+_MOD_DECL_RE = re.compile(r"^([A-Za-z_]\w*)\s*(?::[^=]+)?=")
+
+#: paths whose host code feeds device ops / the model bytes (R009 scope)
+_R009_PREFIXES = ("lightgbm_tpu/ops/", "lightgbm_tpu/parallel/",
+                  "lightgbm_tpu/streaming/", "lightgbm_tpu/mesh/",
+                  "lightgbm_tpu/serving/", "lightgbm_tpu/compiler/",
+                  "lightgbm_tpu/native/")
+_R009_FILES = ("lightgbm_tpu/booster.py", "lightgbm_tpu/engine.py",
+               "lightgbm_tpu/basic.py", "lightgbm_tpu/tree.py",
+               "lightgbm_tpu/objectives.py",
+               "lightgbm_tpu/rank_objective.py",
+               "lightgbm_tpu/convert.py", "lightgbm_tpu/metrics.py",
+               "lightgbm_tpu/utils/binning.py",
+               "lightgbm_tpu/utils/efb.py")
+
+
+def _mk(ctx: ModuleContext, rule: str, node: ast.AST, msg: str
+        ) -> Finding:
+    line = getattr(node, "lineno", 0)
+    return Finding(rule, ctx.relpath, line,
+                   getattr(node, "col_offset", 0),
+                   ctx.symbol_at(line), msg, ctx.snippet(line))
+
+
+def _attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """['self', '_q'] for ``self._q``; ['q'] for ``q``; None for
+    anything not a plain name/attribute chain (subscripts allowed and
+    skipped: ``self._b[k]`` -> ['self', '_b'])."""
+    parts: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            parts.append(node.id)
+            return parts[::-1]
+        else:
+            return None
+
+
+def _state_token(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """("attr", a) for ``self.a[...]`` chains, ("mod", n) for bare
+    names — the two kinds of shared state R007 can guard."""
+    chain = _attr_chain(node)
+    if not chain:
+        return None
+    if chain[0] == "self" and len(chain) >= 2:
+        return ("attr", chain[1])
+    if len(chain) == 1:
+        return ("mod", chain[0])
+    return None
+
+
+class _FnInfo:
+    """Everything the rules need to know about one function."""
+
+    __slots__ = ("module", "qualname", "cls", "node", "line",
+                 "acquires", "calls", "writes", "reads",
+                 "under_lock", "globals_decl")
+
+    def __init__(self, module: str, qualname: str, cls: Optional[str],
+                 node: ast.AST):
+        self.module = module
+        self.qualname = qualname
+        self.cls = cls
+        self.node = node
+        self.line = node.lineno
+        #: (lock_id, held_tuple, line)
+        self.acquires: List[Tuple[str, Tuple[str, ...], int]] = []
+        #: (callee_spec, held_tuple, line)
+        self.calls: List[Tuple[tuple, Tuple[str, ...], int]] = []
+        #: (("attr"|"mod", name), held_tuple, line)
+        self.writes: List[Tuple[Tuple[str, str], Tuple[str, ...], int]] = []
+        #: iteration/snapshot reads, same shape as writes
+        self.reads: List[Tuple[Tuple[str, str], Tuple[str, ...], int]] = []
+        #: every Call made while >=1 lock held: (node, held_tuple)
+        self.under_lock: List[Tuple[ast.Call, Tuple[str, ...]]] = []
+        self.globals_decl: Set[str] = set()
+
+    @property
+    def key(self) -> Tuple[str, str]:
+        return (self.module, self.qualname)
+
+
+class _ThreadInfo:
+    __slots__ = ("node", "line", "daemon", "target", "stored", "fn")
+
+    def __init__(self, node, daemon, target, stored, fn):
+        self.node = node
+        self.line = node.lineno
+        self.daemon = daemon          # True / False / None (unset)
+        self.target = target          # callee spec or None
+        self.stored = stored          # ("attr", a) / ("name", n) / None
+        self.fn = fn                  # owning _FnInfo (or None)
+
+
+class _ModInfo:
+    """Per-module slice of the program model."""
+
+    def __init__(self, ctx: ModuleContext):
+        self.module = ctx.module
+        self.relpath = ctx.relpath
+        self.lines = ctx.lines
+        self.module_aliases = dict(ctx.module_aliases)
+        self.from_imports = dict(ctx.from_imports)
+        self.classes: Dict[str, ast.ClassDef] = {}
+        #: ("attr", cls, attr) / ("mod", "", name) -> global lock id
+        self.locks: Dict[Tuple[str, str, str], str] = {}
+        #: threading.Event/Condition attrs, same keys as locks
+        self.events: Set[Tuple[str, str, str]] = set()
+        #: tokens threads are stored under / joined at (R008)
+        self.thread_tokens: Set[Tuple[str, str]] = set()
+        self.join_tokens: Set[Tuple[str, str]] = set()
+        self.daemon_sets: Set[Tuple[str, str]] = set()
+        self.threads: List[_ThreadInfo] = []
+        #: (cls, attr) -> unresolved ctor dotted name
+        self.attr_ctor: Dict[Tuple[str, str], str] = {}
+        #: ("attr", cls, attr)/("mod", "", name) -> (guard, line)
+        self.guards: Dict[Tuple[str, str, str], Tuple[str, int]] = {}
+        self.fns: Dict[str, _FnInfo] = {}
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def symbol_of(self, fi: _FnInfo) -> str:
+        return fi.qualname
+
+
+def _resolve_factory(ctx: ModuleContext, call: ast.Call
+                     ) -> Optional[str]:
+    """'Lock'/'RLock'/'Event'/'Condition'/'Thread'/'Timer'/'make_lock'
+    when `call` constructs one of the threading primitives the rules
+    track, else None."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    wanted = {"Lock", "RLock", "Event", "Condition", "Thread", "Timer"}
+    if len(parts) == 1:
+        if parts[0] == "make_lock":
+            return "make_lock"
+        fi = ctx.from_imports.get(parts[0])
+        if fi is not None:
+            mod, orig = fi
+            if orig == "make_lock":
+                return "make_lock"
+            if mod == "threading" and orig in wanted:
+                return orig
+        return None
+    base, attr = parts[0], parts[-1]
+    mod = ctx.module_aliases.get(base)
+    if mod == "threading" and attr in wanted:
+        return attr
+    if attr == "make_lock":
+        return "make_lock"
+    return None
+
+
+class _Scanner:
+    """One pass over a function body: lock nesting, call sites, writes,
+    iteration-reads, thread constructions — everything held-set-aware."""
+
+    def __init__(self, prog: "_RaceProgram", ctx: ModuleContext,
+                 minfo: _ModInfo, fi: _FnInfo):
+        self.prog = prog
+        self.ctx = ctx
+        self.minfo = minfo
+        self.fi = fi
+
+    # ------------------------------------------------------------ locks
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        tok = _state_token(expr)
+        if tok is None:
+            return None
+        kind, name = tok
+        if kind == "attr" and self.fi.cls:
+            return self.minfo.locks.get(("attr", self.fi.cls, name))
+        if kind == "mod":
+            return self.minfo.locks.get(("mod", "", name))
+        return None
+
+    # ------------------------------------------------------- statements
+    def walk(self, stmts: Sequence[ast.stmt],
+             held: Tuple[str, ...]) -> None:
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue  # scanned as their own intervals
+            if isinstance(st, ast.Global):
+                self.fi.globals_decl.update(st.names)
+                continue
+            for expr in self._stmt_exprs(st):
+                self.scan_expr(expr, held)
+            if isinstance(st, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                self._handle_assign(st, held)
+            elif isinstance(st, ast.Delete):
+                for t in st.targets:
+                    self._record_write(t, held, st.lineno)
+            if isinstance(st, ast.For) or \
+                    isinstance(st, getattr(ast, "AsyncFor", ())):
+                self._record_read(st.iter, held, st.lineno)
+            if isinstance(st, ast.With) or \
+                    isinstance(st, getattr(ast, "AsyncWith", ())):
+                inner = held
+                for item in st.items:
+                    lid = self._lock_id(item.context_expr)
+                    if lid is not None:
+                        self.fi.acquires.append(
+                            (lid, inner, item.context_expr.lineno))
+                        inner = inner + (lid,)
+                self.walk(st.body, inner)
+                continue
+            for field in ("body", "orelse", "finalbody"):
+                b = getattr(st, field, None)
+                if isinstance(b, list) and b and \
+                        isinstance(b[0], ast.stmt):
+                    self.walk(b, held)
+            for h in getattr(st, "handlers", []):
+                self.walk(h.body, held)
+
+    @staticmethod
+    def _stmt_exprs(st: ast.stmt) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        for field in ("value", "test", "iter", "exc", "msg"):
+            v = getattr(st, field, None)
+            if isinstance(v, ast.AST):
+                out.append(v)
+        if isinstance(st, ast.With) or \
+                isinstance(st, getattr(ast, "AsyncWith", ())):
+            out.extend(i.context_expr for i in st.items)
+        return out
+
+    # ------------------------------------------------------ assignments
+    def _handle_assign(self, st: ast.stmt,
+                       held: Tuple[str, ...]) -> None:
+        targets = st.targets if isinstance(st, ast.Assign) \
+            else [st.target]
+        value = getattr(st, "value", None)
+        for t in targets:
+            for leaf in self._flatten_target(t):
+                self._record_write(leaf, held, st.lineno)
+        # thread construction stored somewhere / daemon flag sets
+        if isinstance(value, ast.Call):
+            kind = _resolve_factory(self.ctx, value)
+            if kind in ("Thread", "Timer"):
+                stored = None
+                if len(targets) == 1:
+                    stored = _state_token(targets[0])
+                self._record_thread(value, stored)
+                if stored:
+                    self.minfo.thread_tokens.add(stored)
+        for t in targets:
+            if isinstance(t, ast.Attribute) and t.attr == "daemon":
+                tok = _state_token(t.value)
+                if tok:
+                    self.minfo.daemon_sets.add(tok)
+
+    @staticmethod
+    def _flatten_target(t: ast.AST) -> List[ast.AST]:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            out: List[ast.AST] = []
+            for e in t.elts:
+                out.extend(_Scanner._flatten_target(e))
+            return out
+        return [t]
+
+    def _record_write(self, target: ast.AST, held: Tuple[str, ...],
+                      line: int) -> None:
+        if isinstance(target, ast.Name):
+            # a plain name-store is a local unless declared global
+            if target.id not in self.fi.globals_decl:
+                return
+            self.fi.writes.append((("mod", target.id), held, line))
+            return
+        tok = _state_token(target)
+        if tok is None:
+            return
+        if isinstance(target, (ast.Attribute, ast.Subscript)):
+            self.fi.writes.append((tok, held, line))
+
+    def _record_read(self, expr: ast.AST, held: Tuple[str, ...],
+                     line: int) -> None:
+        """Record `expr` as an order/consistency-sensitive read (an
+        iteration source or snapshot) of shared state."""
+        if isinstance(expr, ast.Call):
+            # dict-view / shallow-copy iteration reads the container live:
+            # `for k, v in self.fired.items()` races a concurrent resize
+            # exactly like iterating the dict itself
+            func = expr.func
+            if isinstance(func, ast.Attribute) and not expr.args \
+                    and func.attr in ("items", "keys", "values", "copy"):
+                expr = func.value
+            else:
+                return  # sorted(self.x) etc. handled in scan_expr
+        tok = _state_token(expr)
+        if tok is not None:
+            self.fi.reads.append((tok, held, line))
+
+    # ----------------------------------------------------- expressions
+    def scan_expr(self, expr: ast.AST, held: Tuple[str, ...]) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, (ast.Lambda, ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                continue
+            if isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                                 ast.SetComp, ast.DictComp)):
+                for gen in node.generators:
+                    self._record_read(gen.iter, held, node.lineno)
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            self._scan_call(node, held)
+
+    def _scan_call(self, node: ast.Call, held: Tuple[str, ...]) -> None:
+        fi = self.fi
+        if held:
+            fi.under_lock.append((node, held))
+        func = node.func
+        # snapshot/iteration reads: sorted(self.x), list(self.x), ...
+        if isinstance(func, ast.Name) and func.id in _ITER_FUNCS \
+                and node.args:
+            self._record_read(node.args[0], held, node.lineno)
+        if isinstance(func, ast.Attribute) and func.attr == "join" \
+                and node.args and isinstance(func.value, ast.Constant):
+            pass  # "sep".join(...) — not a thread join
+        # thread construction not bound to a name (Thread(...).start())
+        kind = _resolve_factory(self.ctx, node)
+        if kind in ("Thread", "Timer"):
+            self._record_thread(node, stored=None)
+            return
+        # join bookkeeping for R008
+        if isinstance(func, ast.Attribute) and func.attr == "join" \
+                and not isinstance(func.value, ast.Constant):
+            tok = _state_token(func.value)
+            if tok:
+                self.minfo.join_tokens.add(tok)
+        # mutations via container methods
+        if isinstance(func, ast.Attribute) and func.attr in _MUTATORS:
+            tok = _state_token(func.value)
+            if tok:
+                fi.writes.append((tok, held, node.lineno))
+        # resolvable call specs for the interprocedural closure
+        spec = self._callee_spec(func)
+        if spec is not None:
+            fi.calls.append((spec, held, node.lineno))
+
+    def _callee_spec(self, func: ast.AST) -> Optional[tuple]:
+        if isinstance(func, ast.Name):
+            return ("name", func.id)
+        if not isinstance(func, ast.Attribute):
+            return None
+        v = func.value
+        if isinstance(v, ast.Name):
+            if v.id == "self":
+                return ("self", func.attr)
+            return ("var", v.id, func.attr)
+        while isinstance(v, ast.Subscript):
+            v = v.value
+        if isinstance(v, ast.Attribute) and \
+                isinstance(v.value, ast.Name) and v.value.id == "self":
+            return ("selfattr", v.attr, func.attr)
+        return None
+
+    # ---------------------------------------------------------- threads
+    def _record_thread(self, node: ast.Call,
+                       stored: Optional[Tuple[str, str]]) -> None:
+        daemon = None
+        target = None
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            if kw.arg == "target":
+                target = self._callee_spec_value(kw.value)
+        self.minfo.threads.append(
+            _ThreadInfo(node, daemon, target, stored, self.fi))
+
+    @staticmethod
+    def _callee_spec_value(v: ast.AST) -> Optional[tuple]:
+        if isinstance(v, ast.Name):
+            return ("name", v.id)
+        if isinstance(v, ast.Attribute) and \
+                isinstance(v.value, ast.Name):
+            if v.value.id == "self":
+                return ("self", v.attr)
+            return ("var", v.value.id, v.attr)
+        return None
+
+
+class _RaceProgram:
+    """Whole-program model shared by R006-R010; built incrementally by
+    each rule's ``collect`` (idempotent per module), linked lazily on
+    the first ``check``."""
+
+    def __init__(self):
+        self.mods: Dict[str, _ModInfo] = {}
+        self.fns: Dict[Tuple[str, str], _FnInfo] = {}
+        self._seen_paths: Set[str] = set()
+        self._linked = False
+        self._closure_memo: Dict[Tuple[str, str],
+                                 Dict[str, Tuple[str, ...]]] = {}
+
+    # ----------------------------------------------------------- collect
+    def collect(self, ctx: ModuleContext) -> None:
+        if ctx.relpath in self._seen_paths:
+            return
+        self._seen_paths.add(ctx.relpath)
+        minfo = _ModInfo(ctx)
+        self.mods[ctx.module] = minfo
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                minfo.classes[node.name] = node
+        self._collect_locks(ctx, minfo)
+        self._collect_guards(ctx, minfo)
+        # build OUR OWN qualified names ("Cls.meth", "Cls.meth.inner")
+        # — the engine's intervals carry bare function names, which
+        # collide across classes
+        self._visit_defs(ctx, minfo, ctx.tree.body, prefix="", cls=None)
+
+    def _visit_defs(self, ctx: ModuleContext, minfo: _ModInfo,
+                    body, prefix: str, cls: Optional[str]) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._visit_defs(ctx, minfo, node.body,
+                                 prefix=node.name, cls=node.name)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{node.name}" if prefix else node.name
+                fi = _FnInfo(ctx.module, qual, cls, node)
+                minfo.fns[qual] = fi
+                self.fns[fi.key] = fi
+                _Scanner(self, ctx, minfo, fi).walk(node.body, ())
+                self._visit_defs(ctx, minfo, node.body,
+                                 prefix=qual, cls=cls)
+            elif hasattr(node, "body") and not isinstance(
+                    node, (ast.Lambda,)):
+                # defs nested under if/try/with at any level
+                for field in ("body", "orelse", "finalbody"):
+                    b = getattr(node, field, None)
+                    if isinstance(b, list):
+                        self._visit_defs(ctx, minfo, b, prefix, cls)
+                for h in getattr(node, "handlers", []):
+                    self._visit_defs(ctx, minfo, h.body, prefix, cls)
+
+    @staticmethod
+    def _class_at(minfo: _ModInfo, line: int) -> Optional[str]:
+        """Innermost class whose body spans `line`."""
+        best = None
+        best_span = None
+        for name, cnode in minfo.classes.items():
+            end = getattr(cnode, "end_lineno", cnode.lineno)
+            if cnode.lineno <= line <= end:
+                span = end - cnode.lineno
+                if best_span is None or span < best_span:
+                    best, best_span = name, span
+        return best
+
+    def _collect_locks(self, ctx: ModuleContext,
+                       minfo: _ModInfo) -> None:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                continue
+            value = getattr(node, "value", None)
+            if not isinstance(value, ast.Call):
+                continue
+            kind = _resolve_factory(ctx, value)
+            if kind is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                tok = _state_token(t)
+                if tok is None:
+                    continue
+                tkind, name = tok
+                if tkind == "attr":
+                    cls = self._class_at(minfo, node.lineno)
+                    if cls is None:
+                        continue
+                    key = ("attr", cls, name)
+                    lid = f"{minfo.module}.{cls}.{name}"
+                else:
+                    key = ("mod", "", name)
+                    lid = f"{minfo.module}.{name}"
+                if kind in ("Lock", "RLock", "make_lock"):
+                    minfo.locks[key] = lid
+                elif kind in ("Event", "Condition"):
+                    minfo.events.add(key)
+        # attribute ctor types (incl. dict-of-instances values), for
+        # resolving self.attr.method() calls interprocedurally
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tok = _state_token(node.targets[0])
+            if tok is None or tok[0] != "attr":
+                continue
+            cls = self._class_at(minfo, node.lineno)
+            if cls is None:
+                continue
+            ctor = self._ctor_of(node.value)
+            if ctor:
+                minfo.attr_ctor[(cls, tok[1])] = ctor
+
+    @staticmethod
+    def _ctor_of(value: ast.AST) -> Optional[str]:
+        """Dotted ctor name when `value` is ClassName(...), a dict
+        literal/comprehension of ClassName(...) values, or a list of
+        them — the attribute's elements then type as ClassName."""
+        if isinstance(value, ast.Call):
+            name = dotted_name(value.func)
+            if name and name.split(".")[-1][:1].isupper():
+                return name
+            return None
+        if isinstance(value, ast.Dict) and value.values:
+            return _RaceProgram._ctor_of(value.values[0])
+        if isinstance(value, ast.DictComp):
+            return _RaceProgram._ctor_of(value.value)
+        if isinstance(value, (ast.List, ast.Tuple)) and value.elts:
+            return _RaceProgram._ctor_of(value.elts[0])
+        return None
+
+    def _collect_guards(self, ctx: ModuleContext,
+                        minfo: _ModInfo) -> None:
+        for i, line in enumerate(ctx.lines, start=1):
+            m = _GUARD_RE.search(line)
+            if m is None:
+                continue
+            guard = m.group(1)
+            code = line.split("#", 1)[0]
+            am = _ATTR_DECL_RE.search(code)
+            if am:
+                cls = self._class_at(minfo, i)
+                if cls:
+                    minfo.guards[("attr", cls, am.group(1))] = (guard, i)
+                continue
+            mm = _MOD_DECL_RE.match(code)
+            if mm:
+                minfo.guards[("mod", "", mm.group(1))] = (guard, i)
+
+    # -------------------------------------------------------- resolution
+    def resolve_class(self, minfo: _ModInfo, dotted: str
+                      ) -> Optional[Tuple[str, str]]:
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            if parts[0] in minfo.classes:
+                return (minfo.module, parts[0])
+            fi = minfo.from_imports.get(parts[0])
+            if fi:
+                mod, orig = fi
+                tgt = self.mods.get(mod) or self.mods.get(
+                    mod + ".__init__")
+                if tgt and orig in tgt.classes:
+                    return (tgt.module, orig)
+            return None
+        mod = minfo.module_aliases.get(parts[0])
+        if mod:
+            tgt = self.mods.get(mod)
+            if tgt and parts[-1] in tgt.classes:
+                return (tgt.module, parts[-1])
+        return None
+
+    def _method_key(self, module: str, cls: str, meth: str
+                    ) -> Optional[Tuple[str, str]]:
+        minfo = self.mods.get(module)
+        if minfo is None:
+            return None
+        q = f"{cls}.{meth}"
+        if q in minfo.fns:
+            return (module, q)
+        # one-level base-class lookup (SpoolSink(JsonlSink) etc.)
+        cnode = minfo.classes.get(cls)
+        if cnode is not None:
+            for base in cnode.bases:
+                bname = dotted_name(base)
+                if not bname:
+                    continue
+                resolved = self.resolve_class(minfo, bname)
+                if resolved:
+                    key = self._method_key(resolved[0], resolved[1],
+                                           meth)
+                    if key:
+                        return key
+        return None
+
+    def resolve_call(self, fi: _FnInfo, spec: tuple
+                     ) -> Optional[Tuple[str, str]]:
+        minfo = self.mods.get(fi.module)
+        if minfo is None:
+            return None
+        kind = spec[0]
+        if kind == "name":
+            name = spec[1]
+            nested = f"{fi.qualname}.{name}"
+            if nested in minfo.fns:
+                return (fi.module, nested)
+            if name in minfo.fns:
+                return (fi.module, name)
+            if name in minfo.classes:
+                return self._method_key(fi.module, name, "__init__")
+            imp = minfo.from_imports.get(name)
+            if imp:
+                mod, orig = imp
+                tgt = self.mods.get(mod) or self.mods.get(
+                    mod + ".__init__")
+                if tgt:
+                    if orig in tgt.fns:
+                        return (tgt.module, orig)
+                    if orig in tgt.classes:
+                        return self._method_key(tgt.module, orig,
+                                                "__init__")
+            return None
+        if kind == "self" and fi.cls:
+            return self._method_key(fi.module, fi.cls, spec[1])
+        if kind == "selfattr" and fi.cls:
+            ctor = minfo.attr_ctor.get((fi.cls, spec[1]))
+            if ctor:
+                resolved = self.resolve_class(minfo, ctor)
+                if resolved:
+                    return self._method_key(resolved[0], resolved[1],
+                                            spec[2])
+            return None
+        return None
+
+    def resolve_thread_target(self, minfo: _ModInfo, t: _ThreadInfo
+                              ) -> Optional[Tuple[str, str]]:
+        if t.target is None or t.fn is None:
+            return None
+        return self.resolve_call(t.fn, t.target)
+
+    # ---------------------------------------------------- lock closure
+    def lock_closure(self, key: Tuple[str, str],
+                     _stack: Optional[Set[Tuple[str, str]]] = None
+                     ) -> Dict[str, Tuple[str, ...]]:
+        """lock_id -> call chain (qualnames) through which `key`
+        transitively acquires it.  Direct acquisitions map to ()."""
+        memo = self._closure_memo
+        if key in memo:
+            return memo[key]
+        if _stack is None:
+            _stack = set()
+        if key in _stack:
+            return {}
+        _stack.add(key)
+        fi = self.fns.get(key)
+        out: Dict[str, Tuple[str, ...]] = {}
+        if fi is not None:
+            for lid, _held, _line in fi.acquires:
+                out.setdefault(lid, ())
+            for spec, _held, _line in fi.calls:
+                callee = self.resolve_call(fi, spec)
+                if callee is None or callee == key:
+                    continue
+                sub = self.lock_closure(callee, _stack)
+                for lid, chain in sub.items():
+                    out.setdefault(lid, (callee[1],) + chain)
+        _stack.discard(key)
+        memo[key] = out
+        return out
+
+
+# =============================================================== R006
+class R006LockOrder:
+    """Lock-order cycles and call-chain lock re-acquisition.
+
+    Builds the whole-program lock graph — edge A -> B whenever B is
+    acquired (directly or through the call-graph closure) while A is
+    held — and reports (a) every cycle with its witness path and the
+    code sites of the participating edges, and (b) every call chain
+    that re-acquires a lock the caller already holds (these are plain
+    non-reentrant locks: that is a self-deadlock, not a cycle)."""
+
+    id = "R006"
+    title = "lock-order cycle"
+
+    def __init__(self, prog: _RaceProgram):
+        self.prog = prog
+        self._analysis = None
+
+    def collect(self, ctx: ModuleContext) -> None:
+        self.prog.collect(ctx)
+
+    def _analyze(self):
+        prog = self.prog
+        # edges[(a, b)] = (relpath, line, symbol, via)
+        edges: Dict[Tuple[str, str], Tuple[str, int, str, str]] = {}
+        reacquires = []  # (minfo, fi, line, lock, chain)
+        for fi in prog.fns.values():
+            minfo = prog.mods[fi.module]
+            for lid, held, line in fi.acquires:
+                if lid in held:
+                    reacquires.append((minfo, fi, line, lid, ()))
+                    continue
+                for h in held:
+                    edges.setdefault(
+                        (h, lid), (minfo.relpath, line,
+                                   fi.qualname, ""))
+            for spec, held, line in fi.calls:
+                if not held:
+                    continue
+                callee = prog.resolve_call(fi, spec)
+                if callee is None:
+                    continue
+                for lid, chain in prog.lock_closure(callee).items():
+                    via = " -> ".join((callee[1],) + chain)
+                    if lid in held:
+                        reacquires.append(
+                            (minfo, fi, line, lid,
+                             (callee[1],) + chain))
+                        continue
+                    for h in held:
+                        edges.setdefault(
+                            (h, lid),
+                            (minfo.relpath, line, fi.qualname,
+                             f" (via {via})"))
+        cycles = self._cycles({e for e in edges})
+        self._analysis = (edges, cycles, reacquires)
+        return self._analysis
+
+    @staticmethod
+    def _cycles(edge_set: Set[Tuple[str, str]]
+                ) -> List[Tuple[str, ...]]:
+        graph: Dict[str, Set[str]] = {}
+        for a, b in edge_set:
+            graph.setdefault(a, set()).add(b)
+        seen_cycles: Set[frozenset] = set()
+        cycles: List[Tuple[str, ...]] = []
+
+        def dfs(start: str, node: str, path: Tuple[str, ...],
+                on_path: Set[str]):
+            for nxt in sorted(graph.get(node, ())):
+                if nxt == start and len(path) > 1:
+                    key = frozenset(path)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(path + (start,))
+                elif nxt not in on_path and nxt > start:
+                    dfs(start, nxt, path + (nxt,), on_path | {nxt})
+
+        for a in sorted(graph):
+            dfs(a, a, (a,), {a})
+        return cycles
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if self._analysis is None:
+            self._analyze()
+        edges, cycles, reacquires = self._analysis
+        for cyc in cycles:
+            pairs = list(zip(cyc, cyc[1:]))
+            sites = [edges[p] for p in pairs if p in edges]
+            if not sites:
+                continue
+            anchor = min(sites)
+            if anchor[0] != ctx.relpath:
+                continue
+            detail = "; ".join(
+                f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                f"{edges[(a, b)][3]}"
+                for a, b in pairs if (a, b) in edges)
+            yield Finding(
+                self.id, ctx.relpath, anchor[1], 0, anchor[2],
+                f"lock-order cycle {' -> '.join(cyc)} — opposite "
+                f"acquisition orders can deadlock ({detail})",
+                ctx.snippet(anchor[1]))
+        for minfo, fi, line, lid, chain in reacquires:
+            if minfo.relpath != ctx.relpath:
+                continue
+            via = f" via call chain {' -> '.join(chain)}" if chain \
+                else ""
+            yield Finding(
+                self.id, ctx.relpath, line, 0, fi.qualname,
+                f"lock {lid} re-acquired while already held{via} — "
+                "non-reentrant lock, guaranteed self-deadlock",
+                ctx.snippet(line))
+
+
+# =============================================================== R007
+class R007GuardedBy:
+    """Unguarded access to ``# guarded-by:`` annotated shared state.
+
+    The annotation is the contract: for an attribute declared
+    ``self._x = ...  # guarded-by: <lock>`` every write (assignment,
+    augmented assignment, container mutation) and every order/
+    consistency-sensitive read (iteration, ``sorted``/``list``/``dict``
+    snapshot) in the owning class must run with ``self._lock`` held —
+    lexically, or through the held-set the class's own callers
+    propagate into an underscore-private helper.  Module-level state
+    annotated the same way is checked across every function of the
+    module.  Guard tokens that do not name a lock (``atomic``,
+    ``single-writer``, ``worker``, ...) are documentation-only; a
+    lock-looking token that matches no known lock is itself flagged
+    (an annotation typo silently disables enforcement otherwise).
+    """
+
+    id = "R007"
+    title = "unguarded access to guarded-by state"
+
+    def __init__(self, prog: _RaceProgram):
+        self.prog = prog
+        self._findings = None
+
+    def collect(self, ctx: ModuleContext) -> None:
+        self.prog.collect(ctx)
+
+    # ------------------------------------------------------------ link
+    def _guard_lock(self, minfo: _ModInfo, cls: str, guard: str
+                    ) -> Optional[str]:
+        if cls:
+            lid = minfo.locks.get(("attr", cls, guard))
+            if lid:
+                return lid
+        return minfo.locks.get(("mod", "", guard))
+
+    def _analyze(self):
+        prog = self.prog
+        findings = []  # (relpath, line, symbol, message, minfo)
+        for minfo in prog.mods.values():
+            guarded_cls: Dict[str, Dict[str, str]] = {}
+            guarded_mod: Dict[str, str] = {}
+            for (kind, cls, name), (guard, gline) in \
+                    sorted(minfo.guards.items()):
+                token = guard.lower().rstrip(".,;")
+                if token in _GUARD_DOC_TOKENS:
+                    continue
+                lid = self._guard_lock(minfo, cls, guard)
+                if lid is None:
+                    if "lock" in token:
+                        findings.append((
+                            minfo.relpath, gline, name,
+                            f"guarded-by: {guard} names no known lock "
+                            f"of {cls or minfo.module} — annotation "
+                            "typo disables enforcement", minfo))
+                    continue
+                if kind == "attr":
+                    guarded_cls.setdefault(cls, {})[name] = lid
+                else:
+                    guarded_mod[name] = lid
+            for cls, attrs in guarded_cls.items():
+                findings.extend(
+                    self._check_class(minfo, cls, attrs))
+            if guarded_mod:
+                findings.extend(
+                    self._check_module_state(minfo, guarded_mod))
+        self._findings = findings
+        return findings
+
+    def _thread_entry_quals(self, minfo: _ModInfo) -> Set[str]:
+        out = set()
+        for t in minfo.threads:
+            key = self.prog.resolve_thread_target(minfo, t)
+            if key and key[0] == minfo.module:
+                out.add(key[1])
+        return out
+
+    def _check_class(self, minfo: _ModInfo, cls: str,
+                     attrs: Dict[str, str]):
+        prog = self.prog
+        thread_entries = self._thread_entry_quals(minfo)
+        methods = {q: fi for q, fi in minfo.fns.items()
+                   if q.split(".")[0] == cls}
+        entries: List[Tuple[str, frozenset]] = []
+        for q, fi in methods.items():
+            leaf = q.split(".")[-1]
+            if q.count(".") == 1 and leaf in ("__init__", "__del__",
+                                              "__new__"):
+                continue  # construction happens-before publication
+            public = not leaf.startswith("_") or (
+                leaf.startswith("__") and leaf.endswith("__"))
+            if (public and q.count(".") == 1) or q in thread_entries:
+                entries.append((q, frozenset()))
+        out = []
+        seen_sites: Set[Tuple[str, int]] = set()
+        visited: Set[Tuple[str, frozenset]] = set()
+        work = list(entries)
+        while work:
+            q, entry_held = work.pop()
+            if (q, entry_held) in visited:
+                continue
+            visited.add((q, entry_held))
+            fi = methods.get(q)
+            if fi is None:
+                continue
+            for events, what in ((fi.writes, "write"),
+                                 (fi.reads, "iteration/snapshot read")):
+                for (kind, name), held, line in events:
+                    if kind != "attr" or name not in attrs:
+                        continue
+                    lid = attrs[name]
+                    if lid in entry_held or lid in held:
+                        continue
+                    if (q, line) in seen_sites:
+                        continue
+                    seen_sites.add((q, line))
+                    out.append((
+                        minfo.relpath, line, q,
+                        f"{what} of self.{name} (guarded-by "
+                        f"{lid.rsplit('.', 1)[-1]}) without the lock "
+                        "held", minfo))
+            for spec, held, _line in fi.calls:
+                callee = prog.resolve_call(fi, spec)
+                if callee and callee[0] == minfo.module and \
+                        callee[1] in methods:
+                    work.append((callee[1],
+                                 entry_held | frozenset(held)))
+        return out
+
+    def _check_module_state(self, minfo: _ModInfo,
+                            guarded: Dict[str, str]):
+        out = []
+        for q, fi in minfo.fns.items():
+            for events, what in ((fi.writes, "write"),
+                                 (fi.reads, "iteration/snapshot read")):
+                for (kind, name), held, line in events:
+                    if kind != "mod" or name not in guarded:
+                        continue
+                    if guarded[name] in held:
+                        continue
+                    out.append((
+                        minfo.relpath, line, q,
+                        f"{what} of module state {name} (guarded-by "
+                        f"{guarded[name].rsplit('.', 1)[-1]}) without "
+                        "the lock held", minfo))
+        return out
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if self._findings is None:
+            self._analyze()
+        for relpath, line, symbol, msg, _minfo in self._findings:
+            if relpath == ctx.relpath:
+                yield Finding(self.id, ctx.relpath, line, 0, symbol,
+                              msg, ctx.snippet(line))
+
+
+# =============================================================== R008
+class R008ThreadLifecycle:
+    """Thread/lock lifecycle discipline.
+
+    a. a non-daemon ``threading.Thread``/``Timer`` that is never
+       ``join()``ed anywhere in its module (and never flipped to
+       daemon) leaks: process shutdown hangs on it, test workers
+       accumulate it;
+    b. ``lock.acquire()`` outside ``with`` and outside a try/finally
+       that releases — an exception between acquire and release then
+       wedges every other thread forever.
+    """
+
+    id = "R008"
+    title = "thread lifecycle"
+
+    def __init__(self, prog: _RaceProgram):
+        self.prog = prog
+
+    def collect(self, ctx: ModuleContext) -> None:
+        self.prog.collect(ctx)
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        minfo = self.prog.mods.get(ctx.module)
+        if minfo is None:
+            return
+        for t in minfo.threads:
+            if t.daemon:
+                continue
+            if t.stored and (t.stored in minfo.daemon_sets):
+                continue
+            if t.stored and (t.stored in minfo.join_tokens):
+                continue
+            where = "" if t.stored is None else \
+                f" (stored as {t.stored[1]})"
+            yield Finding(
+                self.id, ctx.relpath, t.line, 0,
+                ctx.symbol_at(t.line),
+                f"non-daemon thread{where} with no reachable join() "
+                "in this module — pass daemon=True or join it on "
+                "shutdown", ctx.snippet(t.line))
+        yield from self._check_acquire_discipline(ctx)
+
+    def _check_acquire_discipline(self, ctx: ModuleContext
+                                  ) -> Iterable[Finding]:
+        for iv in ctx.functions:
+            body_stmts = list(ast.walk(iv.node))
+            for node in body_stmts:
+                blocks = [getattr(node, "body", None),
+                          getattr(node, "orelse", None),
+                          getattr(node, "finalbody", None)]
+                for h in getattr(node, "handlers", []):
+                    blocks.append(h.body)
+                for block in blocks:
+                    if not isinstance(block, list):
+                        continue
+                    for i, st in enumerate(block):
+                        call = self._acquire_call(st)
+                        if call is None:
+                            continue
+                        if self._released_after(block, i, call):
+                            continue
+                        line = st.lineno
+                        yield Finding(
+                            self.id, ctx.relpath, line, 0,
+                            ctx.symbol_at(line),
+                            "acquire() without with/try-finally — an "
+                            "exception before release() deadlocks "
+                            "every other taker",
+                            ctx.snippet(line))
+
+    @staticmethod
+    def _acquire_call(st: ast.stmt) -> Optional[Tuple[str, ...]]:
+        value = getattr(st, "value", None)
+        if not (isinstance(st, (ast.Expr, ast.Assign)) and
+                isinstance(value, ast.Call) and
+                isinstance(value.func, ast.Attribute) and
+                value.func.attr == "acquire"):
+            return None
+        chain = _attr_chain(value.func.value)
+        if chain is None or "lock" not in chain[-1].lower():
+            return None
+        return tuple(chain)
+
+    @staticmethod
+    def _released_after(block: List[ast.stmt], i: int,
+                        chain: Tuple[str, ...]) -> bool:
+        """True when the statement after the acquire is a Try whose
+        finally releases the same lock."""
+        if i + 1 >= len(block):
+            return False
+        nxt = block[i + 1]
+        if not isinstance(nxt, ast.Try):
+            return False
+        for st in nxt.finalbody:
+            value = getattr(st, "value", None)
+            if isinstance(st, ast.Expr) and \
+                    isinstance(value, ast.Call) and \
+                    isinstance(value.func, ast.Attribute) and \
+                    value.func.attr == "release" and \
+                    _attr_chain(value.func.value) == list(chain):
+                return True
+        return False
+
+
+# =============================================================== R009
+class R009Determinism:
+    """Determinism hazards on device-feeding paths.
+
+    a. iteration over a ``set``/``frozenset`` (literal, comprehension,
+       ``set(...)`` call, or a local assigned one) feeding an
+       order-sensitive consumer — a ``for`` loop, a comprehension,
+       ``list``/``tuple``/``enumerate``/``join`` — without ``sorted``:
+       hash order varies across processes (PYTHONHASHSEED), so
+       anything downstream of it stops being byte-reproducible;
+    b. ``np.argsort`` without ``kind="stable"``/``"mergesort"``: tie
+       order then depends on introsort internals — pinned only per
+       numpy build, not by contract;
+    c. float accumulation over an unordered collection (``sum`` over a
+       set): float addition does not commute in rounding, so the total
+       depends on hash order.
+    """
+
+    id = "R009"
+    title = "determinism hazard"
+
+    def collect(self, ctx: ModuleContext) -> None:
+        pass
+
+    @staticmethod
+    def _in_scope(relpath: str) -> bool:
+        return relpath.startswith(_R009_PREFIXES) or \
+            relpath in _R009_FILES
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        if not self._in_scope(ctx.relpath):
+            return
+        np_names = ctx.np_names
+        for iv in ctx.functions:
+            yield from self._check_fn(ctx, iv.node, np_names)
+        yield from self._check_fn(ctx, ctx.tree, np_names,
+                                  module_level=True)
+
+    def _check_fn(self, ctx: ModuleContext, fn_node: ast.AST,
+                  np_names: Set[str], module_level: bool = False
+                  ) -> Iterable[Finding]:
+        set_vars: Set[str] = set()
+        stack = list(ast.iter_child_nodes(fn_node))
+        nodes = []
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue  # their own scan
+            nodes.append(n)
+            stack.extend(ast.iter_child_nodes(n))
+        nodes.sort(key=lambda n: (getattr(n, "lineno", 0),
+                                  getattr(n, "col_offset", 0)))
+        for n in nodes:
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                if self._is_set_expr(n.value, set_vars):
+                    set_vars.add(n.targets[0].id)
+                elif n.targets[0].id in set_vars:
+                    set_vars.discard(n.targets[0].id)
+        for n in nodes:
+            if isinstance(n, ast.For) and \
+                    self._is_set_expr(n.iter, set_vars):
+                yield _mk(ctx, self.id, n.iter,
+                          "iteration over a set: hash order varies "
+                          "across processes — wrap in sorted()")
+            elif isinstance(n, (ast.GeneratorExp, ast.ListComp,
+                                ast.DictComp)):
+                # SetComp is exempt: unordered in, unordered out —
+                # hash order cannot leak through it
+                for gen in n.generators:
+                    if self._is_set_expr(gen.iter, set_vars):
+                        yield _mk(
+                            ctx, self.id, gen.iter,
+                            "comprehension over a set: hash order "
+                            "varies across processes — wrap in "
+                            "sorted()")
+            elif isinstance(n, ast.Call):
+                yield from self._check_call(ctx, n, set_vars, np_names)
+
+    def _check_call(self, ctx: ModuleContext, n: ast.Call,
+                    set_vars: Set[str], np_names: Set[str]
+                    ) -> Iterable[Finding]:
+        func = n.func
+        if isinstance(func, ast.Name) and n.args and \
+                self._is_set_expr(n.args[0], set_vars):
+            if func.id == "sum":
+                yield _mk(ctx, self.id, n,
+                          "float accumulation over an unordered set: "
+                          "addition order follows hash order — sort "
+                          "first (or use math.fsum over sorted())")
+            elif func.id in ("list", "tuple", "iter", "enumerate",
+                             "reversed"):
+                yield _mk(ctx, self.id, n,
+                          f"{func.id}() over a set feeds hash order "
+                          "downstream — wrap in sorted()")
+        if isinstance(func, ast.Attribute) and func.attr == "join" \
+                and n.args and self._is_set_expr(n.args[0], set_vars):
+            yield _mk(ctx, self.id, n,
+                      "join() over a set concatenates in hash order "
+                      "— wrap in sorted()")
+        if isinstance(func, ast.Attribute) and \
+                func.attr == "argsort" and \
+                isinstance(func.value, ast.Name) and \
+                func.value.id in np_names:
+            kind = next((kw.value for kw in n.keywords
+                         if kw.arg == "kind"), None)
+            stable = isinstance(kind, ast.Constant) and \
+                kind.value in ("stable", "mergesort")
+            if not stable:
+                yield _mk(ctx, self.id, n,
+                          "np.argsort without kind='stable': tie "
+                          "order depends on introsort internals — "
+                          "pinned per numpy build, not by contract")
+
+    @staticmethod
+    def _is_set_expr(e: ast.AST, set_vars: Set[str]) -> bool:
+        if isinstance(e, (ast.Set, ast.SetComp)):
+            return True
+        if isinstance(e, ast.Name):
+            return e.id in set_vars
+        if isinstance(e, ast.Call) and isinstance(e.func, ast.Name):
+            return e.func.id in ("set", "frozenset")
+        if isinstance(e, ast.BinOp) and isinstance(
+                e.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+            return R009Determinism._is_set_expr(e.left, set_vars) or \
+                R009Determinism._is_set_expr(e.right, set_vars)
+        return False
+
+
+# =============================================================== R010
+class R010SyncUnderLock:
+    """Blocking work inside a held-lock region.
+
+    Inside a lexical ``with <known-lock>:`` body, flag the operations
+    that can stall every other taker of the lock (the swap-lock stall
+    class): R001-class host syncs (``.item()``, ``jax.device_get``,
+    ``.block_until_ready()``), ``time.sleep``, thread ``join()``,
+    event ``wait()``, and blocking queue ``get()``/``put()`` (the
+    ``_nowait`` variants are exempt).  Device work reached through a
+    call is intentionally out of scope — build-then-swap under the
+    swap lock is the design — so the rule stays lexical and
+    high-precision."""
+
+    id = "R010"
+    title = "blocking call under lock"
+
+    def __init__(self, prog: _RaceProgram):
+        self.prog = prog
+
+    def collect(self, ctx: ModuleContext) -> None:
+        self.prog.collect(ctx)
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        minfo = self.prog.mods.get(ctx.module)
+        if minfo is None:
+            return
+        for fi in minfo.fns.values():
+            for node, held in fi.under_lock:
+                msg = self._classify(ctx, minfo, fi, node)
+                if msg:
+                    yield Finding(
+                        self.id, ctx.relpath, node.lineno,
+                        node.col_offset, fi.qualname,
+                        f"{msg} while holding "
+                        f"{', '.join(h.rsplit('.', 1)[-1] for h in held)}"
+                        " — every other taker stalls behind it",
+                        ctx.snippet(node.lineno))
+
+    def _classify(self, ctx: ModuleContext, minfo: _ModInfo,
+                  fi: _FnInfo, node: ast.Call) -> Optional[str]:
+        func = node.func
+        jaxish = ctx.is_jaxish_callee(func)
+        if jaxish in ("jax.device_get", "jax.block_until_ready"):
+            return f"host sync {jaxish}()"
+        if not isinstance(func, ast.Attribute):
+            return self._time_sleep(ctx, func)
+        attr = func.attr
+        if attr == "item" and not node.args:
+            return "host sync .item()"
+        if attr == "block_until_ready":
+            return "host sync .block_until_ready()"
+        if attr == "sleep":
+            return self._time_sleep(ctx, func)
+        tok = _state_token(func.value)
+        if attr == "join" and tok and (
+                tok in minfo.thread_tokens or
+                "thread" in tok[1].lower()):
+            return "thread join()"
+        if attr == "wait" and tok and self._is_event(minfo, fi, tok):
+            return "event wait()"
+        if attr in ("get", "put") and tok and self._queueish(tok[1]):
+            if any(kw.arg == "block" and
+                   isinstance(kw.value, ast.Constant) and
+                   kw.value.value is False for kw in node.keywords):
+                return None
+            return f"blocking queue {attr}()"
+        return None
+
+    @staticmethod
+    def _time_sleep(ctx: ModuleContext, func: ast.AST
+                    ) -> Optional[str]:
+        name = dotted_name(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if len(parts) == 2 and parts[1] == "sleep" and \
+                ctx.module_aliases.get(parts[0]) == "time":
+            return "time.sleep()"
+        if len(parts) == 1 and \
+                ctx.from_imports.get(parts[0]) == ("time", "sleep"):
+            return "time.sleep()"
+        return None
+
+    @staticmethod
+    def _is_event(minfo: _ModInfo, fi: _FnInfo,
+                  tok: Tuple[str, str]) -> bool:
+        kind, name = tok
+        if kind == "attr" and fi.cls:
+            return ("attr", fi.cls, name) in minfo.events
+        return ("mod", "", name) in minfo.events
+
+    @staticmethod
+    def _queueish(name: str) -> bool:
+        n = name.lower()
+        return n == "q" or n.endswith("_q") or "queue" in n
+
+
+RACE_RULES = (R006LockOrder, R007GuardedBy, R008ThreadLifecycle,
+              R009Determinism, R010SyncUnderLock)
+
+
+def race_rules():
+    """Fresh rule instances sharing one whole-program model."""
+    prog = _RaceProgram()
+    return [R006LockOrder(prog), R007GuardedBy(prog),
+            R008ThreadLifecycle(prog), R009Determinism(),
+            R010SyncUnderLock(prog)]

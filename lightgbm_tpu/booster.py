@@ -339,6 +339,15 @@ class Booster:
             enable_runtime_checks(True)
             log.warning("debug_contracts=true: runtime shape/dtype "
                         "contract checks enabled for this process")
+        if self.config.debug_locks:
+            # runtime half of graft-race R006: every make_lock lock
+            # feeds the process-global acquisition-order witness; an
+            # inverted order raises LockOrderError with both stacks.
+            # Sticky process-global switch, like debug_contracts
+            from .analysis import enable_lock_witness
+            enable_lock_witness(True)
+            log.warning("debug_locks=true: lock-order witness armed "
+                        "for this process")
         train_set.params = {**(train_set.params or {}), **{
             k: v for k, v in self.params.items()
             if k in ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",

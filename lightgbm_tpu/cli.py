@@ -272,8 +272,8 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu telemetry-report <events.jsonl>\n"
               "       python -m lightgbm_tpu telemetry diff <A.json> <B.json>"
               " [--warn-timings]\n"
-              "       python -m lightgbm_tpu lint [--format json|text]"
-              " [--update-baseline]\n"
+              "       python -m lightgbm_tpu lint [--race]"
+              " [--format json|text] [--update-baseline]\n"
               "       python -m lightgbm_tpu serve model=<model_file>"
               " [serve_port=...] [serve_trace=...]\n"
               "       python -m lightgbm_tpu fleet model=<model_file>"

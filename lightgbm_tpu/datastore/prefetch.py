@@ -24,6 +24,12 @@ from typing import Callable, List, Optional, Tuple
 import numpy as np
 
 try:
+    from ..analysis import make_lock
+except ImportError:  # file-path load in a jax-free synthetic package
+    def make_lock(name):
+        return threading.Lock()
+
+try:
     from ..utils.log import LightGBMError
 except ImportError:  # file-path load in a jax-free synthetic package
     class LightGBMError(RuntimeError):
@@ -108,10 +114,12 @@ class ShardPrefetcher:
         self._on_hit = on_hit or (lambda: None)
         self._on_stall = on_stall or (lambda: None)
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        # single-writer (producer thread) then read after join; the
+        # happens-before is the queue sentinel, not a lock
         self._err: Optional[BaseException] = None
-        self._resident = 0
-        self.peak_resident_bytes = 0
-        self._lock = threading.Lock()
+        self._resident = 0            # guarded-by: _lock
+        self.peak_resident_bytes = 0  # guarded-by: _lock
+        self._lock = make_lock("datastore.prefetch._lock")
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._produce, daemon=True,
                                         name="lgbm-tpu-datastore-prefetch")

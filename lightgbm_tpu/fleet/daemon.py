@@ -91,6 +91,11 @@ class TrainerDaemon:
                  params=None):
         self._config = params if isinstance(params, Config) \
             else Config(dict(params or {}))
+        if self._config.debug_locks:
+            # runtime half of graft-race R006 — see booster.py for the
+            # matching training-side switch; sticky process-global
+            from ..analysis import enable_lock_witness
+            enable_lock_witness(True)
         self.store_dir = store_dir
         self.registry = registry
         self.name = name
@@ -123,6 +128,9 @@ class TrainerDaemon:
         #: count (the pre-resilience behaviour); `_recover` replaces it
         #: with the crash-persisted mark so rows appended before a crash
         #: but never trained through still count toward the next retrain
+        # the poll loop is the only writer of the counters below after
+        # construction (guarded-by: single-writer — the daemon thread);
+        # status() reads them lock-free, accepting one-poll staleness
         self.trained_rows = store.n_rows
         self.generation = store.generation
         self.retrains = 0

@@ -41,6 +41,7 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from .. import telemetry
+from ..analysis import make_lock
 from ..utils.config import Config
 
 #: quantile-edge count for the self-fit fallback (no BinMapper s)
@@ -106,15 +107,15 @@ class DriftMonitor:
         self.capacity = max(int(cfg.serve_drift_ring), 1)
         self.min_rows = max(int(cfg.serve_drift_min_rows), 1)
         self.top_k = max(int(cfg.serve_drift_top_k), 1)
-        self._lock = threading.Lock()
+        self._lock = make_lock("fleet.drift._lock")
         self._rows: collections.deque = collections.deque(
-            maxlen=self.capacity)
-        self._width: Optional[int] = None
-        self._seen = 0
-        self._computed_at = 0      # rows seen at last compute
-        self._fallback_edges: Optional[List[np.ndarray]] = None
-        self._mappers = None
-        self._expected: Optional[List[np.ndarray]] = None
+            maxlen=self.capacity)             # guarded-by: _lock
+        self._width: Optional[int] = None     # guarded-by: _lock
+        self._seen = 0                        # guarded-by: _lock
+        self._computed_at = 0                 # guarded-by: _lock
+        self._fallback_edges: Optional[List[np.ndarray]] = None  # guarded-by: _lock
+        self._mappers = None                  # guarded-by: _lock
+        self._expected: Optional[List[np.ndarray]] = None  # guarded-by: _lock
         self.rebind(booster)
 
     # ------------------------------------------------------ sampler hook
@@ -159,28 +160,35 @@ class DriftMonitor:
             # no mappers: keep whatever baseline exists (possibly none)
 
     # --------------------------------------------------------- binning
-    def _bin_window(self, X: np.ndarray) -> List[np.ndarray]:
-        """Per-feature bucket-count vectors for a sampled window."""
+    @staticmethod
+    def _bin_window(X: np.ndarray, mappers, edges):
+        """Per-feature bucket-count vectors for a sampled window.
+
+        Pure function of its snapshot arguments — `compute` snapshots
+        `_mappers`/`_fallback_edges` under the lock and commits any
+        newly-fitted fallback edges back under the lock (a concurrent
+        `rebind` must not see a self-fit baseline resurrect over the
+        mappers it just installed).  Returns ``(counts, edges)`` with
+        the edges actually used (None while mappers bin)."""
         counts = []
-        if self._mappers and len(self._mappers) >= X.shape[1]:
+        if mappers and len(mappers) >= X.shape[1]:
             for j in range(X.shape[1]):
-                m = self._mappers[j]
+                m = mappers[j]
                 codes = m.values_to_bins(X[:, j])
                 counts.append(np.bincount(codes.astype(np.int64),
                                           minlength=m.num_bin))
-            return counts
+            return counts, None
         # self-fit fallback: equal-frequency edges from the first window
-        if self._fallback_edges is None:
-            self._fallback_edges = [
+        if edges is None:
+            edges = [
                 np.unique(np.quantile(
                     X[:, j], np.linspace(0, 1, FALLBACK_BINS + 1)[1:-1]))
                 for j in range(X.shape[1])]
         for j in range(X.shape[1]):
-            codes = np.searchsorted(self._fallback_edges[j], X[:, j],
-                                    side="left")
+            codes = np.searchsorted(edges[j], X[:, j], side="left")
             counts.append(np.bincount(
-                codes, minlength=len(self._fallback_edges[j]) + 1))
-        return counts
+                codes, minlength=len(edges[j]) + 1))
+        return counts, edges
 
     # --------------------------------------------------------- compute
     def compute(self) -> Optional[Dict[str, Any]]:
@@ -195,8 +203,15 @@ class DriftMonitor:
             X = np.stack(list(self._rows))
             self._computed_at = self._seen
             seen = self._seen
-        actual = self._bin_window(X)
+            mappers = self._mappers
+            edges = self._fallback_edges
+        # the heavy binning runs OUTSIDE the lock on the snapshots —
+        # the sampler hook must never wait on a window being scored
+        actual, used_edges = self._bin_window(X, mappers, edges)
         with self._lock:
+            if used_edges is not None and self._mappers is None \
+                    and self._fallback_edges is None:
+                self._fallback_edges = used_edges
             if self._expected is None:
                 # baseline window (file-loaded booster): later windows
                 # score against the traffic observed at attach time

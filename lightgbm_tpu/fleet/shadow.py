@@ -39,6 +39,7 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from .. import telemetry
+from ..analysis import make_lock
 from ..utils.config import Config
 
 
@@ -56,10 +57,10 @@ class TrafficSampler:
 
     def __init__(self, capacity: int = 256):
         self.capacity = max(int(capacity), 1)
-        self._lock = threading.Lock()
-        self._rows: list = []
-        self._seen = 0
-        self._width: Optional[int] = None
+        self._lock = make_lock("fleet.shadow._lock")
+        self._rows: list = []               # guarded-by: _lock
+        self._seen = 0                      # guarded-by: _lock
+        self._width: Optional[int] = None   # guarded-by: _lock
 
     def __call__(self, X) -> None:
         X = np.asarray(X, dtype=np.float64)

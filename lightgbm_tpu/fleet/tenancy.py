@@ -40,6 +40,7 @@ import time
 from typing import Dict, List, Optional, Union
 
 from .. import telemetry
+from ..analysis import make_lock
 from ..serving.batcher import ServingOverloadError
 from ..serving.registry import ModelRegistry, ServingModel
 from ..utils.config import Config
@@ -141,8 +142,8 @@ class TenantRegistry:
         self.registry = registry if registry is not None \
             else ModelRegistry(dict(params or {}))
         self._owns_registry = registry is None
-        self._lock = threading.Lock()
-        self._tenants: Dict[str, Tenant] = {}
+        self._lock = make_lock("fleet.tenancy._lock")
+        self._tenants: Dict[str, Tenant] = {}  # guarded-by: _lock
         self.classes = parse_slo_classes(self._config.fleet_slo_classes)
 
     # ---------------------------------------------------------- lifecycle
@@ -182,10 +183,11 @@ class TenantRegistry:
     def tenant(self, name: str) -> Tenant:
         with self._lock:
             t = self._tenants.get(name)
+            known = sorted(self._tenants)
         if t is None:
             raise LightGBMError(
                 f"no tenant {name!r} "
-                f"(registered: {', '.join(sorted(self._tenants)) or 'none'})")
+                f"(registered: {', '.join(known) or 'none'})")
         return t
 
     def names(self) -> List[str]:

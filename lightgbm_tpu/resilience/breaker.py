@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Callable
 
+from ..analysis import make_lock
+
 CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
@@ -49,11 +51,13 @@ class CircuitBreaker:
         self.backoff_s = max(float(backoff_s), 0.0)
         self.backoff_max_s = max(float(backoff_max_s), self.backoff_s)
         self._clock = clock
-        self._lock = threading.Lock()
-        self._state = CLOSED
-        self._cur_backoff = self.backoff_s
-        self._retry_at = 0.0
-        self.failures = 0
+        self._lock = make_lock("resilience.breaker._lock")
+        # writes only under _lock (via _to); the lock-free `state` read
+        # path is the documented single-field staleness trade
+        self._state = CLOSED                 # guarded-by: _lock
+        self._cur_backoff = self.backoff_s   # guarded-by: _lock
+        self._retry_at = 0.0                 # guarded-by: _lock
+        self.failures = 0                    # guarded-by: _lock
 
     # ------------------------------------------------------------ reads
     @property

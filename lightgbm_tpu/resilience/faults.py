@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from ..analysis import make_lock
+
 ENV_VAR = "LGBM_FAULTS"
 
 #: default hang horizon — long enough to be "forever" for any watchdog,
@@ -128,13 +130,16 @@ class FaultPlane:
     """
 
     def __init__(self, env: Optional[str] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("resilience.faults._lock")
+        # written under _lock as an immutable-snapshot tuple swap; the
+        # disarmed fast path reads it lock-free by design, so the
+        # attribute is deliberately NOT annotated for R007
         self._specs: tuple = ()
         self._release = threading.Event()
-        self._rng = random.Random(0)
+        self._rng = random.Random(0)  # guarded-by: _lock
         #: per-(site, mode) trigger counts, for assertions and the
         #: telemetry bridge at the integration layers
-        self.fired: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}  # guarded-by: _lock
         spec = os.environ.get(ENV_VAR, "") if env is None else env
         if spec:
             self.arm(spec)
@@ -229,7 +234,9 @@ class FaultPlane:
 
     def fired_at(self, site_prefix: str) -> int:
         """Total triggers whose site starts with ``site_prefix``."""
-        return sum(v for k, v in self.fired.items()
+        with self._lock:
+            fired = dict(self.fired)
+        return sum(v for k, v in fired.items()
                    if k.startswith(site_prefix))
 
 

@@ -26,6 +26,8 @@ import queue
 import threading
 from typing import Any, Callable, Optional
 
+from ..analysis import make_lock
+
 try:
     from ..utils.log import LightGBMError
 except ImportError:  # file-path load in a jax-free synthetic package
@@ -77,9 +79,9 @@ class Supervisor:
     def __init__(self, site: str, timeout_ms: float = 0.0):
         self.site = site
         self.timeout_s = max(float(timeout_ms), 0.0) / 1000.0
-        self._lock = threading.Lock()
-        self._q: Optional[queue.Queue] = None
-        self._thread: Optional[threading.Thread] = None
+        self._lock = make_lock("resilience.supervise._lock")
+        self._q: Optional[queue.Queue] = None  # guarded-by: _lock
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
 
     @property
     def enabled(self) -> bool:

@@ -93,8 +93,10 @@ class MicroBatcher:
         # intermediate).  Only the single worker thread touches it, and
         # the runtime consumes the batch synchronously inside
         # `predict`, so reuse across flushes is race-free.
-        self._stage: dict = {}
-        self._closed = False
+        self._stage: dict = {}  # guarded-by: worker-thread
+        # request handoff is the queue itself; per-request results ride
+        # each _Request's own done-Event (happens-before via Event.set)
+        self._closed = False    # guarded-by: single-writer
         self._worker = threading.Thread(
             target=self._guard, name=f"lgbm-serve-{runtime.name}",
             daemon=True)

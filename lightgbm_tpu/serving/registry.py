@@ -33,7 +33,9 @@ import time
 from typing import Dict, List, Optional, Union
 
 from .. import telemetry
+from ..analysis import enable_lock_witness, make_lock
 from ..resilience import FAULTS
+from ..utils import log
 from ..utils.config import Config
 from ..utils.log import LightGBMError
 from .batcher import MicroBatcher, ServingClosedError
@@ -57,8 +59,8 @@ class ServingModel:
         self.batcher = batcher
         self.auto_refresh = auto_refresh
         self.last_used = time.monotonic()
-        self._refresh_kick = threading.Lock()
-        self._refresh_thread: Optional[threading.Thread] = None
+        self._refresh_kick = make_lock("serving.registry._refresh_kick")
+        self._refresh_thread: Optional[threading.Thread] = None  # guarded-by: _refresh_kick
 
     def predict(self, X, raw_score: bool = False,
                 timeout: Optional[float] = None,
@@ -121,17 +123,23 @@ class ModelRegistry:
 
     def __init__(self, params: Optional[dict] = None):
         self._config = Config(dict(params or {}))
-        self._lock = threading.Lock()
+        self._lock = make_lock("serving.registry._lock")
         # serializes the budget decision (_admit) WITH the swap it
         # admits: a demotion decided from a pre-swap LRU snapshot could
         # otherwise demote the entry a concurrent load() just made live
-        self._swap_lock = threading.Lock()
-        self._models: Dict[str, ServingModel] = {}
+        self._swap_lock = make_lock("serving.registry._swap_lock")
+        self._models: Dict[str, ServingModel] = {}  # guarded-by: _lock
         # per-model traffic sampler hooks (fleet/shadow.py TrafficSampler
         # and fleet/drift.py DriftMonitor attach here): each is called
         # with every request's row block, outside the serving data path
         # — sampling never touches the bytes served
-        self._samplers: Dict[str, List[object]] = {}
+        self._samplers: Dict[str, List[object]] = {}  # guarded-by: _lock
+        if self._config.debug_locks:
+            # runtime half of graft-race R006 — see booster.py for the
+            # matching training-side switch; sticky process-global
+            enable_lock_witness(True)
+            log.warning("debug_locks=true: lock-order witness armed "
+                        "for this process")
         cfg = self._config
         telemetry.SERVE_RECORDER.configure(
             enabled=cfg.serve_trace, capacity=cfg.serve_trace_ring,

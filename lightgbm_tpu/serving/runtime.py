@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from ..analysis import make_lock
 from ..compiler import PlanNotCompilable, build_plan
 from ..compiler.kernel import ROW_BLOCK, compiled_predict
 from ..ops.predict import predict_leaf_ensemble, predict_raw_ensemble_exact
@@ -178,16 +179,16 @@ class ServingRuntime:
                                  backoff_s=breaker_backoff_s,
                                  backoff_max_s=breaker_backoff_max_s)
             for rung in ("compiled", "device_sum", "slot_path")}
-        self._reprobe_lock = threading.Lock()
-        self._reprobe_threads: Dict[str, threading.Thread] = {}
+        self._reprobe_lock = make_lock("serving.runtime._reprobe_lock")
+        self._reprobe_threads: Dict[str, threading.Thread] = {}  # guarded-by: _reprobe_lock
         #: pin every device array (export planes + staged inputs) to one
         #: device — the sharded serving plane builds one pinned runtime
         #: per mesh device (serving/sharded.py).  None = default device,
         #: the pre-existing behavior.
         self.device = device
-        self._refresh_lock = threading.Lock()
-        self._staging_lock = threading.Lock()
-        self._staging: Dict = {}
+        self._refresh_lock = make_lock("serving.runtime._refresh_lock")
+        self._staging_lock = make_lock("serving.runtime._staging_lock")
+        self._staging: Dict = {}  # guarded-by: _staging_lock
         self.refresh()
 
     # ------------------------------------------------------------ export

@@ -42,6 +42,7 @@ import numpy as np
 import jax
 
 from .. import telemetry
+from ..analysis import make_lock
 from ..utils.log import LightGBMError
 from .runtime import DEFAULT_MAX_BATCH_ROWS, ServingRuntime
 
@@ -112,9 +113,9 @@ class ShardedServingRuntime:
                            breaker_backoff_s=breaker_backoff_s,
                            breaker_backoff_max_s=breaker_backoff_max_s)
             for i, dev in enumerate(self.devices)]
-        self._sched_lock = threading.Lock()
-        self._outstanding = [0] * len(self._replicas)   # rows in flight
-        self._routed = [0] * len(self._replicas)        # rows, cumulative
+        self._sched_lock = make_lock("serving.sharded._sched_lock")
+        self._outstanding = [0] * len(self._replicas)  # rows in flight; guarded-by: _sched_lock
+        self._routed = [0] * len(self._replicas)  # rows, cumulative; guarded-by: _sched_lock
         telemetry.REGISTRY.gauge("serve.replicas").set(
             len(self._replicas))
         self._set_balance_gauges()
@@ -199,7 +200,8 @@ class ShardedServingRuntime:
         return assign
 
     def _set_balance_gauges(self) -> None:
-        routed = list(self._routed)
+        with self._sched_lock:
+            routed = list(self._routed)
         total = sum(routed)
         mean = total / max(len(routed), 1)
         imb = (max(routed) / mean) if mean > 0 else 1.0

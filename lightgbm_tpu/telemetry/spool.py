@@ -44,6 +44,7 @@ import os
 import re
 import socket
 import sys
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -72,9 +73,17 @@ DEFAULT_SPOOL_DIR = "lgbm_tpu_spool"
 #: Spool directories this process has attached to — `/debug/fleet`
 #: (telemetry/ops.py) aggregates them so a `top` against a serving
 #: process sees the whole fleet's spools, not just its own stream.
-SPOOL_DIRS: List[str] = []
+SPOOL_DIRS: List[str] = []  # guarded-by: _attach_lock
 
-_ATTACHED: Dict[str, "SpoolSink"] = {}
+_ATTACHED: Dict[str, "SpoolSink"] = {}  # guarded-by: _attach_lock
+
+#: serializes attach_spool's check-then-act: a Booster and a serving
+#: daemon attaching the same dir concurrently must share ONE sink, not
+#: stack two headers into two files.  A plain threading.Lock (not
+#: make_lock) because this module stays file-path-loadable with zero
+#: package imports at module scope; it is a leaf lock — nothing else
+#: is ever acquired under it
+_attach_lock = threading.Lock()
 
 
 def _safe(token: str) -> str:
@@ -145,14 +154,15 @@ def attach_spool(spool_dir: str, role: str,
     from .metrics import REGISTRY
     from .spans import TRACER
     key = os.path.abspath(spool_dir or DEFAULT_SPOOL_DIR)
-    sink = _ATTACHED.get(key)
-    if sink is None:
-        sink = SpoolSink(key, role, rank=rank)
-        _ATTACHED[key] = sink
-        TRACER.add_sink(sink)
-        if key not in SPOOL_DIRS:
-            SPOOL_DIRS.append(key)
-        REGISTRY.counter("spool.attached").inc()
+    with _attach_lock:
+        sink = _ATTACHED.get(key)
+        if sink is None:
+            sink = SpoolSink(key, role, rank=rank)
+            _ATTACHED[key] = sink
+            TRACER.add_sink(sink)
+            if key not in SPOOL_DIRS:
+                SPOOL_DIRS.append(key)
+            REGISTRY.counter("spool.attached").inc()
     return sink
 
 

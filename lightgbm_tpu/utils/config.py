@@ -416,6 +416,15 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # at trace time (once per compilation, not per step) but the flag is
     # process-global and sticky — see analysis.enable_runtime_checks
     "debug_contracts": (False, "bool", ()),
+    # debug mode: arm the runtime lock-order witness
+    # (lightgbm_tpu/analysis/lockwitness.py).  Every subsystem lock
+    # created via make_lock records the global acquisition order; the
+    # first acquisition that inverts an already-observed order raises
+    # LockOrderError with both stacks instead of (maybe) deadlocking.
+    # Process-global and sticky, like debug_contracts.  Purely
+    # order-observing: model bytes and serving responses are identical
+    # with it on or off
+    "debug_locks": (False, "bool", ()),
     # telemetry (lightgbm_tpu/telemetry/): JSONL event sink path — spans
     # (dataset bin, compile/warmup, train chunks, eval, predict), point
     # events (probe attempts, fallbacks) and a final metrics snapshot are

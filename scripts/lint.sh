@@ -1,13 +1,25 @@
 #!/bin/bash
-# graft-lint gate — static analysis against the checked-in baseline
-# (docs/STATIC_ANALYSIS.md).  Mirrors scripts/t1.sh: run from anywhere,
-# exit code is the tool's own (0 clean/baselined, 1 new findings).
+# graft-lint + graft-race gate — static analysis against the checked-in
+# baselines (docs/STATIC_ANALYSIS.md).  Mirrors scripts/t1.sh: run from
+# anywhere, exit code is nonzero if EITHER pass finds new findings.
 #
 # The linter is stdlib-only and never initializes a jax backend, but the
 # environment may pre-register a remote TPU PJRT plugin via
 # sitecustomize (gated on PALLAS_AXON_POOL_IPS) whose registration hangs
 # even unrelated python processes at interpreter start — so run with the
 # same cleaned env the test suite uses (utils/env.py cleaned_cpu_env).
+#
+# Extra flags pass through to BOTH passes (e.g. --format json); to
+# update one baseline, call the module directly with --update-baseline.
 cd "$(dirname "$0")/.." || exit 1
-exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m lightgbm_tpu lint "$@"
+lint_rc=$?
+
+env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m lightgbm_tpu lint --race "$@"
+race_rc=$?
+
+[ "$lint_rc" -ne 0 ] && exit "$lint_rc"
+exit "$race_rc"

@@ -8,8 +8,9 @@ cd "$(dirname "$0")/.."
 
 tier="${1:-quick}"
 
-# graft-lint gate first (seconds, no jax backend): new findings beyond
-# lint_baseline.json fail CI before any test burns minutes
+# graft-lint + graft-race gates first (seconds, no jax backend): new
+# findings beyond lint_baseline.json / race_baseline.json fail CI
+# before any test burns minutes
 ./scripts/lint.sh
 
 case "$tier" in
@@ -535,8 +536,13 @@ create_fleet_store(store_dir, X, y, shard_rows=256)
 # sinks — the offline CLI reads this file after the daemon is gone
 telemetry.LEDGER.reset()
 telemetry.TRACER.attach_jsonl(events_path)
+# debug_locks arms the lock-order witness (graft-race runtime half)
+# for the whole smoke: daemon + registry + batcher run with every lock
+# acquisition order-checked, and the byte-identity assertions below
+# double as proof the witness never touches served bytes
 client = ServingClient(bst, params={"serve_warmup": False,
-                                    "serve_max_wait_ms": 0.0})
+                                    "serve_max_wait_ms": 0.0,
+                                    "debug_locks": True})
 daemon = TrainerDaemon(
     store_dir, client.registry, bst,
     train_params={"objective": "binary", "num_leaves": 15,
@@ -707,11 +713,14 @@ want = bst.predict(X)
 # below only ever has to cover real dispatch — a 5 s deadline vs the
 # 1 h hang horizon is unambiguous.  compiled=off makes device_sum the
 # top rung (the one the fault wedges).
+# debug_locks: run the whole chaos scenario (watchdog, breaker,
+# rung demotion/re-probe) under the lock-order witness
 client = ServingClient(bst, params={
     "serve_warmup": True, "serve_compiled": "off",
     "serve_max_wait_ms": 0.0,
     "serve_dispatch_timeout_ms": 5000.0,
-    "serve_breaker_backoff_s": 2.0})
+    "serve_breaker_backoff_s": 2.0,
+    "debug_locks": True})
 rt = client.registry.get("default").runtime
 assert rt.device_sum_active, "device_sum rung must start live"
 srv = make_server(client, "127.0.0.1", 0)

@@ -177,7 +177,8 @@ TPU_RECORD = {"value": 2.956, "auc": 0.8978, "n": 2_000_000,
 def _emit(rounds_per_sec: float, n_rows: int, backend: str,
           partial: bool, auc=None, pred=None, probe=None,
           telemetry=None, flight=None, pipeline=None,
-          serving=None, streaming=None, status=None) -> None:
+          serving=None, streaming=None, memledger=None,
+          status=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -217,6 +218,15 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         line["flight"] = flight
         if isinstance(flight, dict) and flight.get("watermarks"):
             line["memory"] = flight["watermarks"]
+    if memledger is not None:
+        # device-memory ledger roll-up (@memledger line): attributed
+        # per-owner residency, allocator-reconciled unattributed
+        # watermark, leak-sentinel slope and budget-violation counts —
+        # merged into the same `memory` block as the flight watermarks
+        # (copy first: `memory` may alias flight["watermarks"])
+        mem = dict(line.get("memory") or {})
+        mem["ledger"] = memledger
+        line["memory"] = mem
     if pipeline is not None:
         # pipelined-dispatch summary (@pipeline line): configured depth,
         # chunks run, device-idle-gap estimate totals — the `telemetry
@@ -415,6 +425,7 @@ def _run_orchestrator() -> None:
     worker_pipeline = None
     worker_serving = None
     worker_streaming = None
+    worker_memledger = None
     platform = backend_tag
     deadline = time.time() + worker_timeout
     try:
@@ -498,6 +509,15 @@ def _run_orchestrator() -> None:
                             line.split(None, 1)[1])
                     except (ValueError, IndexError):
                         pass
+                elif line.startswith("@memledger "):
+                    # device-memory ledger roll-up (attributed owners,
+                    # unattributed watermark, leak slope) — last wins,
+                    # the exit-time emission has the predict phase too
+                    try:
+                        worker_memledger = json.loads(
+                            line.split(None, 1)[1])
+                    except (ValueError, IndexError):
+                        pass
     finally:
         try:
             proc.kill()
@@ -510,14 +530,16 @@ def _run_orchestrator() -> None:
         _emit(final, n, platform, partial=False, auc=auc, pred=pred,
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
-              serving=worker_serving, streaming=worker_streaming)
+              serving=worker_serving, streaming=worker_streaming,
+              memledger=worker_memledger)
     elif chunks:
         tot_r = sum(c[0] for c in chunks)
         tot_s = sum(c[1] for c in chunks)
         _emit(tot_r / tot_s, n, platform, partial=True, auc=auc, pred=pred,
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
-              serving=worker_serving, streaming=worker_streaming)
+              serving=worker_serving, streaming=worker_streaming,
+              memledger=worker_memledger)
     else:
         # nothing measured — still emit a parseable line (value 0, an
         # explicit machine-readable status) so the round records an
@@ -528,7 +550,7 @@ def _run_orchestrator() -> None:
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
               serving=worker_serving, streaming=worker_streaming,
-              status="no-run")
+              memledger=worker_memledger, status="no-run")
 
 
 # --------------------------------------------------------------------------
@@ -586,6 +608,38 @@ def _run_worker() -> None:
         try:
             fs = bst.flight_summary()
             print("@flight " + json.dumps(fs, separators=(",", ":")),
+                  flush=True)
+        except Exception:
+            pass
+
+    def _stream_memledger():
+        # device-memory ledger roll-up: per-device attributed bytes by
+        # owner, allocator reconciliation (unattributed watermark) and
+        # the leak-sentinel slope — the BENCH JSON `memory` block merges
+        # this next to the flight recorder's phase watermarks
+        try:
+            led = telemetry.MEMLEDGER
+            if not led.enabled:
+                return
+            snap = led.debug_snapshot()
+            blk = {"devices": {
+                dev: {"attributed_mb":
+                          round(d.get("attributed_bytes", 0) / 2**20, 3),
+                      "peak_mb":
+                          round(d.get("peak_bytes", 0) / 2**20, 3),
+                      "owners": {k: round(o["bytes"] / 2**20, 3)
+                                 for k, o in d.get("owners", {}).items()}}
+                for dev, d in snap.get("devices", {}).items()}}
+            rec = snap.get("reconcile") or {}
+            if rec:
+                blk["unattributed_mb"] = round(
+                    rec.get("unattributed_bytes", 0) / 2**20, 3)
+                blk["reconcile_source"] = rec.get("source")
+            blk["leak_slope_mb_per_min"] = round(
+                led.sentinel.slope_mb_per_min(), 4)
+            blk["budget_violations"] = snap.get("budget_violations", {})
+            blk["oom_dumps"] = int(snap.get("oom_dumps", 0))
+            print("@memledger " + json.dumps(blk, separators=(",", ":")),
                   flush=True)
         except Exception:
             pass
@@ -687,6 +741,7 @@ def _run_worker() -> None:
     _stream_telemetry()
     _stream_flight(bst)
     _stream_pipeline()
+    _stream_memledger()
 
     # batch-predict throughput (VERDICT r3 #6: prediction was never
     # measured): device jitted stacked-ensemble path vs the host walk
@@ -1010,6 +1065,8 @@ def _run_worker() -> None:
                        round(stalls / max(hits + stalls, 1), 4),
                    "peak_device_mb":
                        reg.gauge("stream.peak_device_mb").value,
+                   "peak_staging_mb":
+                       reg.gauge("stream.peak_staging_mb").value,
                    "byte_identical":
                        strip(bst_a.model_to_string())
                        == strip(bst_s.model_to_string())}
@@ -1028,6 +1085,7 @@ def _run_worker() -> None:
             _log(f"streaming bench failed: {e}")
     _stream_telemetry()
     _stream_flight(bst)
+    _stream_memledger()
     # self-contained spool entry: the registry snapshot rides the stream
     # as one `metrics` event, so aggregate() can roll this worker into
     # the fleet metrics without the BENCH JSON line

@@ -318,6 +318,12 @@ class Booster:
             # attach-before-_DeviceData ordering, idempotent per dir
             telemetry.attach_spool(self.config.telemetry_spool_dir,
                                    role="trainer")
+        # arm the attributed device-memory ledger BEFORE _DeviceData so
+        # the bin-matrix upload is attributed from the first byte;
+        # ledger on/off never changes trained bytes (tests pin this)
+        telemetry.MEMLEDGER.configure(
+            enabled=bool(self.config.memory_ledger),
+            reconcile_ms=float(self.config.memory_reconcile_ms))
         self._debug_nans = bool(self.config.tpu_debug_nans)
         if self._debug_nans:
             # numeric-sanitizer mode (ref: cmake/Sanitizer.cmake posture):
@@ -1132,6 +1138,10 @@ class Booster:
                 prefetch_depth=cfg.datastore_prefetch,
                 collective_timeout_ms=cfg.mesh_collective_timeout_ms,
                 run_stats=self._dd._pf_stats)
+            # placement registered the per-device buffers under
+            # `datastore.place` — the round-boundary ledger sweep must
+            # not attribute the same bytes again under `train.bins`
+            self._train_bins_attributed = True
         else:
             if self._dd.datastore_pending:
                 log.warning("tree_learner=feature with external_memory "
@@ -1143,6 +1153,7 @@ class Booster:
             self._train_bins = place_training_data(
                 np.asarray(train_src), self._mesh, kind,
                 pad_features=pad_features)
+            self._train_bins_attributed = False
         self._grower = make_distributed_grower(
             self._grower_spec, self._mesh, kind,
             self._dd.num_feature, self._dd.num_data, wave=wave,
@@ -1212,7 +1223,8 @@ class Booster:
             # hit/stall total and one residency watermark per run
             self._stream_engine = StreamingWaveGrower(
                 spec, store, prefetch_depth=depth,
-                run_stats=self._dd._pf_stats)
+                run_stats=self._dd._pf_stats,
+                budget_mb=float(cfg.datastore_budget_mb))
             self._stream_cache_key = key
             log.info(
                 f"streaming_train: shard-streamed training engaged "
@@ -1343,10 +1355,58 @@ class Booster:
                 self._nan_check_ctx():
             out = self._update_impl(train_set, fobj)
         telemetry.REGISTRY.counter("train.rounds").inc()
+        self._ledger_round()
         if self._flight is not None:
             from .telemetry.recorder import sample_memory
             sample_memory("train")
         return out
+
+    def _ledger_round(self) -> None:
+        """Round-boundary memory-ledger sweep: re-attribute the rebound
+        O(N) training state (`assign` replaces the previous round's
+        handles for the same owner), feed the leak sentinel, and emit
+        the per-owner gauges into the event stream.  Host-side nbytes
+        arithmetic only — never a device sync — and a strict no-op with
+        the ledger disabled."""
+        led = telemetry.MEMLEDGER
+        if not led.enabled:
+            return
+        # dataset-resident device arrays: the bin matrix plus the
+        # per-feature metadata / label / weight copies _DeviceData
+        # pinned at construction.  When the bins were streamed straight
+        # from the datastore the per-device buffers are already under
+        # `datastore.place` — only the sidecar arrays go here then.
+        dd = getattr(self, "_dd", None)
+        bins: List[Any] = []
+        if not getattr(self, "_train_bins_attributed", False):
+            bins.append(getattr(self, "_train_bins", None))
+        if dd is not None:
+            bins += [getattr(dd, a, None) for a in
+                     ("_bins_fm", "_bundle_fm", "feat_nb", "feat_missing",
+                      "feat_default", "base_allowed_dev", "is_cat",
+                      "label", "weight")]
+        for v in (getattr(self, "_feat", None) or {}).values():
+            bins.append(v)
+        scores = [getattr(self, "_train_score", None),
+                  getattr(self, "_ones", None),
+                  getattr(self, "_obj_state", None)] \
+            + list(getattr(self, "_valid_scores", []) or []) \
+            + [e[-1] for e in getattr(self, "_last_contribs", []) or []]
+        # identity-dedupe (serial path: `_train_bins` IS `_dd._bins_fm`)
+        # — the same buffer must not be attributed twice
+        seen: set = set()
+
+        def _uniq(arrs):
+            out = []
+            for a in arrs:
+                if getattr(a, "nbytes", None) and id(a) not in seen:
+                    seen.add(id(a))
+                    out.append(a)
+            return out
+
+        led.assign("train.bins", _uniq(bins))
+        led.assign("train.scores", _uniq(scores))
+        led.on_round()
 
     def _update_impl(self, train_set: Optional[Dataset] = None,
                      fobj=None) -> bool:

@@ -284,6 +284,8 @@ def run(argv: List[str]) -> int:
               " [n=8] [--json]\n"
               "       python -m lightgbm_tpu timeline <spool_dir>"
               " [--trace out.json] [--json]\n"
+              "       python -m lightgbm_tpu memory"
+              " [url | spool_dir] [--json]\n"
               "       python -m lightgbm_tpu compile-plan <model_file>"
               " [serve_tile_vmem_kb=...] [--json]",
               file=sys.stderr)
@@ -317,6 +319,11 @@ def run(argv: List[str]) -> int:
         # fleet timeline + optional Chrome-trace export
         from .telemetry.spool import main as timeline_main
         return timeline_main(argv[1:])
+    if argv[0] == "memory":
+        # attributed device-memory report (telemetry/memledger.py):
+        # /debug/memory from a serving process or a spool-dir roll-up
+        from .telemetry.memledger import main as memory_main
+        return memory_main(argv[1:])
     if argv[0] == "telemetry-report":
         # subcommand, not a key=value task — handled before parse_args
         from .telemetry.report import main as report_main

@@ -247,6 +247,13 @@ def _plan_telemetry(plan: CompiledPlan, name: str) -> None:
         plan.n_trees)
     telemetry.REGISTRY.gauge("compile.plan.vmem_bytes", model=name).set(
         plan.total_plane_bytes())
+    # attribute the packed (host) planes in the memory ledger — the
+    # runtime re-registers its device copies under serve.<name>.planes
+    # at refresh, so the two owners never double-count one buffer
+    telemetry.MEMLEDGER.assign(
+        "compile.plan",
+        [a for p in plan.planes for a in p.values()
+         if hasattr(a, "nbytes")], model=name)
     telemetry.event("compile.plan", model=name, tiles=plan.num_tiles(),
                     trees=plan.n_trees, buckets=len(plan.buckets),
                     bytes=plan.total_plane_bytes())

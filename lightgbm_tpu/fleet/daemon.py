@@ -283,6 +283,16 @@ class TrainerDaemon:
         self.generation = store.generation
         if self.drift is not None:
             self.drift.compute()   # off the hot path: poll cadence
+        # memory heartbeat: one watermark observation + robust slope fit
+        # per poll; a sustained positive slope is the leak evidence the
+        # soak run (ROADMAP 5) watches, so it lands in the fleet Ledger
+        if telemetry.MEMLEDGER.enabled:
+            telemetry.MEMLEDGER.on_round()
+            slope = telemetry.MEMLEDGER.sentinel.slope_mb_per_min()
+            if slope > 1.0:
+                telemetry.LEDGER.record(
+                    "memory.leak_suspect", model=self.name,
+                    slope_mb_per_min=round(slope, 3))
         if store.n_rows - self.trained_rows < \
                 int(self._config.fleet_retrain_rows):
             return False

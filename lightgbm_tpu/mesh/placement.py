@@ -44,12 +44,26 @@ def padded_row_count(num_data: int, shards: int) -> int:
 
 def record_placement(placed, prefix: str = "parallel") -> None:
     """Per-device attribution of one mesh-resident array: a
-    ``{prefix}.dev{id}.placed_bytes`` gauge per addressable shard."""
+    ``{prefix}.dev{id}.placed_bytes`` gauge per addressable device.
+
+    Metadata only, on purpose: reading ``shard.data`` materializes a
+    persistent aliasing ``jax.Array`` (cached on the parent), which
+    permanently inflates ``jax.live_arrays()`` and breaks the memory
+    ledger's reconciliation — so the per-device bytes are derived from
+    the sharding instead (replicated = full nbytes per device, sharded
+    = an even split, the same convention memledger uses)."""
     from ..telemetry import REGISTRY
-    for shard in placed.addressable_shards:
-        REGISTRY.gauge(
-            f"{prefix}.dev{shard.device.id}.placed_bytes").set(
-                shard.data.nbytes)
+    sharding = getattr(placed, "sharding", None)
+    devs = sorted(getattr(sharding, "addressable_devices", None)
+                  or placed.devices(), key=lambda d: int(d.id))
+    if not devs:
+        return
+    if getattr(sharding, "is_fully_replicated", True):
+        per = int(placed.nbytes)
+    else:
+        per = int(placed.nbytes) // len(devs)
+    for d in devs:
+        REGISTRY.gauge(f"{prefix}.dev{int(d.id)}.placed_bytes").set(per)
 
 
 class _CollectiveTimer:
@@ -266,7 +280,10 @@ def place_from_datastore(store, mesh: Mesh, kind: str,
                     def _put(host=host, dev=dev):
                         FAULTS.inject("mesh.collective")
                         return jax.device_put(host, dev)
-                    bufs.append(sup.call(_put))
+                    # RESOURCE_EXHAUSTED here dumps the attributed
+                    # snapshot ({"ev":"oom"}) before re-raising
+                    with telemetry.MEMLEDGER.oom_guard("mesh.place"):
+                        bufs.append(sup.call(_put))
         finally:
             pf.close()
             peak = pf.peak_resident_bytes
@@ -279,5 +296,8 @@ def place_from_datastore(store, mesh: Mesh, kind: str,
                 round(peak / (1024.0 * 1024.0), 3))
     placed = jax.make_array_from_single_device_arrays(
         (f_pad, n_pad), NamedSharding(mesh, P(None, axes)), bufs)
+    # `assign`, not `register`: a re-placement replaces the previous
+    # run's attribution for the same owner instead of double-counting
+    telemetry.MEMLEDGER.assign("datastore.place", bufs)
     record_placement(placed)
     return placed

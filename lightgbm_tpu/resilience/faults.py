@@ -73,7 +73,8 @@ class FaultSpec:
                  "fired", "skipped")
 
     def __init__(self, pattern: str, mode: str,
-                 arg: Optional[float] = None,
+                 arg: Any = None,  # float via the grammar; tests may
+                 # pass a str message for error mode programmatically
                  p: float = 1.0, n: int = 0, after: int = 0):
         if mode not in _MODES:
             raise ValueError(f"unknown fault mode {mode!r} "
@@ -111,7 +112,9 @@ class FaultSpec:
 
     def describe(self) -> str:
         out = f"{self.pattern}:{self.mode}"
-        if self.arg is not None:
+        if isinstance(self.arg, str):
+            out += f":{self.arg}"
+        elif self.arg is not None:
             out += f":{self.arg:g}"
         if self.p != 1.0:
             out += f"@p={self.p:g}"
@@ -204,6 +207,12 @@ class FaultPlane:
     def _trigger(self, site: str, spec: FaultSpec, payload: Any,
                  release: threading.Event) -> Any:
         if spec.mode == "error":
+            # a string arg becomes the message verbatim (programmatic
+            # FaultSpec only — the grammar parses args as floats): tests
+            # simulate status-text-matched failures, e.g. a
+            # RESOURCE_EXHAUSTED for the OOM-forensics path
+            if isinstance(spec.arg, str):
+                raise FaultInjected(f"{spec.arg} (injected at {site})")
             raise FaultInjected(
                 f"injected fault at {site} ({spec.describe()})")
         if spec.mode == "delay":

@@ -151,6 +151,10 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
             if not ok:
                 return
             self._send_json(200, telemetry.fleet_snapshot(limit=limit))
+        elif url.path == "/debug/memory":
+            # attributed per-device owners + allocator reconciliation
+            # (reconcile runs on THIS debug request, not a serve thread)
+            self._send_json(200, telemetry.MEMLEDGER.debug_snapshot())
         else:
             self._send_json(404, {"error": f"unknown path {self.path}"})
 

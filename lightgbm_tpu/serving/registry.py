@@ -103,6 +103,11 @@ class ServingModel:
         t = self._refresh_thread
         if t is not None and t.is_alive():
             t.join(timeout=30.0)
+        # un-attribute the runtime's buffers in the memory ledger — an
+        # unloaded model must stop counting against serve.<name>.*
+        rel = getattr(self.runtime, "_ledger_release", None)
+        if rel is not None:
+            rel()
 
 
 class ModelRegistry:
@@ -261,6 +266,14 @@ class ModelRegistry:
                                             freed_bytes=freed)
                     used -= freed
         self._update_vram_gauge()
+        # declared-vs-measured check at the swap boundary: a normal
+        # admit (possibly after demotions) lands under the ceiling, so
+        # a counted violation here means the accounting drifted or the
+        # demotion math stopped freeing what it claims
+        telemetry.MEMLEDGER.audit(
+            "serve_vram_budget_mb", budget, used + need, model=name,
+            site="registry.admit", need_bytes=need, used_bytes=used,
+            replicas=getattr(runtime, "num_replicas", 1))
         if used + need > budget:
             raise LightGBMError(
                 f"serving model {name!r} needs {need} device bytes but "

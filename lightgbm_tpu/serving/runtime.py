@@ -189,6 +189,13 @@ class ServingRuntime:
         self._refresh_lock = make_lock("serving.runtime._refresh_lock")
         self._staging_lock = make_lock("serving.runtime._staging_lock")
         self._staging: Dict = {}  # guarded-by: _staging_lock
+        #: memory-ledger handles THIS runtime registered (plane handles
+        #: under _refresh_lock, staging handles under _staging_lock);
+        #: release is by handle, never by owner prefix — two runtimes
+        #: for the same model name (a load() swap overlap) must not
+        #: wipe each other's attribution
+        self._ledger_handles: List = []  # guarded-by: _refresh_lock
+        self._ledger_staging: List = []  # guarded-by: _staging_lock
         self.refresh()
 
     # ------------------------------------------------------------ export
@@ -220,6 +227,7 @@ class ServingRuntime:
             st.device_sum_ok = self._device_sum_enable(ex, st)
             st.compiled_ok = self._compiled_enable(ex, st)
             self._state = st
+            self._ledger_register(st)
 
     def _pin_export(self, ex: Dict) -> Dict:
         """Copy the export's device arrays onto this runtime's pinned
@@ -293,11 +301,19 @@ class ServingRuntime:
     def num_feature(self) -> int:
         return int(self._booster.num_feature())
 
-    def device_bytes(self) -> int:
-        """Accelerator-resident bytes of this runtime's export (stacked
-        traversal planes + leaf-value bit planes + compiled tile
-        planes) — the registry's `serve_vram_budget_mb` accounting
-        unit.  0 after `demote()`."""
+    def staging_bytes(self) -> int:
+        """Bytes of the reused per-(bucket, width) staging buffers —
+        each sizes the transient device copy `_stage32` uploads per
+        call, so this is the runtime's worst-case per-call staging
+        footprint on top of the pinned planes."""
+        with self._staging_lock:
+            return sum(int(buf.nbytes)
+                       for buf in self._staging.values())
+
+    def _plane_bytes(self) -> int:
+        """Pinned plane bytes only (stacked traversal planes +
+        leaf-value bit planes + compiled tile planes) — what
+        `demote()` actually frees.  0 after `demote()`."""
         st = self._state
         ex = st.export
         if st.demoted or not ex:
@@ -315,6 +331,63 @@ class ServingRuntime:
                          for a in bucket if a is not None)
         return total
 
+    def device_bytes(self) -> int:
+        """Accelerator-resident bytes of this runtime's export (stacked
+        traversal planes + leaf-value bit planes + compiled tile
+        planes) — the registry's `serve_vram_budget_mb` accounting unit
+        and exactly what the memory ledger attributes under
+        `serve.<name>.planes{rung=}`, so `_admit` and the budget
+        auditor agree on one number.  The reused per-(bucket, width)
+        staging buffers are host-side scratch that survives `demote()`
+        and exists regardless of admission — attributed separately
+        under `serve.<name>.staging{bucket=,width=}` and reported by
+        `staging_bytes()`, deliberately excluded here so workload shape
+        (which buckets a traffic mix touched) can never flip an admit
+        decision."""
+        return self._plane_bytes()
+
+    def _ledger_register(self, st: _ServeState) -> None:
+        """(Re-)attribute the published bundle's planes in the memory
+        ledger.  `assign` releases the previous bundle's handles for
+        the same owner+labels first, so refresh/demote/rung swaps never
+        double-count; a demoted bundle assigns empty lists, which IS
+        the release."""
+        led = telemetry.MEMLEDGER
+        if not led.enabled:
+            return
+        ex = st.export
+        owner = f"serve.{self.name}.planes"
+        stacked_arrays: list = []
+        tile_planes: list = []
+        if ex and not st.demoted:
+            stacked = ex.get("stacked")
+            if stacked:
+                stacked_arrays += [v for v in stacked.values()
+                                   if hasattr(v, "nbytes")]
+            stacked_arrays += [ex[k] for k in ("value_hi", "value_lo")
+                               if ex.get(k) is not None]
+            if st.plan_planes is not None:
+                tile_planes = [a for bucket in st.plan_planes
+                               for a in bucket if a is not None]
+        self._ledger_handles = (
+            led.assign(owner, stacked_arrays, rung="stacked")
+            + led.assign(owner, tile_planes, rung="compiled"))
+
+    def _ledger_release(self) -> None:
+        """Drop every ledger handle this runtime owns (planes AND
+        staging) — `ServingModel.close()` calls this so an unloaded
+        model stops being attributed.  Handle-wise (idempotent), not
+        owner-prefix-wise: during a load() swap the old and new
+        runtimes briefly share owner keys."""
+        led = telemetry.MEMLEDGER
+        with self._refresh_lock:
+            handles, self._ledger_handles = self._ledger_handles, []
+        with self._staging_lock:
+            handles += self._ledger_staging
+            self._ledger_staging = []
+        for h in handles:
+            led.release(h)
+
     def demote(self) -> int:
         """Move the export's device arrays to host copies (the
         registry's LRU budget demotion).  The runtime keeps serving
@@ -322,7 +395,7 @@ class ServingRuntime:
         — at reduced throughput until the next `refresh()` promotes it
         back.  Returns the device bytes freed."""
         with self._refresh_lock:
-            freed = self.device_bytes()
+            freed = self._plane_bytes()  # staging survives demotion
             if freed == 0:
                 return 0
             cur = self._state
@@ -349,6 +422,7 @@ class ServingRuntime:
                        None) is not None:
                 self._booster._serving_export_cache = None
             self._state = st
+            self._ledger_register(st)
         telemetry.REGISTRY.counter("serve.demotions").inc()
         return freed
 
@@ -487,6 +561,15 @@ class ServingRuntime:
         except PlanNotCompilable as e:
             self._disable_compiled("not_compilable", str(e))
             return False
+        # declared-vs-measured tile contract: the packer promised every
+        # tile fits serve_tile_vmem_kb — hold it to that (counts
+        # mem.budget_violation{contract=serve_tile_vmem_kb} on breach)
+        if plan.tile_stats:
+            telemetry.MEMLEDGER.audit(
+                "serve_tile_vmem_kb", self._tile_vmem_kb * 1024,
+                max(int(s.get("bytes", 0)) for s in plan.tile_stats),
+                model=self.name, site="serve.compiled_enable",
+                tiles=len(plan.tile_stats))
         planes = []
         for p in plan.planes:
             arrs = [jnp.asarray(p["words"]), jnp.asarray(p["kids"]),
@@ -666,6 +749,7 @@ class ServingRuntime:
             new.probe_failed = cur.probe_failed
             new.demoted = cur.demoted
             self._state = new
+            self._ledger_register(new)
 
     # ----------------------------------------- breaker-gated recovery
     def _maybe_reprobe(self, st: _ServeState) -> None:
@@ -787,6 +871,7 @@ class ServingRuntime:
             new.plan_meta = None
             new.plan_gidx = None
         self._state = new
+        self._ledger_register(new)
 
     # ----------------------------------------------------------- predict
     def predict(self, X, raw_score: bool = False,
@@ -900,29 +985,34 @@ class ServingRuntime:
             # dispatch + D2H under one watchdog deadline: a wedged
             # kernel is abandoned and surfaces as DeviceTimeoutError,
             # which the except in `_compiled` treats like any device
-            # failure (degrade + open the breaker)
-            FAULTS.inject("compiled.traverse")
-            t = time.perf_counter()
-            out = compiled_predict(Xd, st.plan_planes, st.plan_gidx,
-                                   ex["value_hi"], ex["value_lo"], cls,
-                                   meta=st.plan_meta, n_class=K,
-                                   convert=conv, interpret=interp)
-            clock.add("dispatch", time.perf_counter() - t)
-            if want_raw:
+            # failure (degrade + open the breaker).  The oom_guard dumps
+            # the attributed snapshot on RESOURCE_EXHAUSTED, re-raises,
+            # and the same except degrades the rung.
+            with telemetry.MEMLEDGER.oom_guard("serve.dispatch.compiled",
+                                               model=self.name):
+                FAULTS.inject("compiled.traverse")
                 t = time.perf_counter()
-                hi = np.asarray(jax.device_get(out[0]))
-                lo = np.asarray(jax.device_get(out[1]))
+                out = compiled_predict(Xd, st.plan_planes, st.plan_gidx,
+                                       ex["value_hi"], ex["value_lo"],
+                                       cls, meta=st.plan_meta, n_class=K,
+                                       convert=conv, interpret=interp)
+                clock.add("dispatch", time.perf_counter() - t)
+                if want_raw:
+                    t = time.perf_counter()
+                    hi = np.asarray(jax.device_get(out[0]))
+                    lo = np.asarray(jax.device_get(out[1]))
+                    clock.add("d2h", time.perf_counter() - t)
+                    telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                        hi.nbytes + lo.nbytes)
+                    raw = ((hi.astype(np.uint64) << np.uint64(32))
+                           | lo).view(np.float64)
+                    return FAULTS.inject("serve.d2h.compiled", raw)
+                t = time.perf_counter()
+                o = np.asarray(jax.device_get(out))
                 clock.add("d2h", time.perf_counter() - t)
                 telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
-                    hi.nbytes + lo.nbytes)
-                raw = ((hi.astype(np.uint64) << np.uint64(32))
-                       | lo).view(np.float64)
-                return FAULTS.inject("serve.d2h.compiled", raw)
-            t = time.perf_counter()
-            o = np.asarray(jax.device_get(out))
-            clock.add("d2h", time.perf_counter() - t)
-            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
-            return FAULTS.inject("serve.d2h.compiled", o)
+                    o.nbytes)
+                return FAULTS.inject("serve.d2h.compiled", o)
 
         return self._supervisors["compiled"].call(_device)[:n]
 
@@ -968,25 +1058,28 @@ class ServingRuntime:
         n = Xc.shape[0]
 
         def _device():
-            FAULTS.inject("serve.dispatch.device_sum")
-            t = time.perf_counter()
-            out = _EXACT_JIT(arrays, Xd, n_class=K, convert=conv)
-            clock.add("dispatch", time.perf_counter() - t)
-            if want_raw:
+            with telemetry.MEMLEDGER.oom_guard(
+                    "serve.dispatch.device_sum", model=self.name):
+                FAULTS.inject("serve.dispatch.device_sum")
                 t = time.perf_counter()
-                hi = np.asarray(jax.device_get(out[0]))
-                lo = np.asarray(jax.device_get(out[1]))
+                out = _EXACT_JIT(arrays, Xd, n_class=K, convert=conv)
+                clock.add("dispatch", time.perf_counter() - t)
+                if want_raw:
+                    t = time.perf_counter()
+                    hi = np.asarray(jax.device_get(out[0]))
+                    lo = np.asarray(jax.device_get(out[1]))
+                    clock.add("d2h", time.perf_counter() - t)
+                    telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                        hi.nbytes + lo.nbytes)
+                    raw = ((hi.astype(np.uint64) << np.uint64(32))
+                           | lo).view(np.float64)
+                    return FAULTS.inject("serve.d2h.device_sum", raw)
+                t = time.perf_counter()
+                o = np.asarray(jax.device_get(out))
                 clock.add("d2h", time.perf_counter() - t)
                 telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
-                    hi.nbytes + lo.nbytes)
-                raw = ((hi.astype(np.uint64) << np.uint64(32))
-                       | lo).view(np.float64)
-                return FAULTS.inject("serve.d2h.device_sum", raw)
-            t = time.perf_counter()
-            o = np.asarray(jax.device_get(out))
-            clock.add("d2h", time.perf_counter() - t)
-            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(o.nbytes)
-            return FAULTS.inject("serve.d2h.device_sum", o)
+                    o.nbytes)
+                return FAULTS.inject("serve.d2h.device_sum", o)
 
         return self._supervisors["device_sum"].call(_device)[:n]
 
@@ -1085,15 +1178,18 @@ class ServingRuntime:
                   if k not in ("min_features", "value")}
 
         def _device():
-            FAULTS.inject("serve.dispatch.slot_path")
-            t = time.perf_counter()
-            out = _LEAF_JIT(arrays, Xd)
-            clock.add("dispatch", time.perf_counter() - t)
-            t = time.perf_counter()
-            slots = np.asarray(jax.device_get(out))
-            clock.add("d2h", time.perf_counter() - t)
-            telemetry.REGISTRY.counter("serve.d2h_bytes").inc(slots.nbytes)
-            return FAULTS.inject("serve.d2h.slot_path", slots)
+            with telemetry.MEMLEDGER.oom_guard(
+                    "serve.dispatch.slot_path", model=self.name):
+                FAULTS.inject("serve.dispatch.slot_path")
+                t = time.perf_counter()
+                out = _LEAF_JIT(arrays, Xd)
+                clock.add("dispatch", time.perf_counter() - t)
+                t = time.perf_counter()
+                slots = np.asarray(jax.device_get(out))
+                clock.add("d2h", time.perf_counter() - t)
+                telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                    slots.nbytes)
+                return FAULTS.inject("serve.d2h.slot_path", slots)
 
         return self._supervisors["slot_path"].call(_device)[:, :n]
 
@@ -1112,6 +1208,15 @@ class ServingRuntime:
             if buf is None:
                 buf = np.empty((b, Xc.shape[1]), np.float32)
                 self._staging[key] = buf
+                # once per (bucket, width), NOT per call: the reused
+                # buffer is the worst-case per-call device staging, so
+                # it is the thing worth attributing (the per-call
+                # device copy is transient and weakref churn on the
+                # hot path buys nothing)
+                self._ledger_staging.append(
+                    telemetry.MEMLEDGER.register(
+                        f"serve.{self.name}.staging", buf,
+                        bucket=str(b), width=str(Xc.shape[1])))
             with np.errstate(over="ignore"):
                 buf[:n] = Xc
             buf[n:] = 0.0

@@ -173,6 +173,12 @@ class ShardedServingRuntime:
         this / num_replicas — the copies are byte-identical)."""
         return sum(r.device_bytes() for r in self._replicas)
 
+    def _ledger_release(self) -> None:
+        """Drop every replica's memory-ledger handles (registry close
+        path — replicas register under `serve.<name>.r<i>.*`)."""
+        for r in self._replicas:
+            r._ledger_release()
+
     def warmup(self) -> int:
         """Warm every replica's bucket ladder on its own device (the
         jit caches are keyed per device, so each replica pays its own

@@ -125,7 +125,7 @@ class StreamingWaveGrower:
 
     def __init__(self, spec: GrowerSpec, store, *, prefetch_depth: int = 2,
                  run_stats: Optional[PrefetchRunStats] = None,
-                 payload: str = "bins"):
+                 payload: str = "bins", budget_mb: float = 0.0):
         reasons = streaming_downgrade_reasons(spec, store)
         if reasons:
             raise ValueError("spec cannot stream: " + "; ".join(reasons))
@@ -164,7 +164,16 @@ class StreamingWaveGrower:
         REGISTRY.gauge("wave.shards").set(1)
         REGISTRY.gauge("wave.fused").set(0)
         REGISTRY.gauge("stream.shards").set(store.n_shards)
+        # two watermarks (memledger satellite): `peak_staging_bytes` is
+        # what `datastore_budget_mb` sizes — at most the current +
+        # previous staged shard blocks; `peak_device_bytes` is the
+        # HONEST device footprint: staging PLUS the resident O(N) state
+        # (payload, leaf_id, grad/hess) and the live histogram carries.
+        # The old gauge counted only staging and therefore lied.
         self.peak_device_bytes = 0
+        self.peak_staging_bytes = 0
+        self._resident_bytes = 0  # recomputed per pass, host arithmetic
+        self.budget_mb = float(budget_mb)
         self._tree_idx = -1     # bumped per __call__ (one call = one tree)
         self._build_programs()
 
@@ -613,21 +622,45 @@ class StreamingWaveGrower:
                     prof["prefetch_wait_s"] += t1 - t0
                     prof["h2d_s"] += t2 - t1
                 staged = block.nbytes + prev_bytes
-                if staged > self.peak_device_bytes:
-                    self.peak_device_bytes = staged
+                if staged > self.peak_staging_bytes:
+                    self.peak_staging_bytes = staged
+                total = staged + self._resident_bytes
+                if total > self.peak_device_bytes:
+                    self.peak_device_bytes = total
                 prev_bytes = block.nbytes
+                # weakref-tracked: the free is observed when the
+                # double-buffer rotates, no release bookkeeping here
+                telemetry.MEMLEDGER.register("stream.staging", dev)
                 shards_read.inc()
                 yield block.shape[1], row0, dev
         finally:
             pf.close()
             self.stats.absorb(pf)
+            REGISTRY.gauge("stream.peak_staging_mb").set(
+                round(self.peak_staging_bytes / 2**20, 3))
             REGISTRY.gauge("stream.peak_device_mb").set(
                 round(self.peak_device_bytes / 2**20, 3))
+            # the staging double-buffer is the part the budget sizes —
+            # audited per pass against the declared contract
+            telemetry.MEMLEDGER.audit(
+                "datastore_budget_mb", self.budget_mb * 2**20,
+                self.peak_staging_bytes, site="stream.pass",
+                peak_staging_mb=round(self.peak_staging_bytes / 2**20, 3))
             # run-max (not per-pass) host residency: the accounting
             # satellite — short-lived per-pass prefetchers must not
             # reset the published steady state
             REGISTRY.gauge("datastore.peak_resident_mb").set(
                 round(self.stats.peak_resident_bytes / 2**20, 3))
+
+    # ------------------------------------------------------------ residency
+    @staticmethod
+    def _tree_nbytes(tree_) -> int:
+        """Host-side byte total of a pytree of device arrays (metadata
+        only — never a device sync)."""
+        if tree_ is None:
+            return 0
+        return sum(int(getattr(a, "nbytes", 0))
+                   for a in jax.tree_util.tree_leaves(tree_))
 
     # ------------------------------------------------------------ profiler
     @staticmethod
@@ -669,6 +702,14 @@ class StreamingWaveGrower:
             grad, hess, sample_weight)
         N = payload.shape[0]
         leaf_id = jnp.zeros((N,), jnp.int32)
+        # resident O(N) state the old gauge ignored: the [N, 3] payload,
+        # the partition vector, and the caller's grad/hess (alive for
+        # the whole tree).  `buf=stream` keeps these handles disjoint
+        # from the booster's own `train.scores` assignment.
+        base_resident = self._tree_nbytes(
+            (payload, leaf_id, grad, hess, sample_weight))
+        telemetry.MEMLEDGER.register("train.scores", payload, buf="stream")
+        telemetry.MEMLEDGER.register("train.scores", leaf_id, buf="stream")
         self._tree_idx += 1
         tree = self._tree_idx
         wave_idx = 0
@@ -679,11 +720,13 @@ class StreamingWaveGrower:
             prof, t_pass = self._pass_profile(), time.perf_counter()
             root_slots = jnp.full((W,), LB, jnp.int32).at[0].set(0)
             acc = self._acc_init()
-            for rows, row0, dev in self._stream(prof):
-                t_f = time.perf_counter()
-                acc = self._accum_prog(rows)(
-                    acc, dev, payload, leaf_id, row0, root_slots, qs)
-                prof["device_fold_s"] += time.perf_counter() - t_f
+            self._resident_bytes = base_resident + self._tree_nbytes(acc)
+            with telemetry.MEMLEDGER.oom_guard("stream.fold"):
+                for rows, row0, dev in self._stream(prof):
+                    t_f = time.perf_counter()
+                    acc = self._accum_prog(rows)(
+                        acc, dev, payload, leaf_id, row0, root_slots, qs)
+                    prof["device_fold_s"] += time.perf_counter() - t_f
             t_h = time.perf_counter()
             hist0 = self._acc_finalize(acc, qs)[0]
             prof["host_harvest_s"] += time.perf_counter() - t_h
@@ -691,6 +734,8 @@ class StreamingWaveGrower:
                              shards=shards)
         state, allowed_eff = self._root_find(hist0, root_g, root_h,
                                              root_c, feat, allowed)
+        if state.get("hist") is not None:
+            telemetry.MEMLEDGER.register("train.hist_carry", state["hist"])
 
         # ---- wave loop (host-driven; cond mirrors the in-memory one) ----
         while (int(state["step"]) < LB - 1
@@ -707,12 +752,15 @@ class StreamingWaveGrower:
                                     phase="partition") as sp:
                     prof, t_pass = self._pass_profile(), \
                         time.perf_counter()
-                    for rows, row0, dev in self._stream(prof):
-                        t_f = time.perf_counter()
-                        leaf_id = self._part_prog(rows)(
-                            dev, leaf_id, row0, desc, feat)
-                        prof["device_fold_s"] += \
-                            time.perf_counter() - t_f
+                    self._resident_bytes = base_resident + \
+                        self._tree_nbytes(s1.get("hist"))
+                    with telemetry.MEMLEDGER.oom_guard("stream.fold"):
+                        for rows, row0, dev in self._stream(prof):
+                            t_f = time.perf_counter()
+                            leaf_id = self._part_prog(rows)(
+                                dev, leaf_id, row0, desc, feat)
+                            prof["device_fold_s"] += \
+                                time.perf_counter() - t_f
                     self._pass_close(sp, prof, t_pass, tree=tree,
                                      wave=wave_idx, shards=shards)
                 state = {k: s1[k] for k in
@@ -721,12 +769,17 @@ class StreamingWaveGrower:
             with telemetry.span("stream.pass", phase="wave") as sp:
                 prof, t_pass = self._pass_profile(), time.perf_counter()
                 acc = self._acc_init()
-                for rows, row0, dev in self._stream(prof):
-                    t_f = time.perf_counter()
-                    acc, leaf_id = self._wave_prog(rows)(
-                        acc, dev, payload, leaf_id, row0, desc, feat,
-                        qs)
-                    prof["device_fold_s"] += time.perf_counter() - t_f
+                self._resident_bytes = base_resident + \
+                    self._tree_nbytes(acc) + \
+                    self._tree_nbytes(state.get("hist"))
+                with telemetry.MEMLEDGER.oom_guard("stream.fold"):
+                    for rows, row0, dev in self._stream(prof):
+                        t_f = time.perf_counter()
+                        acc, leaf_id = self._wave_prog(rows)(
+                            acc, dev, payload, leaf_id, row0, desc,
+                            feat, qs)
+                        prof["device_fold_s"] += \
+                            time.perf_counter() - t_f
                 t_h = time.perf_counter()
                 small_h = self._acc_finalize(acc, qs)
                 prof["host_harvest_s"] += time.perf_counter() - t_h
@@ -736,6 +789,7 @@ class StreamingWaveGrower:
                 state["hist"], s1, small_h, feat, allowed_eff)
             state = {k: s1[k] for k in self._carry_keys}
             state["hist"] = hist
+            telemetry.MEMLEDGER.register("train.hist_carry", hist)
             for k, v in zip(LEAF_KEYS, leaf_upd):
                 state[k] = v
 

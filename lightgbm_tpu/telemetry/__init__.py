@@ -32,6 +32,8 @@ from .request_trace import (RequestTrace, SERVE_RECORDER, ServeRecorder,
                             observe_stages, server_latency_block)
 from .diff import diff_snapshots, flatten, load_snapshot
 from .ledger import LEDGER, Ledger, ancestry, ledger_records, rejections
+from .memledger import (LeakSentinel, MemHandle, MEMLEDGER, MemoryLedger,
+                        is_oom, render_memory)
 from .slo import BurnRateMeter
 from .ops import fleet_snapshot, render_top
 
@@ -51,6 +53,8 @@ __all__ = [
     "server_latency_block",
     "diff_snapshots", "flatten", "load_snapshot",
     "LEDGER", "Ledger", "ancestry", "ledger_records", "rejections",
+    "LeakSentinel", "MemHandle", "MEMLEDGER", "MemoryLedger", "is_oom",
+    "render_memory",
     "BurnRateMeter",
     "fleet_snapshot", "render_top",
 ]

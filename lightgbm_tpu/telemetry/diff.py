@@ -70,7 +70,23 @@ RULES: List[Tuple[str, str, str]] = [
     ("*compile.recompiles", "up_is_bad", "counter"),
     ("*cache_entries", "up_is_bad", "counter"),
     ("*compile_total_s", "up_is_bad", "timing"),
+    # device-memory ledger (ISSUE 18): unattributed bytes growing means
+    # allocations escaped the owner taxonomy (an attribution leak);
+    # budget-violation counts and the leak-sentinel slope fail hard on
+    # growth (slope is wall-clock-derived — timing tolerance); the
+    # reconcile walk is background work, and the per-device per-owner
+    # attribution gauges are workload shape, not a regression axis
+    ("*mem.unattributed_bytes", "up_is_bad", "counter"),
+    ("*mem.budget_violation*", "up_is_bad", "counter"),
+    ("*mem.leak.slope_mb_per_min", "up_is_bad", "timing"),
+    ("*mem.reconcile*", "ignore", "timing"),
+    ("*mem.oom.dumps", "up_is_bad", "counter"),
+    # watermarks (..peak_bytes, matched below) fail hard on growth;
+    # the LIVE per-owner gauges are whatever was resident at snapshot
+    # time — scheduling-dependent, not a regression axis
     ("*peak_bytes", "up_is_bad", "counter"),
+    ("*mem.dev*", "ignore", "counter"),
+    ("*mem.host.*", "ignore", "counter"),
     ("*mem.*", "up_is_bad", "counter"),
     # fallback / forced events — higher is worse
     ("*fallback*", "up_is_bad", "counter"),
@@ -257,6 +273,9 @@ RULES: List[Tuple[str, str, str]] = [
     # (pass count moves with tree shape), and the shard-count gauge is
     # dataset identity
     ("*stream.peak_device_mb", "up_is_bad", "counter"),
+    # transient staging watermark (ISSUE 18): the double-buffer window
+    # alone — deterministic array sizes, same budget-contract semantics
+    ("*stream.peak_staging_mb", "up_is_bad", "counter"),
     ("*stream.stalls", "up_is_bad", "timing"),
     # streaming-pass profiler (ISSUE 16): per-stage attribution
     # histograms (prefetch-wait / H2D / device-fold / host-harvest) are
@@ -278,6 +297,16 @@ RULES: List[Tuple[str, str, str]] = [
     ("streaming.stall_ratio", "up_is_bad", "timing"),
     ("streaming.peak_device_mb", "up_is_bad", "counter"),
     ("streaming.*", "ignore", "counter"),
+    # the bench `memory.ledger` block (ISSUE 18): the unattributed
+    # watermark, violation counts and the leak slope fail hard on
+    # growth (slope is wall-clock-derived — timing tolerance); the
+    # per-device per-owner attribution is workload shape, not a
+    # regression axis
+    ("memory.ledger.unattributed_mb", "up_is_bad", "counter"),
+    ("memory.ledger.budget_violations*", "up_is_bad", "counter"),
+    ("memory.ledger.oom_dumps", "up_is_bad", "counter"),
+    ("memory.ledger.leak_slope_mb_per_min", "up_is_bad", "timing"),
+    ("memory.ledger.*", "ignore", "counter"),
     ("*datastore.prefetch.stall", "up_is_bad", "timing"),
     ("*datastore.prefetch.hit", "ignore", "counter"),
     ("*datastore.spill_bytes", "ignore", "counter"),

@@ -1,0 +1,894 @@
+"""Attributed device-memory ledger: who owns every resident byte.
+
+Every budget contract in this codebase (`datastore_budget_mb`,
+`serve_vram_budget_mb`, `serve_tile_vmem_kb`, the streaming staging
+budget) was self-reported from scattered sites; nothing reconciled the
+claims against allocator truth or explained a RESOURCE_EXHAUSTED.  This
+module is the one audited ledger those numbers now flow through:
+
+ - **registration** — subsystems that put bytes on a device register
+   the buffer under an owner tag (`train.bins`, `train.scores`,
+   `train.hist_carry`, `serve.<model>.planes{rung=}`,
+   `serve.<model>.staging`, `stream.staging`, `datastore.place`,
+   `compile.plan`) via `MEMLEDGER.register(owner, array)`.  The handle
+   holds a weakref with a free callback, so deallocation is observed
+   without touching dispatch paths; registration itself is host-side
+   nbytes arithmetic (array metadata only — zero device syncs).
+   Gauges: `mem.dev<i>.<owner>` live bytes, `.peak_bytes` high-water.
+ - **reconcile()** — diffs attributed totals against allocator truth
+   (`device.memory_stats()` on TPU/GPU; the `jax.live_arrays()`
+   fallback on CPU, same source tagging as recorder.sample_memory) and
+   publishes `mem.unattributed_bytes` plus a shape/dtype fingerprint of
+   the largest unknown buffers.
+ - **audit()** — budget-contract check at round / refresh / swap /
+   demote boundaries: measured attributed bytes vs the declared
+   ceiling, counting `mem.budget_violation{contract=}` and writing a
+   causally-linked Ledger record with the evidence.  Never raises.
+ - **leak sentinel** — per-round watermark series through a Theil-Sen
+   slope fit (robust to sawtooth allocation) published as
+   `mem.leak.slope_mb_per_min`, consumed by the fleet daemon and bench.
+ - **oom_guard()** — wraps known dispatch sites so a RESOURCE_EXHAUSTED
+   dumps the full attributed snapshot as an `{"ev": "oom"}` sink/spool
+   event naming the top owners per device, then re-raises.
+
+Surfaces: `GET /debug/memory` (serving/http.py), `python -m
+lightgbm_tpu memory [url | spool-dir] [--json]`, the `memory` block in
+BENCH JSON, and per-process memory counter tracks in the Chrome-trace
+export (spool.py).  See docs/MEMORY.md.
+
+STDLIB + optional-jax by design, like every sibling in this package:
+loadable by file path from jax-free processes (jax is reached through
+`sys.modules` only, never imported).  Training and serving outputs are
+byte-identical with the ledger on or off — the ledger observes
+allocations, it never changes them.
+"""
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+try:
+    from .metrics import REGISTRY
+    from .sinks import make_event
+except ImportError:  # loaded by file path, outside the package
+    import importlib.util as _ilu
+
+    def _load_sibling(name: str):
+        spec = _ilu.spec_from_file_location(
+            f"_telemetry_memledger_{name}",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         f"{name}.py"))
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    REGISTRY = _load_sibling("metrics").REGISTRY
+    make_event = _load_sibling("sinks").make_event
+
+try:
+    from .ledger import LEDGER
+    from .spans import TRACER
+except ImportError:  # file-path load: no sink/ledger mirroring
+    LEDGER = None
+    TRACER = None
+
+try:
+    from ..analysis import make_lock
+except ImportError:  # file-path load: plain lock, no order witness
+    def make_lock(role: str):
+        return threading.Lock()
+
+DEFAULT_URL = "http://127.0.0.1:8080/debug/memory"
+
+#: fingerprints reported for the largest allocator-known but
+#: ledger-unknown buffers in a reconcile
+MAX_UNKNOWN_FINGERPRINTS = 5
+
+#: leak-sentinel ring capacity (observations) and the pair budget the
+#: Theil-Sen fit subsamples down to (median of pairwise slopes is
+#: O(n^2); 512 obs would be 130k pairs)
+SENTINEL_CAPACITY = 512
+SENTINEL_MAX_PAIRS = 2048
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does this exception smell like device-memory exhaustion?  Matches
+    the XLA RESOURCE_EXHAUSTED status text (TPU/GPU allocators) and the
+    generic out-of-memory phrasings; a FAULTS error injection carrying
+    either string simulates the real thing end to end."""
+    s = f"{type(exc).__name__}: {exc}"
+    return ("RESOURCE_EXHAUSTED" in s or "OutOfMemory" in s
+            or "out of memory" in s.lower())
+
+
+def _owner_key(owner: str, labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return owner
+    return owner + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _array_parts(array: Any) -> Tuple[List[Tuple[str, int]],
+                                      Tuple[int, ...], str]:
+    """`[(device_key, nbytes), ...]` + shape + dtype for an array-like,
+    from METADATA only (shape/dtype/nbytes/device id reads never sync).
+    Deliberately avoids `addressable_shards[...].data`: materializing a
+    shard view registers a new aliasing entry in `jax.live_arrays()`
+    that would then double-count against allocator truth forever.
+    Sharded arrays split nbytes evenly across their devices; replicated
+    arrays charge the full nbytes per device; plain numpy (and anything
+    without device identity) attributes to the `host` pseudo-device."""
+    shape = tuple(int(s) for s in (getattr(array, "shape", ()) or ()))
+    dtype = str(getattr(array, "dtype", "?"))
+    nbytes = int(getattr(array, "nbytes", 0))
+    devices = getattr(array, "devices", None)
+    if callable(devices):
+        try:
+            ids = sorted(int(getattr(d, "id", 0)) for d in devices())
+        except Exception:
+            ids = []
+        if ids:
+            sharding = getattr(array, "sharding", None)
+            replicated = bool(getattr(sharding, "is_fully_replicated",
+                                      len(ids) == 1))
+            per = nbytes if replicated else max(nbytes // len(ids), 0)
+            return [(f"dev{i}", per) for i in ids], shape, dtype
+    return [("host", nbytes)], shape, dtype
+
+
+class MemHandle:
+    """One registered buffer: owner tag, per-device byte parts, and the
+    weakref whose death reports the free.  `release()` is explicit and
+    idempotent — hot paths with deterministic lifecycles (streaming
+    staging) release by hand instead of waiting for GC."""
+
+    __slots__ = ("owner", "labels", "parts", "shape", "dtype",
+                 "released", "_ledger", "_ref", "__weakref__")
+
+    def __init__(self, ledger: Optional["MemoryLedger"], owner: str,
+                 labels: Tuple[Tuple[str, str], ...],
+                 parts: List[Tuple[str, int]],
+                 shape: Tuple[int, ...], dtype: str):
+        self.owner = owner
+        self.labels = labels
+        self.parts = parts
+        self.shape = shape
+        self.dtype = dtype
+        self.released = False  # guarded-by: the owning ledger's _lock
+        self._ledger = ledger
+        self._ref: Optional[weakref.ref] = None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(nb for _dev, nb in self.parts)
+
+    def release(self) -> None:
+        if self._ledger is not None:
+            self._ledger.release(self)
+
+
+#: the no-op handle a disabled ledger hands out — callers hold and
+#: release it without branching on the enabled flag
+_NOOP_HANDLE = MemHandle(None, "", (), [], (), "?")
+
+
+class LeakSentinel:
+    """Bounded (t, bytes) watermark series with a Theil-Sen slope fit.
+
+    The median of pairwise slopes is robust to the sawtooth a healthy
+    allocator draws (alloc-free cycles around a flat baseline) while a
+    genuine monotone leak pulls every pairwise slope positive.
+    Timestamps are injectable for tests; production observes wall time.
+    """
+
+    def __init__(self, capacity: int = SENTINEL_CAPACITY):
+        self._lock = make_lock("telemetry.memledger.sentinel._lock")
+        self._pts: collections.deque = collections.deque(
+            maxlen=max(int(capacity), 4))  # guarded-by: _lock
+
+    def observe(self, nbytes: float, t: Optional[float] = None) -> float:
+        """Append one watermark observation and republish the slope
+        gauge.  Returns the current slope (MB/min)."""
+        ts = time.monotonic() if t is None else float(t)
+        with self._lock:
+            self._pts.append((ts, float(nbytes)))
+        slope = self.slope_mb_per_min()
+        REGISTRY.gauge("mem.leak.slope_mb_per_min").set(round(slope, 6))
+        return slope
+
+    def slope_mb_per_min(self) -> float:
+        with self._lock:
+            pts = list(self._pts)
+        n = len(pts)
+        if n < 3 or pts[-1][0] <= pts[0][0]:
+            return 0.0
+        # subsample the O(n^2) pair set deterministically (stride on the
+        # first index) so a full ring stays cheap
+        stride = 1
+        while (n // stride) * (n - 1) // 2 > SENTINEL_MAX_PAIRS:
+            stride += 1
+        slopes: List[float] = []
+        for i in range(0, n - 1, stride):
+            t0, b0 = pts[i]
+            for j in range(i + 1, n):
+                dt = pts[j][0] - t0
+                if dt > 0:
+                    slopes.append((pts[j][1] - b0) / dt)
+        if not slopes:
+            return 0.0
+        slopes.sort()
+        mid = len(slopes) // 2
+        med = slopes[mid] if len(slopes) % 2 else \
+            0.5 * (slopes[mid - 1] + slopes[mid])
+        return med * 60.0 / float(1 << 20)  # bytes/s -> MB/min
+
+    def samples(self) -> int:
+        with self._lock:
+            return len(self._pts)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pts.clear()
+
+
+class MemoryLedger:
+    """Process-global per-device attributed allocation ledger.
+
+    Thread-safety: one witnessed lock guards the slot table and handle
+    set.  Weakref free callbacks run at arbitrary GC points — possibly
+    while this very lock is held — so they never touch guarded state:
+    they append the dead handle to a lock-free deque that every public
+    entry point drains under the lock (`_drain_locked`).
+    """
+
+    def __init__(self):
+        self._lock = make_lock("telemetry.memledger._lock")
+        #: (device_key, owner_key) -> [live_bytes, peak_bytes]
+        self._slots: Dict[Tuple[str, str], List[int]] = {}  # guarded-by: _lock
+        self._handles: set = set()        # guarded-by: _lock
+        self._dev_live: Dict[str, int] = {}  # guarded-by: _lock
+        self._dev_peak: Dict[str, int] = {}  # guarded-by: _lock
+        # freed handles parked by weakref callbacks; deque append/pop
+        # are atomic, so the GC-context writer needs no lock
+        self._pending: collections.deque = collections.deque()  # guarded-by: atomic
+        self._enabled = True  # guarded-by: atomic (bool flip, read-mostly)
+        self._sentinel = LeakSentinel()
+        self._reconcile_stop = threading.Event()
+        self._reconcile_thread: Optional[threading.Thread] = None  # guarded-by: _lock
+
+    # ------------------------------------------------------ configuration
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    @property
+    def sentinel(self) -> LeakSentinel:
+        """The leak sentinel — the fleet daemon and bench read its
+        `slope_mb_per_min()` directly."""
+        return self._sentinel
+
+    def configure(self, enabled: bool = True,
+                  reconcile_ms: float = 0.0) -> None:
+        """Arm/disarm the ledger (`memory_ledger` param) and start the
+        background reconciler when `memory_reconcile_ms` > 0 — the
+        reconcile runs OFF the request/training threads by design."""
+        self._enabled = bool(enabled)
+        period_s = max(float(reconcile_ms or 0.0), 0.0) / 1000.0
+        with self._lock:
+            th = self._reconcile_thread
+            if self._enabled and period_s > 0.0 and \
+                    (th is None or not th.is_alive()):
+                self._reconcile_stop = threading.Event()
+                stop = self._reconcile_stop
+                th = threading.Thread(
+                    target=self._reconcile_loop, args=(stop, period_s),
+                    name="memledger-reconcile", daemon=True)
+                self._reconcile_thread = th
+                th.start()
+            elif (not self._enabled or period_s <= 0.0):
+                self._reconcile_stop.set()
+
+    def _reconcile_loop(self, stop: threading.Event,
+                        period_s: float) -> None:
+        while not stop.wait(period_s):
+            try:
+                self.reconcile()
+            except Exception:
+                REGISTRY.counter("mem.reconcile.errors").inc()
+
+    # -------------------------------------------------------- registration
+    def register(self, owner: str, array: Any = None, *,
+                 nbytes: Optional[int] = None,
+                 device: Optional[str] = None,
+                 shape: Optional[Tuple[int, ...]] = None,
+                 dtype: str = "?", **labels: str) -> MemHandle:
+        """Attribute one buffer to `owner` (labels become gauge labels,
+        e.g. `rung="stacked"`).  Pass the array itself for weakref free
+        tracking, or explicit `nbytes`/`device` for synthetic entries.
+        Host-side metadata arithmetic only; returns a no-op handle when
+        the ledger is disabled."""
+        if not self._enabled:
+            return _NOOP_HANDLE
+        lab = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        if array is not None:
+            parts, shp, dt = _array_parts(array)
+        else:
+            parts = [(device or "host", int(nbytes or 0))]
+            shp, dt = tuple(shape or ()), str(dtype)
+        h = MemHandle(self, owner, lab, parts, shp, dt)
+        if array is not None:
+            try:
+                h._ref = weakref.ref(
+                    array,
+                    lambda _r, _h=h, _q=self._pending: _q.append(_h))
+            except TypeError:
+                h._ref = None  # unweakrefable: explicit release only
+        with self._lock:
+            self._drain_locked()
+            self._add_locked(h)
+        return h
+
+    def assign(self, owner: str, arrays: Iterable[Any],
+               **labels: str) -> List[MemHandle]:
+        """Replace every handle registered under exactly (owner, labels)
+        with the given arrays — the per-round refresh primitive for
+        buffers that are rebound rather than freed (scores, carries)."""
+        if not self._enabled:
+            return []
+        lab = tuple(sorted((k, str(v)) for k, v in labels.items()))
+        with self._lock:
+            self._drain_locked()
+            stale = [h for h in self._handles
+                     if h.owner == owner and h.labels == lab]
+            for h in stale:
+                self._release_locked(h)
+        return [self.register(owner, a, **labels)
+                for a in arrays if a is not None]
+
+    def release(self, handle: MemHandle) -> None:
+        """Explicitly un-attribute a handle (idempotent; also safe to
+        call after the weakref already reported the free)."""
+        if handle is _NOOP_HANDLE or handle._ledger is not self:
+            return
+        with self._lock:
+            self._drain_locked()
+            self._release_locked(handle)
+
+    def release_owner(self, prefix: str) -> int:
+        """Release every handle whose owner starts with `prefix` (e.g.
+        `serve.default.` when a serving runtime closes).  Returns the
+        number of handles released."""
+        with self._lock:
+            self._drain_locked()
+            victims = [h for h in self._handles
+                       if h.owner.startswith(prefix)]
+            for h in victims:
+                self._release_locked(h)
+        return len(victims)
+
+    # ----------------------------------------------- internals (locked)
+    def _drain_locked(self) -> None:
+        # weakref callbacks parked dead handles on the atomic deque;
+        # fold them into the table now that the lock is held
+        while True:
+            try:
+                h = self._pending.popleft()
+            except IndexError:
+                break
+            self._release_locked(h)
+
+    def _add_locked(self, h: MemHandle) -> None:
+        self._handles.add(h)
+        okey = _owner_key(h.owner, h.labels)
+        for dev, nb in h.parts:
+            slot = self._slots.setdefault((dev, okey), [0, 0])
+            slot[0] += nb
+            if slot[0] > slot[1]:
+                slot[1] = slot[0]
+            live = self._dev_live.get(dev, 0) + nb
+            self._dev_live[dev] = live
+            if live > self._dev_peak.get(dev, 0):
+                self._dev_peak[dev] = live
+                REGISTRY.gauge(
+                    f"mem.{dev}.attributed_peak_bytes").set(live)
+            self._publish(dev, h, slot)
+            REGISTRY.gauge(f"mem.{dev}.attributed_bytes").set(
+                self._dev_live[dev])
+
+    def _release_locked(self, h: MemHandle) -> None:
+        if h.released:
+            return
+        h.released = True
+        self._handles.discard(h)
+        okey = _owner_key(h.owner, h.labels)
+        for dev, nb in h.parts:
+            slot = self._slots.get((dev, okey))
+            if slot is not None:
+                slot[0] = max(slot[0] - nb, 0)
+                self._publish(dev, h, slot)
+            self._dev_live[dev] = max(
+                self._dev_live.get(dev, 0) - nb, 0)
+            REGISTRY.gauge(f"mem.{dev}.attributed_bytes").set(
+                self._dev_live[dev])
+
+    def _publish(self, dev: str, h: MemHandle, slot: List[int]) -> None:
+        labels = dict(h.labels)
+        REGISTRY.gauge(f"mem.{dev}.{h.owner}", **labels).set(slot[0])
+        REGISTRY.gauge(f"mem.{dev}.{h.owner}.peak_bytes",
+                       **labels).set(slot[1])
+
+    # ------------------------------------------------------------ queries
+    def attributed_bytes(self, prefix: str = "",
+                         device: Optional[str] = None) -> int:
+        """Live attributed bytes, optionally filtered by owner prefix
+        and/or device key (`dev0`, `host`)."""
+        total = 0
+        with self._lock:
+            self._drain_locked()
+            for (dev, okey), slot in self._slots.items():
+                if device is not None and dev != device:
+                    continue
+                if prefix and not okey.startswith(prefix):
+                    continue
+                total += slot[0]
+        return total
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-ready attributed view: per device, per owner, live and
+        peak bytes plus device totals and the leak-sentinel state."""
+        with self._lock:
+            self._drain_locked()
+            devices: Dict[str, Any] = {}
+            for (dev, okey), slot in sorted(self._slots.items()):
+                d = devices.setdefault(
+                    dev, {"owners": {}, "attributed_bytes": 0,
+                          "peak_bytes": int(self._dev_peak.get(dev, 0))})
+                d["owners"][okey] = {"bytes": int(slot[0]),
+                                     "peak_bytes": int(slot[1])}
+                d["attributed_bytes"] += int(slot[0])
+            handles = len(self._handles)
+        violations = {
+            ",".join(f"{k}={v}" for k, v in c.labels) or "total": c.value
+            for c in REGISTRY.counter_family("mem.budget_violation")}
+        return {
+            "enabled": self._enabled,
+            "devices": devices,
+            "handles": handles,
+            "leak": {
+                "slope_mb_per_min": round(
+                    self._sentinel.slope_mb_per_min(), 6),
+                "samples": self._sentinel.samples()},
+            "budget_violations": violations,
+            "oom_dumps": REGISTRY.counter("mem.oom.dumps").value,
+        }
+
+    # --------------------------------------------------------- reconcile
+    def reconcile(self, max_fingerprints: int = MAX_UNKNOWN_FINGERPRINTS
+                  ) -> Dict[str, Any]:
+        """Diff attributed totals against allocator truth.
+
+        TPU/GPU: `device.memory_stats()` bytes_in_use per device.  CPU
+        fallback: `jax.live_arrays()` summed per device on the DEFAULT
+        backend platform (host-committed / off-platform arrays tracked
+        as per-platform subtotals, same semantics as
+        recorder.sample_memory) — plus a shape/dtype fingerprint of the
+        largest buffers the ledger cannot attribute.  Publishes the
+        `mem.unattributed_bytes` gauge and the `mem.reconcile` timing.
+        Runs off the hot path (background thread / debug GET / CLI).
+        """
+        t0 = time.perf_counter()
+        out: Dict[str, Any] = {"source": "none", "devices": {},
+                               "unattributed_bytes": 0,
+                               "largest_unknown": []}
+        jax = sys.modules.get("jax")
+        snap = self.snapshot()
+        attributed = {dev: d["attributed_bytes"]
+                      for dev, d in snap["devices"].items()}
+        if jax is None:
+            return out
+        try:
+            devices = list(jax.local_devices())
+        except Exception:
+            return out
+        truth: Dict[str, int] = {}
+        source = "memory_stats"
+        for d in devices:
+            try:
+                ms = d.memory_stats()
+            except Exception:
+                ms = None
+            if not ms:
+                source = "live_arrays"
+                break
+            truth[f"dev{int(getattr(d, 'id', 0))}"] = int(
+                ms.get("bytes_in_use", 0))
+        unknown: List[Dict[str, Any]] = []
+        if source == "live_arrays":
+            truth = {}
+            platforms: Dict[str, int] = {}
+            try:
+                default_plat = str(jax.default_backend()).lower()
+            except Exception:
+                default_plat = "cpu"
+            known: set = set()
+            with self._lock:
+                self._drain_locked()
+                for h in self._handles:
+                    ref = h._ref
+                    target = ref() if ref is not None else None
+                    if target is not None:
+                        known.add(id(target))
+            try:
+                live = list(jax.live_arrays())
+            except Exception:
+                live = []
+            # Dedupe aliasing views by underlying buffer pointer:
+            # `addressable_shards[i].data` views share their parent's
+            # buffer but appear as separate live arrays — counting each
+            # would overstate allocator truth (single-buffer arrays
+            # only; multi-shard globals fall back to object identity).
+            seen: Dict[Any, Dict[str, Any]] = {}
+            for a in live:
+                try:
+                    devs = sorted(a.devices(),
+                                  key=lambda d: int(getattr(d, "id", 0)))
+                except Exception:
+                    continue
+                if not devs:
+                    continue
+                try:
+                    key: Any = ("ptr", int(a.unsafe_buffer_pointer()))
+                except Exception:
+                    key = ("id", id(a))
+                ent = seen.get(key)
+                if ent is None:
+                    sharding = getattr(a, "sharding", None)
+                    seen[key] = {
+                        "nbytes": int(getattr(a, "nbytes", 0)),
+                        "devs": [int(getattr(d, "id", 0))
+                                 for d in devs],
+                        "plat": str(getattr(devs[0], "platform",
+                                            default_plat)).lower(),
+                        "shape": list(getattr(a, "shape", ())),
+                        "dtype": str(getattr(a, "dtype", "?")),
+                        "known": id(a) in known,
+                        "replicated": bool(getattr(
+                            sharding, "is_fully_replicated",
+                            len(devs) == 1)),
+                    }
+                elif id(a) in known:
+                    ent["known"] = True
+            for ent in seen.values():
+                nb = ent["nbytes"]
+                platforms[ent["plat"]] = \
+                    platforms.get(ent["plat"], 0) + nb
+                if ent["plat"] != default_plat:
+                    continue  # host-committed: not device residency
+                per = nb if ent["replicated"] \
+                    else max(nb // len(ent["devs"]), 0)
+                for i in ent["devs"]:
+                    truth[f"dev{i}"] = truth.get(f"dev{i}", 0) + per
+                if not ent["known"] and nb:
+                    unknown.append({
+                        "shape": ent["shape"], "dtype": ent["dtype"],
+                        "nbytes": nb,
+                        "device": f"dev{ent['devs'][0]}"})
+            out["platforms"] = {k: platforms[k] for k in sorted(platforms)}
+        total_unattr = 0
+        for dev in sorted(set(truth) | set(attributed)):
+            t = int(truth.get(dev, 0))
+            att = int(attributed.get(dev, 0))
+            unattr = max(t - att, 0)
+            total_unattr += unattr
+            out["devices"][dev] = {
+                "allocator_bytes": t, "attributed_bytes": att,
+                "unattributed_bytes": unattr,
+                # attributed-but-not-allocator-visible (freed on device,
+                # handle still live): the inverse miss, clamped apart
+                "over_attributed_bytes": max(att - t, 0)
+                if dev in truth else 0,
+            }
+        unknown.sort(key=lambda u: -u["nbytes"])
+        out["largest_unknown"] = unknown[:max(int(max_fingerprints), 0)]
+        out["source"] = source
+        out["unattributed_bytes"] = total_unattr
+        REGISTRY.gauge("mem.unattributed_bytes").set(total_unattr)
+        REGISTRY.timing("mem.reconcile").observe(
+            time.perf_counter() - t0)
+        return out
+
+    # ------------------------------------------------------------- audit
+    def audit(self, contract: str, budget_bytes: float,
+              measured_bytes: float, model: str = "default",
+              **evidence: Any) -> bool:
+        """Budget-contract check: did `measured_bytes` of attributed
+        residency break the declared `budget_bytes` ceiling?  Counts
+        `mem.budget_violation{contract=}` and writes a Ledger record
+        with the evidence; returns True on violation.  Never raises —
+        the auditor observes contracts, it does not enforce them (the
+        enforcing sites keep their own raise/demote behaviour)."""
+        if not self._enabled or budget_bytes <= 0:
+            return False
+        if measured_bytes <= budget_bytes:
+            return False
+        REGISTRY.counter("mem.budget_violation",
+                         contract=contract).inc()
+        if LEDGER is not None:
+            try:
+                LEDGER.record(
+                    "memory.budget_violation", model=model,
+                    contract=contract, budget_bytes=int(budget_bytes),
+                    measured_bytes=int(measured_bytes),
+                    overage_bytes=int(measured_bytes - budget_bytes),
+                    **evidence)
+            except Exception:
+                pass
+        return True
+
+    # ------------------------------------------------------ round hooks
+    def on_round(self, t: Optional[float] = None) -> None:
+        """Boundary hook (training round / fleet poll / request batch):
+        feed the leak sentinel the current attributed watermark and, when
+        sinks are attached, emit a `{"ev": "metrics"}` memory point the
+        spool folds into per-process Chrome-trace counter tracks.  Pure
+        host arithmetic — safe at per-round cadence."""
+        if not self._enabled:
+            return
+        gauges: Dict[str, float] = {}
+        total = 0
+        with self._lock:
+            self._drain_locked()
+            for (dev, okey), slot in self._slots.items():
+                gauges[f"mem.{dev}.{okey}"] = float(slot[0])
+                total += slot[0]
+        self._sentinel.observe(total, t=t)
+        if TRACER is not None and TRACER._sinks and gauges:
+            TRACER._emit(make_event("metrics", "memory",
+                                    snapshot={"gauges": gauges}))
+
+    # ---------------------------------------------------- OOM forensics
+    def oom_guard(self, site: str, model: str = "default"):
+        """Context manager for dispatch sites: a RESOURCE_EXHAUSTED (or
+        simulated one) escaping the body dumps the attributed snapshot
+        as an `{"ev": "oom"}` event, then re-raises unchanged."""
+        return _OomGuard(self, site, model)
+
+    def record_oom(self, site: str, exc: BaseException,
+                   model: str = "default") -> Dict[str, Any]:
+        """Build + emit the OOM forensics dump: per-device owner bytes
+        (summing exactly to the ledger snapshot), top owners ranked
+        across devices, and the failing site/error."""
+        snap = self.snapshot()
+        devices: Dict[str, Any] = {}
+        ranked: List[Tuple[int, str]] = []
+        for dev, d in snap["devices"].items():
+            owners = {k: v["bytes"] for k, v in d["owners"].items()}
+            devices[dev] = {"owners": owners,
+                            "attributed_bytes": d["attributed_bytes"]}
+            ranked.extend((b, f"{dev}:{k}") for k, b in owners.items())
+        ranked.sort(key=lambda kv: (-kv[0], kv[1]))
+        rec = make_event(
+            "oom", site, model=model, error=str(exc)[:300],
+            devices=devices,
+            attributed_bytes=sum(d["attributed_bytes"]
+                                 for d in devices.values()),
+            top_owners=[{"owner": o, "bytes": b}
+                        for b, o in ranked[:8]])
+        REGISTRY.counter("mem.oom.dumps").inc()
+        if LEDGER is not None:
+            try:
+                LEDGER.record(
+                    "memory.oom", model=model, site=site,
+                    error=str(exc)[:200],
+                    attributed={d: v["attributed_bytes"]
+                                for d, v in devices.items()})
+            except Exception:
+                pass
+        if TRACER is not None and TRACER._sinks:
+            TRACER._emit(rec)
+        return rec
+
+    # ------------------------------------------------------------ debug
+    def debug_snapshot(self, reconcile: bool = True) -> Dict[str, Any]:
+        """The `/debug/memory` body: attributed snapshot + (optionally)
+        a fresh reconcile against allocator truth."""
+        out = self.snapshot()
+        if reconcile:
+            out["reconcile"] = self.reconcile()
+        return out
+
+    def reset(self) -> None:
+        """Test hook: drop every handle, slot, peak and sentinel point
+        (the REGISTRY gauges are reset separately)."""
+        with self._lock:
+            self._drain_locked()
+            for h in list(self._handles):
+                h.released = True
+            self._handles.clear()
+            self._slots.clear()
+            self._dev_live.clear()
+            self._dev_peak.clear()
+            while True:
+                try:
+                    self._pending.popleft()
+                except IndexError:
+                    break
+        self._sentinel.reset()
+
+
+class _OomGuard:
+    """with-statement shim (a plain class beats contextlib here: the
+    guard is entered on serving hot paths and must cost two attribute
+    stores when nothing raises)."""
+
+    __slots__ = ("_ledger", "_site", "_model")
+
+    def __init__(self, ledger: MemoryLedger, site: str, model: str):
+        self._ledger = ledger
+        self._site = site
+        self._model = model
+
+    def __enter__(self) -> "_OomGuard":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if exc is not None and self._ledger._enabled and is_oom(exc):
+            try:
+                self._ledger.record_oom(self._site, exc,
+                                        model=self._model)
+            except Exception:
+                pass  # forensics must never mask the original error
+        return False  # always re-raise
+
+
+#: The process-global ledger every instrumented allocation reports to.
+MEMLEDGER = MemoryLedger()
+
+
+# -------------------------------------------------------------- render
+def _fmt_mb(b: float) -> str:
+    return f"{b / float(1 << 20):.2f} MB"
+
+
+def render_memory(snap: Dict[str, Any]) -> str:
+    """Fixed-width text rendering of a `/debug/memory` body (or the
+    spool roll-up shaped like one)."""
+    lines = ["memory ledger"
+             + ("" if snap.get("enabled", True) else " (DISABLED)")]
+    rec = snap.get("reconcile") or {}
+    rec_devs = rec.get("devices", {})
+    for dev, d in sorted(snap.get("devices", {}).items()):
+        extra = ""
+        rd = rec_devs.get(dev)
+        if rd:
+            extra = (f", allocator {_fmt_mb(rd['allocator_bytes'])}, "
+                     f"unattributed {_fmt_mb(rd['unattributed_bytes'])}")
+        lines.append(f"  {dev}: attributed "
+                     f"{_fmt_mb(d.get('attributed_bytes', 0))} "
+                     f"(peak {_fmt_mb(d.get('peak_bytes', 0))})"
+                     + extra)
+        owners = d.get("owners", {})
+        for okey, o in sorted(owners.items(),
+                              key=lambda kv: -kv[1]["bytes"]):
+            lines.append(f"    {okey:<40} {_fmt_mb(o['bytes']):>12} "
+                         f"(peak {_fmt_mb(o['peak_bytes'])})")
+    if rec:
+        lines.append(f"  reconcile[{rec.get('source', '?')}]: "
+                     f"unattributed "
+                     f"{_fmt_mb(rec.get('unattributed_bytes', 0))}")
+        for u in rec.get("largest_unknown", []):
+            lines.append(f"    unknown {u['dtype']}{u['shape']} "
+                         f"{_fmt_mb(u['nbytes'])} on {u['device']}")
+    leak = snap.get("leak", {})
+    if leak:
+        lines.append(f"  leak slope: "
+                     f"{leak.get('slope_mb_per_min', 0.0):+.4f} MB/min "
+                     f"({leak.get('samples', 0)} samples)")
+    viol = snap.get("budget_violations", {})
+    if viol:
+        lines.append("  budget violations: "
+                     + ", ".join(f"{k} x{int(v)}"
+                                 for k, v in sorted(viol.items())))
+    else:
+        lines.append("  budget violations: none")
+    lines.append(f"  oom dumps: {int(snap.get('oom_dumps', 0))}")
+    return "\n".join(lines)
+
+
+def _spool_memory_snapshot(spool_dir: str) -> Dict[str, Any]:
+    """Shape a merged spool directory like a `/debug/memory` body: per
+    device/owner PEAK bytes from the folded `mem.*` gauge roll-up
+    (cross-process gauges merge as max — the only reduction that never
+    understates a watermark) plus the oom events verbatim."""
+    from .spool import aggregate
+    agg = aggregate(spool_dir)
+    devices: Dict[str, Any] = {}
+    for name, v in (agg.get("metrics", {}).get("gauges") or {}).items():
+        if not name.startswith("mem.") or name.endswith(".peak_bytes"):
+            continue
+        rest = name[len("mem."):]
+        dev, _, okey = rest.partition(".")
+        if not okey or not (dev.startswith("dev") or dev == "host"):
+            continue
+        if okey in ("attributed_bytes", "attributed_peak_bytes"):
+            continue
+        d = devices.setdefault(dev, {"owners": {},
+                                     "attributed_bytes": 0,
+                                     "peak_bytes": 0})
+        d["owners"][okey] = {"bytes": int(v), "peak_bytes": int(v)}
+        d["attributed_bytes"] += int(v)
+    for name, v in (agg.get("metrics", {}).get("gauges") or {}).items():
+        if name.startswith("mem.") and \
+                name.endswith(".attributed_peak_bytes"):
+            dev = name[len("mem."):-len(".attributed_peak_bytes")]
+            if dev in devices:
+                devices[dev]["peak_bytes"] = int(v)
+    ooms = [e for e in agg.get("events", [])
+            if e.get("ev") == "oom"]
+    return {
+        "spool_dir": agg.get("spool_dir"),
+        "devices": devices,
+        "leak": {"slope_mb_per_min": float(
+            (agg.get("metrics", {}).get("gauges") or {}).get(
+                "mem.leak.slope_mb_per_min", 0.0)),
+            "samples": 0},
+        "budget_violations": {
+            k[len("mem.budget_violation"):] or "total": v
+            for k, v in (agg.get("metrics", {}).get("counters")
+                         or {}).items()
+            if k.startswith("mem.budget_violation")},
+        "oom_dumps": len(ooms),
+        "oom_events": ooms[-4:],
+    }
+
+
+# ----------------------------------------------------------------- CLI
+def main(argv: Optional[List[str]] = None) -> int:
+    """`python -m lightgbm_tpu memory [url | spool-dir] [--json]` —
+    fetch `/debug/memory` from a serving process (default
+    http://127.0.0.1:8080) or fold a telemetry spool directory into the
+    same attributed view."""
+    import urllib.error
+    import urllib.request
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print("usage: python -m lightgbm_tpu memory "
+              "[url | spool-dir] [--json]", file=sys.stderr)
+        return 0
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    target = argv[0] if argv else DEFAULT_URL
+    if os.path.isdir(target):
+        try:
+            snap = _spool_memory_snapshot(target)
+        except (OSError, ValueError) as e:
+            print(f"memory: cannot read spool {target}: {e}",
+                  file=sys.stderr)
+            return 2
+    else:
+        url = target
+        if target.startswith("url="):
+            url = target[len("url="):]
+        if "/debug/memory" not in url:
+            url = url.rstrip("/") + "/debug/memory"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                snap = json.loads(r.read().decode("utf-8"))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            print(f"memory: cannot fetch {url}: {e}", file=sys.stderr)
+            return 2
+    if as_json:
+        print(json.dumps(snap, default=str))
+    else:
+        print(render_memory(snap))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

@@ -484,20 +484,56 @@ def sample_memory(phase: str) -> Optional[Dict[str, Any]]:
             peak += dev_peak
             per_dev.append((str(getattr(d, "id", len(per_dev))), dev_peak))
         if source == "live_arrays":
-            # CPU backend: one process-wide number attributed per device
+            # Fallback: sum live-array nbytes per device — METADATA
+            # only (materializing `addressable_shards[i].data` would
+            # register aliasing views that inflate every later sample).
+            # Arrays committed to a platform other than the default
+            # backend (host-committed staging on a TPU run) are NOT
+            # device residency: they land in the per-platform subtotals
+            # instead of the device totals.  Aliasing views that already
+            # exist are deduped by underlying buffer pointer.
             try:
+                default_plat = str(jax.default_backend()).lower()
                 by_dev: Dict[str, int] = {}
+                platforms = {}
+                seen: set = set()
                 for a in jax.live_arrays():
-                    for sh in a.addressable_shards:
-                        key = str(getattr(sh.device, "id", 0))
-                        by_dev[key] = by_dev.get(key, 0) + int(
-                            getattr(sh.data, "nbytes", 0))
+                    try:
+                        devs = sorted(
+                            a.devices(),
+                            key=lambda d: int(getattr(d, "id", 0)))
+                    except Exception:
+                        continue
+                    if not devs:
+                        continue
+                    try:
+                        key = ("ptr", int(a.unsafe_buffer_pointer()))
+                    except Exception:
+                        key = ("id", id(a))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    nb = int(getattr(a, "nbytes", 0))
+                    plat = str(getattr(devs[0], "platform",
+                                       default_plat)).lower()
+                    platforms[plat] = platforms.get(plat, 0) + nb
+                    if plat != default_plat:
+                        continue
+                    replicated = bool(getattr(
+                        getattr(a, "sharding", None),
+                        "is_fully_replicated", len(devs) == 1))
+                    per = nb if replicated \
+                        else max(nb // len(devs), 0)
+                    for d in devs:
+                        k = str(getattr(d, "id", 0))
+                        by_dev[k] = by_dev.get(k, 0) + per
                 total = peak = sum(by_dev.values())
                 per_dev = sorted(by_dev.items())
             except Exception:
                 total = peak = sum(int(getattr(a, "nbytes", 0))
                                    for a in jax.live_arrays())
                 per_dev = [("0", peak)]
+                platforms = {}
     except Exception:
         return None
     with _mem_lock:
@@ -512,8 +548,13 @@ def sample_memory(phase: str) -> Optional[Dict[str, Any]]:
             g = REGISTRY.gauge(f"mem.dev{dev_id}.peak_bytes")
             if dev_peak > g.value:
                 g.set(dev_peak)
-    return {"phase": phase, "bytes_in_use": total, "peak_bytes": peak,
-            "source": source}
+    out = {"phase": phase, "bytes_in_use": total, "peak_bytes": peak,
+           "source": source}
+    if source == "live_arrays":
+        # per-platform subtotals tag the snapshot (returned, not folded
+        # into the cross-run watermarks: the split is GC-timing noise)
+        out["platforms"] = {k: platforms[k] for k in sorted(platforms)}
+    return out
 
 
 def memory_watermarks() -> Dict[str, Dict[str, Any]]:

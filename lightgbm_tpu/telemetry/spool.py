@@ -63,7 +63,7 @@ except ImportError:  # loaded by file path, outside the package
 
 #: Event kinds the aggregator understands; anything else is counted and
 #: skipped (forward-compat: an older reader meeting a newer writer).
-KNOWN_EV_KINDS = ("span", "event", "metrics", "trace", "spool")
+KNOWN_EV_KINDS = ("span", "event", "metrics", "trace", "spool", "oom")
 
 #: Default spool directory when `telemetry_spool=true` with no
 #: `telemetry_spool_dir` (relative to the process cwd, like every other
@@ -301,8 +301,11 @@ def aggregate(spool_dir: str, keep_events: bool = True) -> Dict[str, Any]:
     `keep_events` is false — /debug/fleet wants the summary, not the
     firehose), `metrics` (the fleet registry roll-up), `collectives`
     (per-device skew + straggler per collective), `straggler` (the
-    fleet-wide `mesh.skew.device`), `stream` (pass attribution), and the
-    `torn_lines` / `unknown_ev` forward-compat counters.
+    fleet-wide `mesh.skew.device`), `stream` (pass attribution),
+    `memory_samples` (timestamped per-owner `mem.*` gauge points from
+    the memory ledger's round hook — the Chrome-trace counter tracks),
+    and the `torn_lines` / `unknown_ev` forward-compat counters.
+    OOM forensics dumps (`{"ev": "oom"}`) ride in `events` verbatim.
     """
     spool_dir = os.path.abspath(spool_dir)
     processes: List[Dict[str, Any]] = []
@@ -310,6 +313,7 @@ def aggregate(spool_dir: str, keep_events: bool = True) -> Dict[str, Any]:
     torn_total = 0
     unknown: Dict[str, int] = {}
     fleet = {"counters": {}, "gauges": {}, "timings": {}, "histograms": {}}
+    mem_samples: List[Dict[str, Any]] = []
     for fn in sorted(os.listdir(spool_dir)):
         if not (fn.startswith("proc-") and fn.endswith(".jsonl")):
             continue
@@ -346,6 +350,15 @@ def aggregate(spool_dir: str, keep_events: bool = True) -> Dict[str, Any]:
             if kind == "metrics" and isinstance(ev.get("snapshot"), dict):
                 snap_count += 1
                 _merge_metrics(fleet, ev["snapshot"])
+                if ev.get("name") == "memory":
+                    # memledger round points: keep the timestamped
+                    # samples too — the fold above only retains the
+                    # cross-process max, but the Chrome-trace counter
+                    # tracks need the series
+                    mem_samples.append(
+                        {"ts": float(ev.get("ts", 0.0) or 0.0),
+                         "_proc": proc_key,
+                         "gauges": ev["snapshot"].get("gauges") or {}})
                 continue
             if kind == "spool":
                 continue
@@ -370,6 +383,8 @@ def aggregate(spool_dir: str, keep_events: bool = True) -> Dict[str, Any]:
         "collectives": collectives,
         "straggler": straggler,
         "stream": _stream_pass_summary(merged),
+        "memory_samples": sorted(mem_samples,
+                                 key=lambda s: (s["ts"], s["_proc"])),
         "torn_lines": torn_total,
         "unknown_ev": unknown,
         "n_events": len(merged),
@@ -383,11 +398,15 @@ def aggregate(spool_dir: str, keep_events: bool = True) -> Dict[str, Any]:
 def chrome_trace(agg: Dict[str, Any]) -> Dict[str, Any]:
     """Render an `aggregate()` result as Chrome-trace (catapult) JSON:
     one trace process per spool process, spans as complete (`ph: "X"`)
-    events, point events as instants — loadable by chrome://tracing and
-    Perfetto.  Timestamps are µs relative to the earliest merged event
-    (absolute epoch seconds overflow the viewer's float precision)."""
+    events, point events as instants, memory-ledger round points as
+    per-device counter (`ph: "C"`) tracks and OOM dumps as global
+    instants — loadable by chrome://tracing and Perfetto.  Timestamps
+    are µs relative to the earliest merged event (absolute epoch
+    seconds overflow the viewer's float precision)."""
     events = agg.get("events") or []
-    t0 = min((float(e.get("ts", 0.0) or 0.0) for e in events),
+    mem_samples = agg.get("memory_samples") or []
+    t0 = min((float(e.get("ts", 0.0) or 0.0)
+              for e in list(events) + list(mem_samples)),
              default=0.0)
     trace: List[Dict[str, Any]] = []
     pids: Dict[str, int] = {}
@@ -419,6 +438,30 @@ def chrome_trace(agg: Dict[str, Any]) -> Dict[str, Any]:
             trace.append({"name": ev.get("name", "?"), "ph": "i",
                           "ts": round(us, 3), "s": "p",
                           "pid": pid, "tid": 0, "args": args})
+        elif kind == "oom":
+            # forensics dump: a GLOBAL instant (full-height line in the
+            # viewer) carrying the attributed per-owner snapshot
+            args = {k: v for k, v in ev.items()
+                    if k not in ("ev", "name", "ts", "_proc")}
+            trace.append({"name": f"OOM {ev.get('name', '?')}",
+                          "ph": "i", "ts": round(us, 3), "s": "g",
+                          "pid": pid, "tid": 0, "args": args})
+    for s in mem_samples:
+        pid = pids.get(s.get("_proc", ""), len(pids))
+        us = (float(s.get("ts", 0.0) or 0.0) - t0) * 1e6
+        per_dev: Dict[str, Dict[str, float]] = {}
+        for name, v in (s.get("gauges") or {}).items():
+            if not name.startswith("mem."):
+                continue
+            dev, _, okey = name[len("mem."):].partition(".")
+            if okey:
+                per_dev.setdefault(dev, {})[okey] = round(
+                    float(v) / (1 << 20), 3)
+        for dev, series in sorted(per_dev.items()):
+            # one stacked counter track per device, series per owner
+            trace.append({"name": f"mem.{dev} (MB)", "ph": "C",
+                          "ts": round(us, 3), "pid": pid,
+                          "args": series})
     return {"traceEvents": trace, "displayTimeUnit": "ms",
             "otherData": {"spool_dir": agg.get("spool_dir", ""),
                           "epoch_t0": t0}}

@@ -452,6 +452,15 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "flight_recorder": (False, "bool", ()),
     # ring size: how many most-recent rounds flight_summary() aggregates
     "flight_recorder_depth": (128, "int", ()),
+    # device-memory ledger (telemetry/memledger.py): attributed per-
+    # device HBM accounting — owner-tagged gauges (mem.dev<i>.<owner>),
+    # budget-contract auditing, the leak sentinel and OOM forensics.
+    # Weakref-tracked and sync-free: models and predictions are byte-
+    # identical with it on or off (tests/test_memledger.py)
+    "memory_ledger": (True, "bool", ()),
+    # background reconcile cadence vs allocator truth (publishes
+    # mem.unattributed_bytes); 0 = only on demand (/debug/memory, CLI)
+    "memory_reconcile_ms": (0.0, "float", ()),
     # perf-regression sentinel tolerances (`telemetry diff`, run by
     # scripts/run_ci.sh against telemetry_baseline.json): relative
     # tolerance for counter/shape metrics and for wall-clock metrics.

@@ -314,9 +314,11 @@ EOF
 # streaming smoke (ISSUE 15): the same 4x-over-budget dataset with
 # streaming_train at its "auto" default must ENGAGE the shard-streamed
 # engine (the bin matrix never materializes on device), stay
-# byte-identical to the in-memory model, and keep device bin residency
-# (stream.peak_device_mb — the double-buffered shard staging) inside
-# the budget the assembled matrix would blow through
+# byte-identical to the in-memory model, and keep the budget-governed
+# staging slice (stream.peak_staging_mb — the double-buffered shard
+# staging) inside the budget the assembled matrix would blow through.
+# stream.peak_device_mb is the FULL device watermark (staging plus
+# resident score/histogram state) and so only bounds staging from above
 JAX_PLATFORMS=cpu python - <<'EOF'
 import numpy as np
 import lightgbm_tpu as lgb
@@ -341,12 +343,14 @@ snap = REGISTRY.snapshot()
 passes = snap["counters"].get("stream.shard_passes", 0)
 assert passes > 0, "streaming_train=auto did not engage on over-budget"
 g = snap["gauges"]
-assert 0 < g["stream.peak_device_mb"] <= budget_mb, \
-    f"device staging held {g['stream.peak_device_mb']} MB > {budget_mb} MB"
+assert 0 < g["stream.peak_staging_mb"] <= budget_mb, \
+    f"device staging held {g['stream.peak_staging_mb']} MB > {budget_mb} MB"
+assert g["stream.peak_device_mb"] >= g["stream.peak_staging_mb"], g
 assert g["datastore.peak_resident_mb"] <= budget_mb, g
 print(f"[run_ci] streaming smoke: byte parity over {int(passes)} shard "
-      f"passes, peak device {g['stream.peak_device_mb']} MB <= "
-      f"{budget_mb} MB budget")
+      f"passes, peak staging {g['stream.peak_staging_mb']} MB <= "
+      f"{budget_mb} MB budget (full device watermark "
+      f"{g['stream.peak_device_mb']} MB)")
 EOF
 
 # spool smoke (ISSUE 16): streamed training plus one served predict with
@@ -411,6 +415,72 @@ print(f"[run_ci] spool smoke: timeline over "
       f"passes, attributed {stream['attributed_s']:.3f}s <= wall "
       f"{stream['wall_s']:.3f}s, chrome trace "
       f"{len(trace['traceEvents'])} events")
+EOF
+
+# memory smoke (ISSUE 18): train + serve with the device-memory ledger
+# armed, then hold the attribution contract end to end — the per-owner
+# bytes on /debug/memory must cover the allocator watermark to within
+# the 5% acceptance bound, zero budget-contract violations on a clean
+# run, and the jax-free `memory` CLI must render the same snapshot
+# from the live URL with rc 0.  The register/release/reconcile matrix,
+# leak-slope oracle, doctored violations and OOM forensics live in
+# tests/test_memledger.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.serving import ServingClient
+from lightgbm_tpu.serving.http import make_server
+
+rng = np.random.default_rng(13)
+X = rng.standard_normal((2000, 16))
+y = (X[:, 0] - X[:, 2] + 0.1 * rng.standard_normal(2000) > 0).astype(float)
+bst = lgb.train({"objective": "binary", "num_leaves": 15, "verbosity": -1,
+                 "min_data_in_leaf": 20, "memory_ledger": True},
+                lgb.Dataset(X, label=y), num_boost_round=4)
+client = ServingClient(bst, params={"serve_warmup": False})
+client.predict(X[:64])
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+snap = json.loads(urllib.request.urlopen(
+    f"http://127.0.0.1:{port}/debug/memory", timeout=60).read())
+assert snap["enabled"], "ledger not armed"
+dev = snap["devices"]["dev0"]
+owners = dev["owners"]
+assert any(k.startswith("train.bins") for k in owners), owners.keys()
+assert any(k.startswith("serve.") for k in owners), owners.keys()
+assert sum(o["bytes"] for o in owners.values()) == dev["attributed_bytes"]
+rec = snap["reconcile"]
+if rec.get("source") != "unavailable":
+    alloc = rec["devices"]["dev0"]["allocator_bytes"]
+    assert rec["unattributed_bytes"] <= max(0.05 * alloc, 64), \
+        (f"{rec['unattributed_bytes']}B of {alloc}B unattributed "
+         f"> 5% bound; unknowns: {rec['largest_unknown']}")
+viol = snap.get("budget_violations") or {}
+assert not any(viol.values()), f"clean run counted violations: {viol}"
+assert snap.get("oom_dumps", 0) == 0, snap["oom_dumps"]
+
+r = subprocess.run([sys.executable, "-m", "lightgbm_tpu", "memory",
+                    f"http://127.0.0.1:{port}"],
+                   capture_output=True, text=True)
+assert r.returncode == 0, r.stderr[-2000:]
+assert "train.bins" in r.stdout, r.stdout[-2000:]
+srv.shutdown()
+srv.server_close()
+client.close()
+unattr = rec.get("unattributed_bytes", 0)
+print(f"[run_ci] memory smoke: {len(owners)} owners cover "
+      f"{dev['attributed_bytes']}B attributed, {unattr}B unattributed "
+      f"({rec.get('source')}), zero violations, memory CLI rc 0")
 EOF
 
 # mesh smoke (PR 10): distributed training + sharded serving on the

@@ -142,6 +142,18 @@ def build_snapshot(rounds: int, rel_tol: float,
         fclient.close()
     finally:
         shutil.rmtree(fdir, ignore_errors=True)
+    # memory segment (ISSUE 18): reconcile the device-memory ledger
+    # against allocator truth so the baseline carries
+    # mem.unattributed_bytes (up_is_bad — attribution rot fails the
+    # gate) next to the live mem.dev0.* owner gauges the earlier
+    # segments published (ignore-class workload bookkeeping).  The
+    # gc.collect() first retires every dead segment's arrays so the
+    # live_arrays truth source on CPU sees only deterministic
+    # survivors, not cycle-held garbage with scheduler-dependent
+    # lifetimes
+    import gc
+    gc.collect()
+    telemetry.MEMLEDGER.reconcile()
     return {
         "backend": jax.devices()[0].platform,
         "sentinel": {"rel_tol": float(bst.config.telemetry_diff_rel_tol),

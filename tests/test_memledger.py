@@ -1,0 +1,436 @@
+"""Device-memory ledger (ISSUE 18): attributed HBM accounting, budget
+contracts, leak sentinel, OOM forensics.
+
+The load-bearing claims:
+
+* ATTRIBUTION — `register`/`assign`/`release` keep the per-(device,
+  owner) gauges exact through rebinds and weakref-observed frees, and
+  `reconcile()` against allocator truth finds exactly the buffers the
+  ledger was never told about.
+* SENTINEL oracle — the Theil-Sen slope reads ~0 on a flat series AND
+  on a healthy allocator sawtooth, and recovers the injected slope of
+  a genuine monotone leak (the mean-based fit fails the sawtooth).
+* BUDGET auditor — a doctored over-budget measurement counts
+  `mem.budget_violation{contract=}` and writes a Ledger record with
+  the evidence, without touching live serving.
+* OOM forensics — an injected RESOURCE_EXHAUSTED at a
+  `serve.dispatch.*` site emits an `{"ev": "oom"}` dump whose
+  per-owner bytes sum exactly to the ledger snapshot, and the error
+  still degrades through the resilience ladder byte-identically.
+* IDENTITY — models and predictions are byte-identical with the
+  ledger on or off (the ledger observes, it never syncs).
+* SATELLITES — `ServingRuntime.device_bytes()` = pinned planes +
+  staging (the registry's admit unit), streamed training's device
+  watermark includes the resident O(N) state on top of the staging
+  window, and `sample_memory` reports per-platform subtotals.
+"""
+import gc
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.resilience import FAULTS, FaultInjected, FaultSpec
+from lightgbm_tpu.serving import ModelRegistry, ServingRuntime
+from lightgbm_tpu.telemetry.memledger import (LeakSentinel, MEMLEDGER,
+                                              is_oom, render_memory)
+
+pytestmark = pytest.mark.quick
+
+MB = 1 << 20
+
+
+@pytest.fixture(autouse=True)
+def _armed_ledger():
+    """Every test starts from an enabled, empty ledger and leaves no
+    handles behind for its neighbours."""
+    MEMLEDGER.configure(enabled=True, reconcile_ms=0.0)
+    MEMLEDGER.reset()
+    yield
+    MEMLEDGER.reset()
+    MEMLEDGER.configure(enabled=True, reconcile_ms=0.0)
+
+
+def _train(n=400, f=8, rounds=4, seed=3, **extra):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, f).astype(np.float32)
+    y = (X[:, 0] + rng.randn(n) * 0.5 > 0).astype(np.float64)
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 6,
+              **extra}
+    bst = Booster(params=params, train_set=lgb.Dataset(X, label=y))
+    bst.update_many(rounds)
+    return bst, X
+
+
+def _strip(model_text):
+    return "\n".join(l for l in model_text.splitlines()
+                     if not l.startswith("["))
+
+
+def _owner_bytes(snap, dev, owner):
+    return snap["devices"].get(dev, {}).get("owners", {}) \
+        .get(owner, {}).get("bytes", 0)
+
+
+# ---------------------------------------------------------- attribution
+def test_register_release_reconcile_matrix():
+    # synthetic entries: exact arithmetic through register -> release
+    h1 = MEMLEDGER.register("t.alpha", nbytes=3 * MB, device="dev0")
+    h2 = MEMLEDGER.register("t.alpha", nbytes=1 * MB, device="dev0")
+    h3 = MEMLEDGER.register("t.beta", nbytes=2 * MB, device="dev1",
+                            rung="x")
+    snap = MEMLEDGER.snapshot()
+    assert _owner_bytes(snap, "dev0", "t.alpha") == 4 * MB
+    assert _owner_bytes(snap, "dev1", "t.beta{rung=x}") == 2 * MB
+    assert snap["devices"]["dev0"]["attributed_bytes"] == 4 * MB
+
+    h1.release()
+    h1.release()                                   # idempotent
+    snap = MEMLEDGER.snapshot()
+    assert _owner_bytes(snap, "dev0", "t.alpha") == 1 * MB
+    assert snap["devices"]["dev0"]["peak_bytes"] == 4 * MB  # high-water
+
+    # assign replaces exactly (owner, labels) — the rebind primitive
+    MEMLEDGER.assign("t.alpha", [])
+    snap = MEMLEDGER.snapshot()
+    assert _owner_bytes(snap, "dev0", "t.alpha") == 0
+    assert _owner_bytes(snap, "dev1", "t.beta{rung=x}") == 2 * MB
+    h2.release()                                   # already assigned away
+    h3.release()
+    assert MEMLEDGER.snapshot()["devices"]["dev1"]["owners"][
+        "t.beta{rung=x}"]["bytes"] == 0
+
+
+def test_weakref_free_observed_without_explicit_release():
+    import jax.numpy as jnp
+    a = jnp.arange(4096, dtype=jnp.float32)
+    MEMLEDGER.register("t.weak", a)
+    assert _owner_bytes(MEMLEDGER.snapshot(), "dev0", "t.weak") == 16384
+    del a
+    gc.collect()
+    assert _owner_bytes(MEMLEDGER.snapshot(), "dev0", "t.weak") == 0
+
+
+def test_reconcile_finds_unregistered_arrays():
+    import jax.numpy as jnp
+    known = jnp.arange(2048, dtype=jnp.float32)   # 8192 B, attributed
+    MEMLEDGER.register("t.known", known)
+    stray = jnp.arange(1024, dtype=jnp.float32) + 1   # 4096 B, unknown
+    gc.collect()
+    # a full-suite process carries other tests' live buffers, so ask
+    # for enough fingerprints that the stray can't be crowded out of
+    # the largest-N window by unrelated survivors
+    rec = MEMLEDGER.reconcile(max_fingerprints=256)
+    assert rec["unattributed_bytes"] >= stray.nbytes
+    fp = [u for u in rec["largest_unknown"] if u["nbytes"] == stray.nbytes]
+    assert fp, "stray allocation missing from the unknown fingerprints"
+    del known, stray
+
+
+def test_disabled_ledger_is_inert():
+    MEMLEDGER.configure(enabled=False)
+    h = MEMLEDGER.register("t.off", nbytes=MB, device="dev0")
+    h.release()
+    assert MEMLEDGER.assign("t.off", []) == []
+    assert not MEMLEDGER.audit("datastore_budget_mb", 1.0, 2.0)
+    assert MEMLEDGER.snapshot()["devices"] == {}
+
+
+# ------------------------------------------------------- leak sentinel
+def test_leak_slope_oracle_flat_linear_sawtooth():
+    flat = LeakSentinel()
+    for i in range(60):
+        flat.observe(100 * MB, t=float(i))
+    assert abs(flat.slope_mb_per_min()) < 0.01
+
+    leak = LeakSentinel()        # +2 MB per minute, injected exactly
+    for i in range(60):          # t in seconds, one point per minute
+        leak.observe(100 * MB + i * 2 * MB, t=float(i) * 60.0)
+    assert leak.slope_mb_per_min() == pytest.approx(2.0, rel=1e-6)
+
+    saw = LeakSentinel()         # healthy alloc/free cycle, flat base
+    for i in range(60):
+        saw.observe(100 * MB + (i % 6) * 10 * MB, t=float(i) * 60.0)
+    assert abs(saw.slope_mb_per_min()) < 0.05, \
+        "sawtooth must not read as a leak (Theil-Sen median property)"
+
+
+# ------------------------------------------------------ budget auditor
+def test_budget_auditor_doctored_violation():
+    c = telemetry.REGISTRY.counter("mem.budget_violation",
+                                   contract="serve_vram_budget_mb")
+    v0 = c.value
+    n0 = len(telemetry.LEDGER.records())
+    assert not MEMLEDGER.audit("serve_vram_budget_mb", 8 * MB, 7 * MB,
+                               model="m")
+    assert c.value == v0
+    assert MEMLEDGER.audit("serve_vram_budget_mb", 8 * MB, 9 * MB,
+                           model="m", site="test.doctored")
+    assert c.value == v0 + 1
+    recs = [r for r in telemetry.LEDGER.records()[n0:]
+            if r.get("name") == "memory.budget_violation"]
+    assert recs and recs[-1]["contract"] == "serve_vram_budget_mb"
+    assert recs[-1]["overage_bytes"] == 1 * MB
+    # budget <= 0 disables the contract, never divides by it
+    assert not MEMLEDGER.audit("serve_vram_budget_mb", 0, 9 * MB)
+
+
+# ------------------------------------------------------- OOM forensics
+def test_is_oom_matches_status_texts():
+    assert is_oom(RuntimeError("RESOURCE_EXHAUSTED: while allocating"))
+    assert is_oom(RuntimeError("tpu OutOfMemory on core 0"))
+    assert is_oom(MemoryError("out of memory"))
+    assert not is_oom(ValueError("shape mismatch"))
+
+
+def test_oom_dump_at_serve_dispatch(tmp_path):
+    bst, X = _train()
+    rt = ServingRuntime(bst, name="oomtest")
+    want = rt.predict(X[:16])
+    sink = str(tmp_path / "events.jsonl")
+    telemetry.TRACER.attach_jsonl(sink)
+    dumps = telemetry.REGISTRY.counter("mem.oom.dumps")
+    d0 = dumps.value
+    FAULTS.arm(FaultSpec("serve.dispatch.*", "error",
+                         arg="RESOURCE_EXHAUSTED: out of memory "
+                             "while allocating 1.21GB"))
+    try:
+        # the ladder degrades through the fault — responses stay
+        # byte-identical (the dump is forensics, not error handling)
+        got = rt.predict(X[:16])
+    finally:
+        FAULTS.disarm()
+        telemetry.TRACER.flush()
+        telemetry.TRACER.clear_sinks()
+    assert np.array_equal(got, want)
+    assert dumps.value > d0
+    ooms = [json.loads(l) for l in open(sink)
+            if json.loads(l).get("ev") == "oom"]
+    assert ooms, "no {'ev': 'oom'} dump in the event stream"
+    ev = ooms[0]
+    assert ev["name"].startswith("serve.dispatch.")
+    assert "RESOURCE_EXHAUSTED" in ev["error"]
+    # the acceptance identity: per-owner bytes sum to the snapshot
+    for dev, d in ev["devices"].items():
+        assert sum(d["owners"].values()) == d["attributed_bytes"]
+    assert ev["attributed_bytes"] == \
+        sum(d["attributed_bytes"] for d in ev["devices"].values())
+    assert ev["top_owners"] == sorted(
+        ev["top_owners"], key=lambda o: -o["bytes"])
+
+
+def test_oom_guard_reraises_and_ignores_non_oom():
+    with pytest.raises(FaultInjected):
+        FAULTS.arm(FaultSpec("t.site", "error",
+                             arg="RESOURCE_EXHAUSTED: boom"))
+        try:
+            with MEMLEDGER.oom_guard("t.site"):
+                FAULTS.inject("t.site")
+        finally:
+            FAULTS.disarm()
+    d0 = telemetry.REGISTRY.counter("mem.oom.dumps").value
+    with pytest.raises(ValueError):
+        with MEMLEDGER.oom_guard("t.site2"):
+            raise ValueError("not an oom")
+    assert telemetry.REGISTRY.counter("mem.oom.dumps").value == d0
+
+
+# ----------------------------------------------------------- identity
+def test_models_byte_identical_ledger_on_off():
+    bst_on, X = _train(memory_ledger=True)
+    pred_on = bst_on.predict(X)
+    MEMLEDGER.reset()
+    bst_off, _ = _train(memory_ledger=False)
+    pred_off = bst_off.predict(X)
+    assert _strip(bst_on.model_to_string()) == \
+        _strip(bst_off.model_to_string())
+    assert np.array_equal(pred_on, pred_off)
+    # and the off-run attributed nothing
+    assert MEMLEDGER.snapshot()["devices"] == {}
+
+
+def test_training_attribution_covers_allocator():
+    # Other tests in this process leave live buffers behind (pytest
+    # fixtures, jit constant caches) that the allocator sees but this
+    # run never owned — so assert on the *delta* training adds, which
+    # is what the ISSUE's <=5% acceptance bound measures end to end.
+    gc.collect()
+    pre = MEMLEDGER.reconcile()
+    if pre.get("source") == "unavailable":
+        pytest.skip("no allocator truth on this backend")
+    _bst, _X = _train(rounds=5)
+    snap = MEMLEDGER.debug_snapshot()
+    dev = snap["devices"].get("dev0", {})
+    owners = dev.get("owners", {})
+    assert any(k.startswith("train.bins") for k in owners)
+    assert any(k.startswith("train.scores") for k in owners)
+    rec = snap["reconcile"]
+    alloc_delta = (rec["devices"].get("dev0", {}).get("allocator_bytes", 0)
+                   - pre["devices"].get("dev0", {}).get("allocator_bytes", 0))
+    unattr_delta = rec["unattributed_bytes"] - pre["unattributed_bytes"]
+    assert unattr_delta <= max(0.05 * max(alloc_delta, 0), 256), \
+        f"training added {unattr_delta}B unattributed of {alloc_delta}B"
+
+
+# ------------------------------------------------- serving satellites
+def test_device_bytes_and_staging_attribution():
+    bst, X = _train()
+    rt = ServingRuntime(bst, name="sat3")
+    # the admission unit is the pinned planes — staging is accounted
+    # separately so workload shape can't flip an admit decision
+    assert rt.device_bytes() == rt._plane_bytes()
+    s0 = rt.staging_bytes()
+    rt.predict(X[:48])            # allocates a (bucket, width) buffer
+    assert rt.staging_bytes() > 0 and rt.staging_bytes() >= s0
+    assert rt.device_bytes() == rt._plane_bytes()
+    # attribution mirrors the accounting: planes + staging owner keys
+    snap = MEMLEDGER.snapshot()
+    owners = {k for d in snap["devices"].values() for k in d["owners"]}
+    assert any(k.startswith("serve.sat3.planes") for k in owners)
+    assert any(k.startswith("serve.sat3.staging") for k in owners)
+    freed = rt.demote()
+    assert freed > 0 and rt._plane_bytes() == 0
+    assert rt.device_bytes() == 0 and rt.staging_bytes() > 0, \
+        "staging survives demotion without re-entering the admit unit"
+
+
+def test_admit_decision_unchanged_modulo_staging():
+    # the registry admits on device_bytes() == plane bytes; neither the
+    # ledger riding along nor the staging buffers a traffic mix grows
+    # may flip an admit decision that plane bytes alone would have made
+    bst, X = _train()
+    probe = ServingRuntime(bst, name="probe")
+    probe.predict(X[:16])
+    assert probe.staging_bytes() > 0       # staging exists and is NOT
+    need = probe.device_bytes()            # part of the admit unit
+    probe._ledger_release()
+    reg = ModelRegistry(params={"serve_vram_budget_mb":
+                                (2 * need + MB) / MB})
+    try:
+        reg.load("a", bst)
+        reg.load("b", bst)
+        assert set(reg.names()) == {"a", "b"}
+        v0 = telemetry.REGISTRY.counter(
+            "mem.budget_violation", contract="serve_vram_budget_mb").value
+        got = reg.predict(X[:16], model="a")
+        assert np.array_equal(got, bst.predict(X[:16]))
+        assert telemetry.REGISTRY.counter(
+            "mem.budget_violation",
+            contract="serve_vram_budget_mb").value == v0, \
+            "an in-budget fleet must not count a violation"
+    finally:
+        reg.close()
+
+
+def test_registry_close_releases_serve_attribution():
+    bst, X = _train()
+    reg = ModelRegistry()
+    try:
+        reg.load("gone", bst)
+        reg.predict(X[:8], model="gone")
+        snap = MEMLEDGER.snapshot()
+        live = sum(_owner_bytes(snap, dev, k)
+                   for dev, d in snap["devices"].items()
+                   for k in d["owners"] if k.startswith("serve.gone."))
+        assert live > 0
+    finally:
+        reg.close()
+    snap = MEMLEDGER.snapshot()
+    live = sum(_owner_bytes(snap, dev, k)
+               for dev, d in snap["devices"].items()
+               for k in d["owners"] if k.startswith("serve.gone."))
+    assert live == 0, "closed model still attributed"
+
+
+# ------------------------------------------------ streaming satellite
+def test_streaming_peak_includes_resident_state():
+    gd = telemetry.REGISTRY.gauge("stream.peak_device_mb")
+    gs = telemetry.REGISTRY.gauge("stream.peak_staging_mb")
+    gd.set(0.0)
+    gs.set(0.0)
+    _train(n=3000, f=10, rounds=2, external_memory=True,
+           streaming_train="on", datastore_shard_rows=512)
+    assert gs.value > 0
+    assert gd.value >= gs.value, \
+        "device watermark must include resident O(N) state on top of " \
+        "the staging window"
+    snap = MEMLEDGER.snapshot()
+    owners = {k for d in snap["devices"].values() for k in d["owners"]}
+    assert "stream.staging" in owners
+    assert "train.hist_carry" in owners
+
+
+# --------------------------------------------------- debug surfaces
+def test_debug_snapshot_and_render():
+    MEMLEDGER.register("t.render", nbytes=5 * MB, device="dev0")
+    snap = MEMLEDGER.debug_snapshot()
+    assert snap["enabled"] and "reconcile" in snap
+    text = render_memory(snap)
+    assert "t.render" in text and "budget violations" in text
+    json.dumps(snap)                      # must be JSON-serializable
+
+
+def test_memory_cli_on_spool_dir(tmp_path, capsys):
+    from lightgbm_tpu.telemetry.memledger import main as memory_main
+    from lightgbm_tpu.telemetry.spool import SpoolSink
+    spool = str(tmp_path / "spool")
+    sink = SpoolSink(spool, role="test")
+    telemetry.TRACER.add_sink(sink)
+    try:
+        MEMLEDGER.register("t.cli", nbytes=3 * MB, device="dev0")
+        MEMLEDGER.on_round()
+        try:
+            with MEMLEDGER.oom_guard("t.cli.site"):
+                raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        except RuntimeError:
+            pass
+        telemetry.TRACER.emit_metrics_snapshot()
+        telemetry.TRACER.flush()
+    finally:
+        telemetry.TRACER.remove_sink(sink)
+    assert memory_main([spool, "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["oom_dumps"] >= 1
+    assert any(k.startswith("t.cli") for d in out["devices"].values()
+               for k in d["owners"]), out
+    assert memory_main([spool]) == 0      # text rendering exits 0 too
+
+
+def test_spool_chrome_trace_memory_counters(tmp_path):
+    from lightgbm_tpu.telemetry.spool import (SpoolSink, aggregate,
+                                              chrome_trace)
+    spool = str(tmp_path / "spool")
+    sink = SpoolSink(spool, role="test")
+    telemetry.TRACER.add_sink(sink)
+    try:
+        MEMLEDGER.register("t.trace", nbytes=2 * MB, device="dev0")
+        MEMLEDGER.on_round()
+        telemetry.TRACER.flush()
+    finally:
+        telemetry.TRACER.remove_sink(sink)
+    agg = aggregate(spool)
+    assert agg["memory_samples"], "round hook sample missing from spool"
+    tr = chrome_trace(agg)
+    counters = [e for e in tr["traceEvents"] if e.get("ph") == "C"]
+    assert counters and any("t.trace" in e["args"]
+                            for e in counters), counters
+
+
+# ----------------------------------------------- recorder satellite
+def test_sample_memory_platform_subtotals():
+    from lightgbm_tpu.telemetry.recorder import sample_memory
+    _train(rounds=1)
+    out = sample_memory("test_phase")
+    if not out:
+        pytest.skip("no memory sampling source on this backend")
+    if out.get("source") != "live_arrays":
+        pytest.skip("allocator memory_stats available — the "
+                    "per-platform fallback split does not engage")
+    assert "platforms" in out and out["platforms"], out
+    # platforms cover every live buffer; the device total counts only
+    # the default backend's share
+    assert sum(out["platforms"].values()) >= out["peak_bytes"], out

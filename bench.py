@@ -807,14 +807,16 @@ def _run_worker() -> None:
             # whether the parity probe actually enabled the rung —
             # diff.py fails hard if it flips back to 0, so the slot
             # path cannot silently return
-            def _rung_bench(mode, rows, n_iters, compiled="off"):
+            def _rung_bench(mode, rows, n_iters, compiled="off",
+                            precision="exact"):
                 Xr = X_eval
                 if len(Xr) < rows:
                     Xr = np.tile(Xr, (-(-rows // max(len(Xr), 1)), 1))
                 Xr = np.ascontiguousarray(Xr[:rows], np.float64)
                 c = ServingClient(bst, params={
                     "serve_max_wait_ms": 0.0, "serve_device_sum": mode,
-                    "serve_compiled": compiled})
+                    "serve_compiled": compiled,
+                    "serve_precision": precision})
                 rt = c.registry.get().runtime
                 d2h = telemetry.REGISTRY.counter("serve.d2h_bytes")
                 d2h0 = d2h.value
@@ -828,7 +830,30 @@ def _run_worker() -> None:
                 rtotal = time.time() - t_rall
                 d2h_bytes = d2h.value - d2h0
                 extra = {}
-                if compiled != "off":
+                if precision == "bounded":
+                    # the bounded rung's whole story in one block: is it
+                    # serving (active), what it costs in HBM vs the exact
+                    # compiled planes (plane_bytes/exact_plane_bytes), and
+                    # how much error headroom is left (error_ratio =
+                    # measured / published — diff.py fails HARD when this
+                    # climbs, the probe disables the rung past 1.0)
+                    active = bool(getattr(rt, "bounded_active", False))
+                    st = getattr(rt, "_state", None)
+                    if st is not None and st.bounded_planes is not None:
+                        extra["plane_bytes"] = sum(
+                            int(a.nbytes) for a in st.bounded_planes)
+                    if st is not None and st.plan_planes is not None:
+                        extra["exact_plane_bytes"] = sum(
+                            int(a.nbytes) for bucket in st.plan_planes
+                            for a in bucket if a is not None)
+                    bound = getattr(rt, "bounded_bound", None)
+                    meas = getattr(rt, "bounded_measured_error", None)
+                    if bound:
+                        extra["bound"] = bound
+                        extra["measured_max_abs_error"] = meas
+                        extra["error_ratio"] = round(
+                            (meas or 0.0) / bound, 6)
+                elif compiled != "off":
                     active = bool(getattr(rt, "compiled_active", False))
                     plan = getattr(rt, "_plan", None)
                     if plan is not None:
@@ -858,6 +883,15 @@ def _run_worker() -> None:
             # diff.py sentinel catch the probe flipping it back off
             blk["compiled"] = _rung_bench("off", rung_rows, rung_iters,
                                           compiled="on")
+            # the bounded precision tier (serve_precision=bounded) over
+            # the same compiled planes: int8 leaf codes + int32
+            # accumulation instead of the software-f64 adder.  The block
+            # records plane_bytes next to the exact compiled planes'
+            # bytes (the ~4x cut is the tier's claim) and the measured
+            # vs published error ratio the probe enforced at refresh
+            blk["bounded"] = _rung_bench("off", rung_rows, rung_iters,
+                                         compiled="on",
+                                         precision="bounded")
             blk["device_sum"] = _rung_bench("auto", rung_rows, rung_iters)
             slot = _rung_bench("off", rung_rows, rung_iters)
             slot.pop("active")
@@ -1010,6 +1044,12 @@ def _run_worker() -> None:
                  f"{blk['device_sum']['d2h_bytes_per_row']} B/row D2H) "
                  f"vs slot {slot['rows_per_sec']:,.0f} rows/s "
                  f"({slot['d2h_bytes_per_row']} B/row D2H)")
+            _log(f"bounded rung: "
+                 f"{blk['bounded']['rows_per_sec']:,.0f} rows/s "
+                 f"(active={blk['bounded']['active']}, "
+                 f"{blk['bounded'].get('plane_bytes', 0)} B planes vs "
+                 f"{blk['bounded'].get('exact_plane_bytes', 0)} B exact, "
+                 f"error_ratio {blk['bounded'].get('error_ratio')})")
             _log(f"serving bench: p50 {blk['p50_ms']} ms, "
                  f"p99 {blk['p99_ms']} ms, "
                  f"{blk['rows_per_sec']:,.0f} rows/s "

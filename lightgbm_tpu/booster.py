@@ -455,6 +455,7 @@ class Booster:
             max_cat_threshold=self.config.max_cat_threshold,
             max_cat_to_onehot=self.config.max_cat_to_onehot,
             hist_impl=self._resolve_hist_impl(),
+            hist_interpret=bool(self.config.hist_interpret),
             bundled=self._dd.efb is not None,
             bundle_max_bin=self._dd.efb.max_bin
             if self._dd.efb is not None else 0,
@@ -877,21 +878,26 @@ class Booster:
                 from .parallel.learner import padded_feature_count
                 pc = padded_feature_count(pc, s_last)
             if not probe_cached(pb, pc, multi=True, width=w,
-                                quantized=spec.hist_impl == "pallas_q"):
+                                quantized=spec.hist_impl == "pallas_q",
+                                interpret=spec.hist_interpret):
                 reasons.append("a failing multi-leaf Pallas kernel probe "
                                "on this backend")
         if reasons:
             # priced downgrade (VERDICT r4 #4): strict measured 2.1x
             # slower than the wave AUC-parity config on TPU at the 2M
-            # bench shape (1.4 vs 2.96 rounds/s, PROFILE.md r3c) — tell
-            # users what the fallback costs, not just that it happened
+            # bench shape (1.4 vs 2.96 rounds/s, PROFILE.md r3c); under
+            # the default int-lattice histograms the wave gains the
+            # ~1.8x kernel speedup while strict (gather-dominated)
+            # barely moves, widening the ceiling toward ~4x — see the
+            # COVERAGE.md r7 repricing note
             telemetry.REGISTRY.counter("fallback.events").inc()
             telemetry.event("fallback.wave_downgrade", reasons=reasons)
             log.warning("tree_grow_policy=wave is not supported with "
                         + "; ".join(reasons)
                         + " — using the strict leafwise policy (expect "
-                        "roughly 2-3x lower training throughput on TPU; "
-                        "PROFILE.md r3c)")
+                        "roughly 2-4x lower training throughput on TPU "
+                        "under the default quantized histograms; "
+                        "PROFILE.md r3c, COVERAGE.md r7)")
             return "leafwise"
         return "wave"
 
@@ -911,36 +917,118 @@ class Booster:
             return efb.max_bin, efb.n_cols
         return self._dd.max_bin, self._dd.num_feature
 
-    def _resolve_hist_impl(self) -> str:
-        """Pick the histogram implementation: the Pallas kernel on real TPU
-        backends (gated on a tiny compile-and-compare probe so a Mosaic
-        regression degrades to the XLA path instead of crashing training),
-        segment-sum elsewhere (CPU tests, interpret)."""
+    #: legal `hist_impl` requests (fused names resolve to their base
+    #: family here; the fusion upgrade stays `_maybe_fuse_hist_impl`'s
+    #: call, and the fused path is byte-identical to its base anyway)
+    _HIST_IMPLS = ("auto", "segment_sum", "packed", "pallas", "pallas_q",
+                   "pallas_fused", "pallas_fused_q")
+
+    def _quant_hist_reasons(self) -> list:
+        """Why the int-lattice histogram family cannot apply (empty =
+        eligible): payload values must be exact integer lattice points
+        with hq >= 0 (GOSS rescale weights break integrality; custom
+        objectives may return negative hessians, whose hq < 0 borrows
+        into the packed grad field; more quant bins than the tile bound
+        would overflow the 16-bit field)."""
         cfg = self.config
         from .ops.histogram import PACKED_MAX_QUANT_BINS
-        # quantized-lattice eligibility: payload values must be exact
-        # integer lattice points with hq >= 0 (GOSS rescale weights break
-        # integrality; custom objectives may return negative hessians,
-        # whose hq < 0 borrows into the packed grad field; more quant
-        # bins than the tile bound would overflow the 16-bit field)
-        quant_ok = (cfg.use_quantized_grad
-                    and 0 < cfg.num_grad_quant_bins <= PACKED_MAX_QUANT_BINS
-                    and not self._use_goss
-                    and self._fobj is None and self.objective_ is not None)
+        reasons = []
+        if not 0 < cfg.num_grad_quant_bins <= PACKED_MAX_QUANT_BINS:
+            reasons.append(
+                f"num_grad_quant_bins={cfg.num_grad_quant_bins} outside "
+                f"(0, {PACKED_MAX_QUANT_BINS}]")
+        if self._use_goss:
+            reasons.append("GOSS rescale weights break lattice "
+                           "integrality")
+        if self._fobj is not None or self.objective_ is None:
+            reasons.append("custom objective (negative hessians would "
+                           "borrow into the packed grad field)")
+        return reasons
+
+    def _hist_impl_fallback(self, requested: str, reasons: list) -> None:
+        """Priced degradation of an explicit or implied hist_impl request
+        (VERDICT r4 #4 discipline: tell users what the fallback costs,
+        not just that it happened).  De-duplicated per booster and
+        per (request, reasons) — `_resolve_hist_impl` is consulted by
+        several sizing helpers, and one decision must price once."""
+        seen = getattr(self, "_hist_fallback_seen", None)
+        if seen is None:
+            seen = self._hist_fallback_seen = set()
+        key = (requested, tuple(reasons))
+        if key in seen:
+            return
+        seen.add(key)
+        telemetry.REGISTRY.counter("fallback.events").inc()
+        telemetry.event("fallback.hist_impl", requested=requested,
+                        reasons=reasons)
+        log.warning(f"hist_impl={requested} is not available with "
+                    + "; ".join(reasons)
+                    + " — degrading to the auto-selected path (the "
+                    "lattice/kernel family is the fast path: one packed "
+                    "sweep per (g, h) pair on CPU, ~60x over the XLA "
+                    "scatter on TPU; PROFILE.md round 3b)")
+
+    def _resolve_hist_impl(self) -> str:
+        """Pick the histogram implementation.  Default (`hist_impl=auto`)
+        promotes the int-lattice family wherever the model qualifies:
+        the Pallas kernel on real TPU backends (pallas_q when the
+        lattice applies, gated on a tiny compile-and-compare probe so a
+        Mosaic regression degrades to the XLA path instead of crashing
+        training), the packed-int scatter on CPU, segment-sum last.  A
+        quantized-training request the lattice cannot honor emits a
+        PRICED fallback event instead of degrading silently.  An
+        explicit `hist_impl` pins the path; an ineligible request
+        degrades to the auto choice with a priced event
+        (degrade-don't-error, like the serving ladder)."""
+        cfg = self.config
+        from .ops.pallas_hist import base_hist_impl, probe_cached
+        req = str(cfg.hist_impl or "auto").lower()
+        if req not in self._HIST_IMPLS:
+            raise LightGBMError(
+                f"Unknown hist_impl {cfg.hist_impl!r} (expected one of "
+                f"{', '.join(self._HIST_IMPLS)})")
+        quant_reasons = self._quant_hist_reasons()
+        quant_ok = cfg.use_quantized_grad and not quant_reasons
+        interpret = bool(cfg.hist_interpret)
         on_tpu = False
         if cfg.tpu_use_pallas:
             try:
                 on_tpu = jax.devices()[0].platform in ("tpu", "axon")
             except RuntimeError:
                 on_tpu = False
+        if req != "auto":
+            base = base_hist_impl(req)
+            reasons = []
+            if base in ("packed", "pallas_q"):
+                if not cfg.use_quantized_grad:
+                    reasons.append("use_quantized_grad=False (the "
+                                   "int-lattice needs quantized "
+                                   "gradients)")
+                reasons.extend(quant_reasons)
+            if base in ("pallas", "pallas_q"):
+                if not cfg.tpu_use_pallas:
+                    reasons.append("tpu_use_pallas=False")
+                elif not (on_tpu or interpret):
+                    reasons.append("no Pallas backend (not a TPU, and "
+                                   "hist_interpret is off)")
+                elif not probe_cached(*self._probe_shape(),
+                                      interpret=not on_tpu):
+                    reasons.append("a failing Pallas histogram probe on "
+                                   "this backend")
+            if not reasons:
+                return base
+            self._hist_impl_fallback(req, reasons)
+        # ---- auto: the int-lattice family is the default wherever the
+        # model qualifies ----
+        if cfg.use_quantized_grad and quant_reasons:
+            # quantized training was requested but the lattice cannot
+            # apply — priced, not silent
+            self._hist_impl_fallback("quantized", quant_reasons)
         if on_tpu:
             # XLA lowers the 256-segment scatter-add to a SERIAL update
             # loop on TPU (~60x slower than the kernel — PROFILE.md round
             # 3b), so the Pallas one-hot-matmul kernel is the default
-            # there, gated on a tiny compile-and-compare probe so a
-            # Mosaic regression degrades to the XLA path instead of
-            # crashing training
-            from .ops.pallas_hist import probe_cached
+            # there, probe-gated as above
             if probe_cached(*self._probe_shape()):
                 return "pallas_q" if quant_ok else "pallas"
             telemetry.REGISTRY.counter("fallback.events").inc()
@@ -996,11 +1084,22 @@ class Booster:
             pb, pc = self._probe_shape()
             if not probe_cached(pb, pc, width=w,
                                 quantized=spec.hist_impl == "pallas_q",
-                                fused=True):
+                                fused=True,
+                                interpret=spec.hist_interpret):
                 reasons.append("a failing fused-kernel exact-parity "
                                "probe on this backend")
         if reasons:
+            # priced downgrade: the unfused wave re-reads each wave's
+            # [S, F, MB, 3] histogram block from HBM for the XLA split
+            # scan the fused kernel would have done in VMEM (~15-20% of
+            # wave step time at the 2M bench shape — PROFILE.md r3c)
+            telemetry.REGISTRY.counter("fallback.events").inc()
             telemetry.event("fallback.fused_split", reasons=reasons)
+            log.warning("fused hist+split is unavailable with "
+                        + "; ".join(reasons)
+                        + f" — using the unfused {spec.hist_impl} kernel "
+                        "(one extra histogram-block HBM read per wave "
+                        "for the XLA split scan)")
             return
         self._grower_spec = spec._replace(
             hist_impl="pallas_fused" if spec.hist_impl == "pallas"
@@ -3257,7 +3356,8 @@ class Booster:
             max_delta_step=self.config.max_delta_step,
             # quantization params may have changed: a stale hist_impl /
             # const-hess level would silently mis-scale histogram sums
-            hist_impl=self._resolve_hist_impl())
+            hist_impl=self._resolve_hist_impl(),
+            hist_interpret=bool(self.config.hist_interpret))
         self._grower_spec = self._grower_spec._replace(
             packed_const_hess_level=self._packed_const_hess_level(),
             wave_width=self._wave_width(),

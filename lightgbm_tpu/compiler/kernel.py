@@ -38,7 +38,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..analysis.contracts import contract
-from ..ops.predict import accumulate_slots_exact
+from ..ops.predict import accumulate_slots_bounded, accumulate_slots_exact
 
 #: row-block height; bucket sizes are powers of two so BR always divides
 ROW_BLOCK = 256
@@ -189,3 +189,32 @@ def compiled_predict(X, planes, gather_idx, value_hi, value_lo, cls=None,
     return accumulate_slots_exact(slots, value_hi, value_lo,
                                   n_class=n_class, cls=cls,
                                   convert=convert)
+
+
+@contract(X="[N, F] f32", gather_idx="[T] i32", qval="[T, NL] int",
+          tile_of_tree="[T] i32", scales="[S] f32", meta="static",
+          n_class="static int", convert="static", interpret="static",
+          ret="tree")
+@functools.partial(jax.jit, static_argnames=("meta", "n_class",
+                                             "convert", "interpret"))
+def compiled_predict_bounded(X, planes, gather_idx, qval, tile_of_tree,
+                             scales, cls=None, *, meta, n_class=1,
+                             convert=None, interpret=False):
+    """Bounded-error twin of `compiled_predict`: identical tiled
+    traversal (same `_traverse_bucket` programs, same boosting-order
+    slot gather — routing stays bit-exact, that contract is untouched),
+    but the accumulation tail is `accumulate_slots_bounded`'s int32
+    partial sums over the quantizer's per-tile leaf-value codes instead
+    of the software-f64 adder.  Emits f32 scores inside the published
+    error bound (serving/runtime.py probes the bound before this may
+    serve); 4 bytes per score D2H and no 100-op binary64 add per tree.
+    """
+    parts = []
+    with jax.named_scope("compiled_traverse"):
+        for (words, kids, pal, catw), (depth, mw) in zip(planes, meta):
+            parts.append(_traverse_bucket(X, words, kids, pal, catw,
+                                          depth, mw, interpret))
+    slots = jnp.concatenate(parts, axis=0)[gather_idx]
+    return accumulate_slots_bounded(slots, qval, tile_of_tree, scales,
+                                    n_class=n_class, cls=cls,
+                                    convert=convert)

@@ -46,10 +46,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
     # boosting loop (train.chunk / compile_warmup / eval) all nest inside
     with telemetry.span("train.loop", num_boost_round=num_boost_round,
                         external_memory=bool(
-                            (params or {}).get("external_memory", False))):
+                            (params or {}).get("external_memory", False))) \
+            as sp:
         booster = _train_impl(params, train_set, num_boost_round,
                               valid_sets, valid_names, feval, init_model,
                               keep_training_booster, callbacks)
+        # record the RESOLVED histogram implementation (post probe gates
+        # and fusion upgrade) so a run's telemetry says which kernel
+        # family actually trained the model, not just what was requested
+        spec = getattr(booster, "_grower_spec", None)
+        if spec is not None:
+            sp.set(hist_impl=spec.hist_impl,
+                   grow_policy=getattr(booster, "_grow_policy",
+                                       "leafwise"))
     _finish_telemetry(booster)
     return booster
 

@@ -837,15 +837,16 @@ _PROBE_CACHE = {}
 
 def probe_cached(max_bin: int = 256, num_feature: int = 28,
                  multi: bool = False, width: int = None,
-                 quantized: bool = None, fused: bool = False) -> bool:
+                 quantized: bool = None, fused: bool = False,
+                 interpret: bool = False) -> bool:
     """probe(), memoised per (backend platform, shape, multi params)."""
     try:
         key = (jax.devices()[0].platform, max_bin, num_feature, multi,
-               width, quantized, fused)
+               width, quantized, fused, interpret)
     except RuntimeError:
         return False
     if key not in _PROBE_CACHE:
-        _PROBE_CACHE[key] = probe(max_bin=max_bin,
+        _PROBE_CACHE[key] = probe(interpret=interpret, max_bin=max_bin,
                                   num_feature=num_feature, multi=multi,
                                   width=width, quantized=quantized,
                                   fused=fused)

@@ -542,6 +542,92 @@ def accumulate_slots_exact(slots: Array, value_hi: Array, value_lo: Array,
         return convert(_f64_bits_to_f32(hi, lo))
 
 
+# ------------------------------------------------------------------
+# bounded-error quantized accumulation (serve_precision=bounded)
+#
+# The bounded serving rung trades the software-binary64 adder above for
+# int32 accumulation of per-tile-quantized leaf values: routing stays
+# the EXACT `_leaf_slots` walk (quantizing thresholds would change
+# routing and make the error unboundable), only the gathered leaf
+# VALUES are int8/int16 codes under a per-tile f32 scale
+# (compiler/quantize.pack_bounded).  Integer partial sums are exact and
+# order-independent; the only float arithmetic is the final per-tile
+# scale combine, done in a FIXED ascending-tile order so every program
+# that accumulates through this function produces identical f32 bytes
+# for identical slots.  The analytic error bound the quantizer
+# publishes covers the per-leaf representation error plus the f32
+# combine slop — the serving probe then measures the real max-abs
+# error against the exact-f64 reference and refuses the rung whenever
+# measurement exceeds the published bound.
+
+
+@contract(slots="[T, N] i32", qval="[T, NL] int", tile_of_tree="[T] i32",
+          scales="[S] f32", n_class="static int", cls="[T] i32?",
+          convert="static", ret="tree")
+def accumulate_slots_bounded(slots: Array, qval: Array,
+                             tile_of_tree: Array, scales: Array,
+                             n_class: int = 1, cls: Array = None,
+                             convert=None):
+    """Int32 accumulation of PRE-ROUTED leaf slots over quantized
+    leaf-value planes — the bounded twin of `accumulate_slots_exact`.
+
+    Each scan step gathers tree i's int code at its slot and adds it
+    into the int32 partial of (tile_of_tree[i], class i%K); the partial
+    is exact as long as `qmax * trees_per_tile_class < 2^24` (the
+    quantizer refuses otherwise), so the int32 -> f32 cast at the
+    combine is lossless and the ONLY rounding in the whole path is the
+    per-tile `partial * scale` product and the S-term f32 sum — both
+    inside the published bound.  Returns f32 raw scores ([N] / [N, K]),
+    or converted f32 scores when `convert` is given: 4 bytes per score
+    over the wire and no software-f64 adder on the hot path.
+    """
+    n = slots.shape[1]
+    s_tiles = scales.shape[0]
+    xs = {"slots": slots, "q": qval, "tidx": tile_of_tree}
+    if n_class > 1:
+        xs["cls"] = cls
+
+    def step(carry, tree):
+        q = tree["q"][tree["slots"]].astype(jnp.int32)
+        if n_class > 1:
+            return carry.at[:, tree["tidx"], tree["cls"]].add(q), None
+        return carry.at[:, tree["tidx"]].add(q), None
+
+    with jax.named_scope("accumulate_slots_bounded"):
+        shape = (n, s_tiles, n_class) if n_class > 1 else (n, s_tiles)
+        partial, _ = jax.lax.scan(step, jnp.zeros(shape, jnp.int32), xs)
+        out_shape = (n, n_class) if n_class > 1 else (n,)
+        out = jnp.zeros(out_shape, jnp.float32)
+        # fixed ascending-tile combine order: f32 addition is not
+        # associative, and the published bound's slop term assumes one
+        # deterministic S-term sum shared by every bounded program
+        for s in range(s_tiles):
+            out = out + partial[:, s].astype(jnp.float32) * scales[s]
+        if convert is None:
+            return out
+        return convert(out)
+
+
+@contract(stacked="tree", X="[N, F] float", qval="[T, NL] int",
+          tile_of_tree="[T] i32", scales="[S] f32", n_class="static int",
+          convert="static", ret="tree")
+def predict_raw_ensemble_bounded(stacked, X: Array, qval: Array,
+                                 tile_of_tree: Array, scales: Array,
+                                 n_class: int = 1, convert=None):
+    """Bounded-error scores in one stacked device program: the exact
+    `_leaf_slots` routing scan (shared with every exact rung, so
+    routing is bitwise identical to the ladder beneath) feeding
+    `accumulate_slots_bounded`.  This is the bounded rung's XLA path;
+    the tiled Pallas twin (`compiler.kernel.compiled_predict_bounded`)
+    swaps only the traversal and shares the accumulation function, so
+    both produce identical f32 bytes for the same rows."""
+    cls = stacked.get("cls") if n_class > 1 else None
+    slots = predict_leaf_ensemble(stacked, X)
+    return accumulate_slots_bounded(slots, qval, tile_of_tree, scales,
+                                    n_class=n_class, cls=cls,
+                                    convert=convert)
+
+
 @contract(stacked="tree", X="[N, F] float", ret="[T, N] i32")
 def predict_leaf_ensemble(stacked, X: Array) -> Array:
     """Per-tree leaf slots over padded stacked tree arrays (serving path).

@@ -12,11 +12,14 @@ Endpoints (JSON in/out, no dependencies beyond http.server):
                   this end to end).
   GET  /healthz   -> {"status": "ok", "models": [...], "stale": [...],
                   "demoted": [...], "device_bytes": {...},
-                  "latency_ms": {...}} (503 when no model is loaded;
-                  `stale` lists models whose booster mutated since
-                  their export, `latency_ms` is the all-rung
-                  server-side e2e percentile block once any request
-                  has completed — see ModelRegistry.status)
+                  "bounded": {...}, "latency_ms": {...}} (503 when no
+                  model is loaded; `stale` lists models whose booster
+                  mutated since their export, `bounded` publishes each
+                  bounded-precision model's error contract — active
+                  flag, worst-case bound, probe-measured max abs error
+                  — and `latency_ms` is the all-rung server-side e2e
+                  percentile block once any request has completed —
+                  see ModelRegistry.status)
   GET  /metrics   -> Prometheus text exposition of the process
                   MetricsRegistry (serve.* counters/gauges/timings
                   plus the per-rung `serve.stage.*` classic-histogram
@@ -135,6 +138,8 @@ class ServingHTTPHandler(BaseHTTPRequestHandler):
                        "stale": st["stale"],
                        "demoted": st["demoted"],
                        "device_bytes": st["device_bytes"]}
+            if "bounded" in st:
+                payload["bounded"] = st["bounded"]
             if "latency_ms" in st:
                 payload["latency_ms"] = st["latency_ms"]
             self._send_json(200 if models else 503, payload)

@@ -187,6 +187,8 @@ class ModelRegistry:
                     max_batch_rows=cfg.serve_max_batch_rows,
                     name=name, device_sum=cfg.serve_device_sum,
                     compiled=cfg.serve_compiled,
+                    precision=cfg.serve_precision,
+                    quant_bits=cfg.serve_quant_bits,
                     tile_vmem_kb=cfg.serve_tile_vmem_kb,
                     dispatch_timeout_ms=cfg.serve_dispatch_timeout_ms,
                     breaker_backoff_s=cfg.serve_breaker_backoff_s,
@@ -196,6 +198,8 @@ class ModelRegistry:
                     booster, max_batch_rows=cfg.serve_max_batch_rows,
                     name=name, device_sum=cfg.serve_device_sum,
                     compiled=cfg.serve_compiled,
+                    precision=cfg.serve_precision,
+                    quant_bits=cfg.serve_quant_bits,
                     tile_vmem_kb=cfg.serve_tile_vmem_kb,
                     dispatch_timeout_ms=cfg.serve_dispatch_timeout_ms,
                     breaker_backoff_s=cfg.serve_breaker_backoff_s,
@@ -329,6 +333,21 @@ class ModelRegistry:
                                  if e.runtime.demoted),
                "device_bytes": {n: e.runtime.device_bytes()
                                 for n, e in sorted(entries.items())}}
+        # bounded precision tier: publish each bounded-tier model's
+        # contract (the worst-case bound) next to what the probe actually
+        # measured, so /healthz is where operators audit the promise
+        bounded = {}
+        for n, e in sorted(entries.items()):
+            rt = e.runtime
+            if getattr(rt, "precision", "exact") != "bounded":
+                continue
+            bounded[n] = {
+                "active": bool(rt.bounded_active),
+                "bound": rt.bounded_bound,
+                "measured_max_abs_error": rt.bounded_measured_error,
+            }
+        if bounded:
+            out["bounded"] = bounded
         lat = telemetry.e2e_latency_summary()
         if lat is not None:
             out["latency_ms"] = lat

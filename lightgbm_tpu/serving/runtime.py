@@ -8,8 +8,30 @@ program no matter how ragged the request-size distribution is.  The
 bound is asserted through the PR 3 `jax.monitoring` recompile listener
 in tests/test_serving.py.
 
+Above the exact ladder sits an OPT-IN declared-error tier
+(`serve_precision=bounded`, default "exact"): leaf values quantize to
+per-tile-scaled int8/int16 codes (`compiler.quantize.pack_bounded`) and
+accumulate in int32, with only the final per-tile scale combine in f32
+— routing stays the exact `_leaf_slots` walk, so the ONLY deviation
+from the exact rungs is the leaf-value representation, and it is
+covered by a worst-case bound computed at pack time and PUBLISHED per
+model (registry status / healthz / fleet snapshot).  The refresh-time
+probe measures the real max-abs-error against the exact-f64 reference
+and hard-disables the rung (cause="bound",
+`serve.bounded_disabled{cause=}`) whenever measurement exceeds the
+published bound — the same probe-gated discipline as the rungs below.
+The full exact ladder stays live beneath it for fallback, and the
+exact rungs' bytes are untouched (asserted in
+tests/test_bounded_serving.py).
+
 Fallback ladder (every rung byte-identical to `booster.predict`):
 
+  b. bounded     — opt-in, see above: exact routing + int32-accumulated
+     quantized leaf values, f32 scores inside the published max-abs
+     error bound (NOT byte-identical — the one deliberate exception on
+     this ladder).  Uses the tiled Pallas traversal when the compiled
+     planes are live, the stacked XLA scan otherwise; both share
+     `accumulate_slots_bounded`, so the bytes are identical either way.
   0. compiled    — `compiler/`: the export is compiled into quantized
      VMEM-sized tree tiles and traversed by the fused Pallas kernel
      (`compiler.kernel.compiled_predict`); the tile slots gather back
@@ -59,8 +81,11 @@ import jax.numpy as jnp
 from .. import telemetry
 from ..analysis import make_lock
 from ..compiler import PlanNotCompilable, build_plan
-from ..compiler.kernel import ROW_BLOCK, compiled_predict
-from ..ops.predict import predict_leaf_ensemble, predict_raw_ensemble_exact
+from ..compiler.kernel import ROW_BLOCK, compiled_predict, \
+    compiled_predict_bounded
+from ..compiler.quantize import pack_bounded
+from ..ops.predict import predict_leaf_ensemble, \
+    predict_raw_ensemble_bounded, predict_raw_ensemble_exact
 from ..resilience import FAULTS, OPEN, CircuitBreaker, Supervisor
 
 #: padding cap (and the micro-batcher's default flush threshold): with
@@ -76,6 +101,8 @@ DEFAULT_MAX_BATCH_ROWS = 4096
 _LEAF_JIT = jax.jit(predict_leaf_ensemble)
 _EXACT_JIT = jax.jit(predict_raw_ensemble_exact,
                      static_argnames=("n_class", "convert"))
+_BOUNDED_JIT = jax.jit(predict_raw_ensemble_bounded,
+                       static_argnames=("n_class", "convert"))
 
 
 class _ServeState:
@@ -92,7 +119,8 @@ class _ServeState:
 
     __slots__ = ("export", "device_sum_ok", "compiled_ok", "plan",
                  "plan_planes", "plan_meta", "plan_gidx", "probe_failed",
-                 "demoted")
+                 "demoted", "bounded_ok", "bounded_planes",
+                 "bounded_bound", "bounded_measured")
 
     def __init__(self, export: Dict):
         self.export = export
@@ -104,6 +132,22 @@ class _ServeState:
         self.plan_gidx = None
         self.probe_failed = False
         self.demoted = False
+        # bounded tier: (qval, tile_of_tree, scales) device planes, the
+        # published worst-case bound, the probe-measured max-abs error
+        self.bounded_ok = False
+        self.bounded_planes = None
+        self.bounded_bound = None
+        self.bounded_measured = None
+
+    def clone(self) -> "_ServeState":
+        """Field-for-field copy — the single-rung republish sites
+        (`_publish_rung`, `_drop_compiled`, `_drop_bounded`) start from
+        a full copy so a new rung's fields can never be silently
+        dropped by a manual copy list going stale."""
+        new = _ServeState(self.export)
+        for f in self.__slots__:
+            setattr(new, f, getattr(self, f))
+        return new
 
 
 def bucket_rows(n: int, max_rows: int = DEFAULT_MAX_BATCH_ROWS) -> int:
@@ -147,6 +191,8 @@ class ServingRuntime:
                  device_sum: str = "auto",
                  compiled: str = "auto",
                  tile_vmem_kb: float = 512.0,
+                 precision: str = "exact",
+                 quant_bits: int = 8,
                  device=None,
                  dispatch_timeout_ms: float = 0.0,
                  breaker_backoff_s: float = 30.0,
@@ -159,6 +205,12 @@ class ServingRuntime:
         self._device_sum_mode = str(device_sum).lower()
         self._compiled_mode = str(compiled).lower()
         self._tile_vmem_kb = float(tile_vmem_kb)
+        self._precision = str(precision).lower()
+        if self._precision not in ("exact", "bounded"):
+            raise ValueError(
+                f"serve_precision must be 'exact' or 'bounded', "
+                f"got {precision!r}")
+        self._quant_bits = int(quant_bits)
         self._state = _ServeState({})
         # resilience plane: one watchdog lane + one circuit breaker per
         # device rung.  `dispatch_timeout_ms <= 0` (the default) makes
@@ -167,6 +219,8 @@ class ServingRuntime:
         # open on error, half-open background re-probe after backoff,
         # permanent only on a CONTENT mismatch.
         self._supervisors = {
+            "bounded": Supervisor("serve.dispatch.bounded",
+                                  dispatch_timeout_ms),
             "compiled": Supervisor("compiled.traverse",
                                    dispatch_timeout_ms),
             "device_sum": Supervisor("serve.dispatch.device_sum",
@@ -178,7 +232,8 @@ class ServingRuntime:
             rung: CircuitBreaker(f"{name}.{rung}",
                                  backoff_s=breaker_backoff_s,
                                  backoff_max_s=breaker_backoff_max_s)
-            for rung in ("compiled", "device_sum", "slot_path")}
+            for rung in ("bounded", "compiled", "device_sum",
+                         "slot_path")}
         self._reprobe_lock = make_lock("serving.runtime._reprobe_lock")
         self._reprobe_threads: Dict[str, threading.Thread] = {}  # guarded-by: _reprobe_lock
         #: pin every device array (export planes + staged inputs) to one
@@ -226,6 +281,7 @@ class ServingRuntime:
             st = _ServeState(ex)
             st.device_sum_ok = self._device_sum_enable(ex, st)
             st.compiled_ok = self._compiled_enable(ex, st)
+            st.bounded_ok = self._bounded_enable(ex, st)
             self._state = st
             self._ledger_register(st)
 
@@ -295,6 +351,29 @@ class ServingRuntime:
         return self._state.compiled_ok
 
     @property
+    def precision(self) -> str:
+        """The runtime's configured precision tier ('exact'/'bounded')."""
+        return self._precision
+
+    @property
+    def bounded_active(self) -> bool:
+        """Is the bounded quantized rung serving (opt-in, probe passed,
+        measured error within the published bound)?"""
+        return self._state.bounded_ok
+
+    @property
+    def bounded_bound(self) -> Optional[float]:
+        """The published worst-case max-abs-error bound on RAW scores
+        (None when the bounded tier is off or disqualified)."""
+        return self._state.bounded_bound
+
+    @property
+    def bounded_measured_error(self) -> Optional[float]:
+        """The probe-measured max-abs error vs the exact-f64 reference
+        on the refresh probe batch (None before any bounded probe)."""
+        return self._state.bounded_measured
+
+    @property
     def num_class(self) -> int:
         return self._export["num_class"]
 
@@ -329,6 +408,8 @@ class ServingRuntime:
         if st.plan_planes is not None:
             total += sum(int(a.nbytes) for bucket in st.plan_planes
                          for a in bucket if a is not None)
+        if st.bounded_planes is not None:
+            total += sum(int(a.nbytes) for a in st.bounded_planes)
         return total
 
     def device_bytes(self) -> int:
@@ -359,6 +440,7 @@ class ServingRuntime:
         owner = f"serve.{self.name}.planes"
         stacked_arrays: list = []
         tile_planes: list = []
+        bounded_planes: list = []
         if ex and not st.demoted:
             stacked = ex.get("stacked")
             if stacked:
@@ -369,9 +451,12 @@ class ServingRuntime:
             if st.plan_planes is not None:
                 tile_planes = [a for bucket in st.plan_planes
                                for a in bucket if a is not None]
+            if st.bounded_planes is not None:
+                bounded_planes = list(st.bounded_planes)
         self._ledger_handles = (
             led.assign(owner, stacked_arrays, rung="stacked")
-            + led.assign(owner, tile_planes, rung="compiled"))
+            + led.assign(owner, tile_planes, rung="compiled")
+            + led.assign(owner, bounded_planes, rung="bounded"))
 
     def _ledger_release(self) -> None:
         """Drop every ledger handle this runtime owns (planes AND
@@ -423,6 +508,11 @@ class ServingRuntime:
                 self._booster._serving_export_cache = None
             self._state = st
             self._ledger_register(st)
+            if cur.bounded_ok:
+                # the bounded planes are device arrays — a demoted
+                # bundle drops the rung (next refresh() repacks it)
+                telemetry.REGISTRY.gauge("serve.bounded.active",
+                                         model=self.name).set(0)
         telemetry.REGISTRY.counter("serve.demotions").inc()
         return freed
 
@@ -648,6 +738,140 @@ class ServingRuntime:
                             model=self.name, error=str(e)[:200])
             return "error"
 
+    # ----------------------------------------------------- bounded gate
+    def _disable_bounded(self, cause: str, detail: str = "") -> None:
+        telemetry.REGISTRY.counter("serve.bounded_disabled",
+                                   cause=cause).inc()
+        telemetry.event("serve.bounded_disabled", model=self.name,
+                        cause=cause, detail=detail[:200])
+        telemetry.REGISTRY.gauge("serve.bounded.active",
+                                 model=self.name).set(0)
+
+    def _bounded_gauges(self, st: _ServeState, active: bool) -> None:
+        """Publish the per-model bound/measured gauges the fleet
+        snapshot and sentinel read (`/debug/fleet` renders them)."""
+        g = telemetry.REGISTRY.gauge
+        g("serve.bounded.active", model=self.name).set(1 if active else 0)
+        if st.bounded_bound is not None:
+            g("serve.bounded.bound", model=self.name).set(st.bounded_bound)
+        if st.bounded_measured is not None:
+            g("serve.bounded.measured_error", model=self.name).set(
+                st.bounded_measured)
+
+    def _bounded_enable(self, ex: Dict, st: _ServeState) -> bool:
+        """Decide the bounded quantized rung for this export
+        (refresh-time): quantize the leaf-value table against the tile
+        plan, pin the planes onto the in-construction bundle `st`, then
+        demand the probe-measured max-abs error stay within the
+        published bound.  ANY refusal lands in
+        `serve.bounded_disabled{cause=}` and the exact ladder serves —
+        a model that cannot be bounded-quantized is a degradation,
+        never an error."""
+        if self._precision != "bounded":
+            return False
+        if ex["stacked"] is None or not ex["trees"] \
+                or ex.get("value_hi") is None or ex["average_factor"] != 1:
+            self._disable_bounded("model")
+            return False
+        # the quantizer's per-tile scales come from the SAME tile plan
+        # the compiled rung traverses; when that rung is off (CPU auto /
+        # serve_compiled=off) the plan is built here host-side only —
+        # its tile membership prices the scales, no device planes pinned
+        plan = st.plan
+        if plan is None:
+            try:
+                plan = build_plan(ex, tile_vmem_kb=self._tile_vmem_kb,
+                                  name=self.name)
+            except PlanNotCompilable as e:
+                self._disable_bounded("not_compilable", str(e))
+                return False
+        try:
+            packed = pack_bounded(ex["trees"], plan, ex["leaf_values"],
+                                  ex["num_class"], bits=self._quant_bits)
+        except PlanNotCompilable as e:
+            self._disable_bounded("not_quantizable", str(e))
+            return False
+        arrs = [jnp.asarray(packed["qval"]),
+                jnp.asarray(packed["tile_of_tree"]),
+                jnp.asarray(packed["scales"])]
+        if self.device is not None:
+            arrs = [jax.device_put(a, self.device) for a in arrs]
+        st.bounded_planes = tuple(arrs)
+        st.bounded_bound = float(packed["bound"])
+        verdict = self._probe_bounded(st)
+        if verdict == "ok":
+            self._breakers["bounded"].record_success()
+            self._bounded_gauges(st, True)
+            return True
+        if verdict == "bound":
+            # measured error past the published bound is wrong CONTENT
+            # (a doctored/rotted plane, not a transient): permanent
+            # until a refresh re-quantizes — same class as a parity
+            # mismatch on the exact rungs, but it does NOT taint
+            # `probe_failed` (the exact ladder beneath is untouched)
+            self._breakers["bounded"].record_mismatch()
+            self._disable_bounded(
+                "bound", f"measured {st.bounded_measured!r} > "
+                         f"published {st.bounded_bound!r}")
+            st.bounded_planes = None
+            st.bounded_bound = None
+        else:
+            # transient device exception: KEEP the quantized planes so
+            # the half-open re-probe can retry without a repack
+            self._breakers["bounded"].record_failure()
+            self._disable_bounded("probe_error")
+        return False
+
+    def _probe_bounded(self, st: _ServeState) -> str:
+        """Refresh-time bound-enforcement gate: measure the bounded
+        program's max-abs error against the host f64 gather/sum over
+        the slot program's device slots (the same exact reference the
+        parity probes use) on the threshold-clustered probe batch.
+        Verdict: "ok" (measured <= published bound, measurement stored
+        for publication), "bound" (measured exceeds the bound — the
+        contract would be violated, permanent) or "error" (device
+        exception — breaker-recoverable)."""
+        try:
+            ex = st.export
+            X = self._probe_batch(ex, rows=min(256, self.max_batch_rows))
+            slots = self._device_slots_chunk(X, ex["stacked"])
+            K = ex["num_class"]
+            leaf_values = ex["leaf_values"]
+            want = np.zeros((X.shape[0], K), np.float64)
+            for i in range(slots.shape[0]):
+                want[:, i % K] += leaf_values[i, slots[i]]
+            if K == 1:
+                want = want[:, 0]
+            got = self._bounded_chunk(X, st, want_raw=True)
+            if got.shape != want.shape:
+                st.bounded_measured = float("inf")
+                return "bound"
+            err = float(np.max(np.abs(got.astype(np.float64) - want)))
+            st.bounded_measured = err
+            if not np.isfinite(err) or err > st.bounded_bound:
+                return "bound"
+            return "ok"
+        except Exception as e:
+            telemetry.event("serve.bounded_probe_error",
+                            model=self.name, error=str(e)[:200])
+            return "error"
+
+    def _drop_bounded(self, st: _ServeState, cause: str,
+                      detail: str = "") -> None:
+        """Retire the bounded rung from the PUBLISHED bundle (warmup
+        failures) — the bounded analog of `_drop_compiled`."""
+        self._disable_bounded(cause, detail)
+        with self._refresh_lock:
+            cur = self._state
+            if cur is not st or not cur.bounded_ok:
+                return
+            new = cur.clone()
+            new.bounded_ok = False
+            new.bounded_planes = None
+            new.bounded_bound = None
+            self._state = new
+            self._ledger_register(new)
+
     def buckets(self) -> List[int]:
         """Every padding bucket this runtime can present to the device."""
         out = []
@@ -681,9 +905,20 @@ class ServingRuntime:
                             buckets=len(sizes)):
             t0 = time.perf_counter()
             device_sum_warm = st.device_sum_ok
+            bounded_warm = st.bounded_ok
             slot_warm = True
             for b in sizes:
                 Z = np.zeros((b, nf), np.float64)
+                if bounded_warm:
+                    try:
+                        self._bounded_chunk(Z, st, want_raw=True)
+                        if obj is not None:
+                            self._bounded_chunk(Z, st, want_raw=False)
+                    except Exception as e:
+                        # the bounded rung degrades to the exact ladder
+                        # exactly like a compiled warmup failure
+                        bounded_warm = False
+                        self._drop_bounded(st, "warmup_error", str(e))
                 if slot_warm:
                     try:
                         self._device_slots_chunk(Z, ex["stacked"])
@@ -744,10 +979,12 @@ class ServingRuntime:
             cur = self._state
             if cur is not st or not cur.compiled_ok:
                 return
-            new = _ServeState(cur.export)
-            new.device_sum_ok = cur.device_sum_ok
-            new.probe_failed = cur.probe_failed
-            new.demoted = cur.demoted
+            new = cur.clone()
+            new.compiled_ok = False
+            new.plan = None
+            new.plan_planes = None
+            new.plan_meta = None
+            new.plan_gidx = None
             self._state = new
             self._ledger_register(new)
 
@@ -803,6 +1040,13 @@ class ServingRuntime:
                         verdict = "ok"
                     else:
                         verdict = self._probe_compiled(cur)
+                elif rung == "bounded":
+                    if cur.bounded_planes is None:
+                        # bound breach dropped the planes (permanent)
+                        # or a demote did — only a refresh repacks
+                        verdict = "error"
+                    else:
+                        verdict = self._probe_bounded(cur)
                 else:
                     verdict = self._probe_slot_path(ex)
                 if verdict == "ok":
@@ -811,11 +1055,15 @@ class ServingRuntime:
                                                rung=rung).inc()
                     telemetry.event("serve.breaker.recovered",
                                     model=self.name, rung=rung)
+                    if rung == "bounded":
+                        self._bounded_gauges(cur, True)
                     self._publish_rung(cur, rung, True)
-                elif verdict == "mismatch":
+                elif verdict in ("mismatch", "bound"):
                     br.record_mismatch()
                     if rung == "compiled":
                         self._disable_compiled("probe")
+                    elif rung == "bounded":
+                        self._disable_bounded(verdict)
                     else:
                         telemetry.REGISTRY.counter(
                             "serve.device_sum_disabled").inc()
@@ -852,24 +1100,25 @@ class ServingRuntime:
         breaker alone gated the rung, so closing it suffices)."""
         if rung == "slot_path":
             return
-        flag = "device_sum_ok" if rung == "device_sum" else "compiled_ok"
+        flag = {"device_sum": "device_sum_ok", "compiled": "compiled_ok",
+                "bounded": "bounded_ok"}[rung]
         if getattr(cur, flag) == ok and not mismatch:
             return
-        new = _ServeState(cur.export)
-        new.device_sum_ok = cur.device_sum_ok
-        new.compiled_ok = cur.compiled_ok
-        new.plan = cur.plan
-        new.plan_planes = cur.plan_planes
-        new.plan_meta = cur.plan_meta
-        new.plan_gidx = cur.plan_gidx
-        new.probe_failed = cur.probe_failed or mismatch
-        new.demoted = cur.demoted
+        new = cur.clone()
+        # a bounded bound-breach does NOT taint probe_failed: that flag
+        # labels the EXACT ladder's host-walk cause, and the exact
+        # rungs beneath the bounded tier are untouched by its verdict
+        new.probe_failed = cur.probe_failed or (mismatch
+                                                and rung != "bounded")
         setattr(new, flag, ok)
         if rung == "compiled" and not ok:
             new.plan = None
             new.plan_planes = None
             new.plan_meta = None
             new.plan_gidx = None
+        if rung == "bounded" and not ok:
+            new.bounded_planes = None
+            new.bounded_bound = None
         self._state = new
         self._ledger_register(new)
 
@@ -908,11 +1157,20 @@ class ServingRuntime:
             t0 = time.perf_counter()
             want_raw = raw_score or self._booster.objective_ is None
             out = None
-            if st.compiled_ok and ex["trees"] \
+            # the bounded tier sits ABOVE the exact ladder: opt-in,
+            # probe-passed, breaker-closed — any refusal falls through
+            # to the exact rungs unchanged
+            if st.bounded_ok and ex["trees"] \
+                    and self._breakers["bounded"].allow_request():
+                out = self._bounded(X, st, want_raw, clock)
+                if out is not None:
+                    clock.rung = "bounded"
+            if out is None and st.compiled_ok and ex["trees"] \
                     and self._breakers["compiled"].allow_request():
                 out = self._compiled(X, st, want_raw, clock)
             if out is not None:
-                clock.rung = "compiled"
+                if clock.rung != "bounded":
+                    clock.rung = "compiled"
             else:
                 if st.device_sum_ok and ex["trees"] \
                         and self._breakers["device_sum"].allow_request():
@@ -930,6 +1188,84 @@ class ServingRuntime:
             clock.add("convert", max(total - accounted, 0.0))
         telemetry.REGISTRY.counter("serve.rows").inc(n)
         return out
+
+    # -------------------------------------- rung b: bounded quantized
+    def _bounded(self, X: np.ndarray, st: _ServeState, want_raw: bool,
+                 clock: Optional[telemetry.StageClock] = None,
+                 ) -> Optional[np.ndarray]:
+        """f32 scores inside the published error bound, or None when
+        the exact ladder must take over (same chunk/degrade shape as
+        `_compiled`)."""
+        stacked = st.export["stacked"]
+        if X.shape[1] < stacked["min_features"] or X.shape[0] == 0:
+            return None
+        try:
+            outs = [self._bounded_chunk(
+                        X[lo:lo + self.max_batch_rows], st, want_raw,
+                        clock)
+                    for lo in range(0, X.shape[0], self.max_batch_rows)]
+        except Exception as e:
+            self._breakers["bounded"].record_failure()
+            telemetry.REGISTRY.counter("serve.device_errors").inc()
+            telemetry.event("serve.device_error", model=self.name,
+                            path="bounded", error=str(e)[:200])
+            return None
+        telemetry.REGISTRY.counter("serve.bounded").inc()
+        return outs[0] if len(outs) == 1 else np.concatenate(outs, axis=0)
+
+    def _bounded_chunk(self, Xc: np.ndarray, st: _ServeState,
+                       want_raw: bool,
+                       clock: Optional[telemetry.StageClock] = None,
+                       ) -> np.ndarray:
+        """One bucket-padded bounded dispatch.  Traversal comes from
+        the tiled Pallas program when the compiled planes are live on
+        this bundle, the stacked XLA scan otherwise — both route
+        bit-identically and share `accumulate_slots_bounded`, so the
+        choice never changes the bytes (a compiled-rung drop mid-flight
+        just switches the next chunk's traversal)."""
+        if clock is None:
+            clock = telemetry.StageClock()
+        ex = st.export
+        use_kernel = st.plan_planes is not None
+        b = bucket_rows(Xc.shape[0], self.max_batch_rows)
+        if use_kernel and b > ROW_BLOCK and b % ROW_BLOCK:
+            # the kernel grid tiles rows in ROW_BLOCK blocks (see
+            # `_compiled_chunk`) — pad up so the block spec divides
+            b += ROW_BLOCK - b % ROW_BLOCK
+        t = time.perf_counter()
+        Xd = self._stage32(Xc, b)
+        clock.add("stage_copy", time.perf_counter() - t)
+        K = ex["num_class"]
+        conv = None if want_raw else self._booster.objective_.convert_output
+        qval, tidx, scales = st.bounded_planes
+        n = Xc.shape[0]
+
+        def _device():
+            with telemetry.MEMLEDGER.oom_guard("serve.dispatch.bounded",
+                                               model=self.name):
+                FAULTS.inject("serve.dispatch.bounded")
+                t = time.perf_counter()
+                if use_kernel:
+                    cls = ex["stacked"].get("cls") if K > 1 else None
+                    interp = jax.default_backend() != "tpu"
+                    out = compiled_predict_bounded(
+                        Xd, st.plan_planes, st.plan_gidx, qval, tidx,
+                        scales, cls, meta=st.plan_meta, n_class=K,
+                        convert=conv, interpret=interp)
+                else:
+                    arrays = {k: v for k, v in ex["stacked"].items()
+                              if k not in ("min_features", "value")}
+                    out = _BOUNDED_JIT(arrays, Xd, qval, tidx, scales,
+                                       n_class=K, convert=conv)
+                clock.add("dispatch", time.perf_counter() - t)
+                t = time.perf_counter()
+                o = np.asarray(jax.device_get(out))
+                clock.add("d2h", time.perf_counter() - t)
+                telemetry.REGISTRY.counter("serve.d2h_bytes").inc(
+                    o.nbytes)
+                return FAULTS.inject("serve.d2h.bounded", o)
+
+        return self._supervisors["bounded"].call(_device)[:n]
 
     # ------------------------------------------- rung 0: compiled tiles
     def _compiled(self, X: np.ndarray, st: _ServeState, want_raw: bool,

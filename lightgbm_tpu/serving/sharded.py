@@ -48,8 +48,11 @@ from .runtime import DEFAULT_MAX_BATCH_ROWS, ServingRuntime
 
 #: fallback-ladder rungs from best to most degraded — a striped call
 #: reports the WORST rung any of its chunks used, so a single wedged
-#: replica is visible on the merged trace
-_RUNG_ORDER = ("compiled", "device_sum", "slot_path", "host_walk")
+#: replica is visible on the merged trace (bounded sits above the exact
+#: ladder: it is the fastest rung, and a stripe that fell off it must
+#: win the "most degraded" fold over one that kept it)
+_RUNG_ORDER = ("bounded", "compiled", "device_sum", "slot_path",
+               "host_walk")
 
 
 def resolve_shard_devices(n: int) -> List:
@@ -86,6 +89,8 @@ class ShardedServingRuntime:
                  name: str = "default",
                  device_sum: str = "auto",
                  compiled: str = "auto",
+                 precision: str = "exact",
+                 quant_bits: int = 8,
                  tile_vmem_kb: float = 512.0,
                  dispatch_timeout_ms: float = 0.0,
                  breaker_backoff_s: float = 30.0,
@@ -107,7 +112,9 @@ class ShardedServingRuntime:
                            start_iteration=start_iteration,
                            num_iteration=num_iteration,
                            name=f"{name}.r{i}", device_sum=device_sum,
-                           compiled=compiled, tile_vmem_kb=tile_vmem_kb,
+                           compiled=compiled, precision=precision,
+                           quant_bits=quant_bits,
+                           tile_vmem_kb=tile_vmem_kb,
                            device=dev,
                            dispatch_timeout_ms=dispatch_timeout_ms,
                            breaker_backoff_s=breaker_backoff_s,
@@ -144,6 +151,29 @@ class ShardedServingRuntime:
     @property
     def compiled_active(self) -> bool:
         return self._replicas[0].compiled_active
+
+    @property
+    def precision(self) -> str:
+        return self._replicas[0].precision
+
+    @property
+    def bounded_active(self) -> bool:
+        # the tier is "active" only when EVERY stripe can serve it — a
+        # single degraded replica already breaks the latency story the
+        # bounded tier exists for
+        return all(r.bounded_active for r in self._replicas)
+
+    @property
+    def bounded_bound(self):
+        return self._replicas[0].bounded_bound
+
+    @property
+    def bounded_measured_error(self):
+        # the published contract covers every stripe: report the WORST
+        # probe measurement across replicas
+        vals = [r.bounded_measured_error for r in self._replicas]
+        vals = [v for v in vals if v is not None]
+        return max(vals) if vals else None
 
     @property
     def booster(self):

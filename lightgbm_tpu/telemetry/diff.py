@@ -204,6 +204,20 @@ RULES: List[Tuple[str, str, str]] = [
     ("*serving.compiled.p99_ms", "up_is_bad", "timing"),
     ("*serving.compiled.*", "ignore", "counter"),
     ("*compile.plan.*", "ignore", "counter"),
+    # bounded precision tier (serve_precision=bounded): `active`
+    # flipping 1 -> 0 means the quantized rung stopped serving (counter
+    # class — fails hard); `error_ratio` (probe-measured / published
+    # bound) climbing means the quantizer's error headroom is eroding —
+    # also hard, the probe disables the rung outright past 1.0.  The
+    # rung's latency/throughput are wall-clock; plane bytes and the
+    # published bound are identity for a fixed model.
+    ("*serve.bounded_disabled*", "up_is_bad", "counter"),
+    ("*serving.bounded.active", "down_is_bad", "counter"),
+    ("*serving.bounded.error_ratio", "up_is_bad", "counter"),
+    ("*serving.bounded.rows_per_sec", "down_is_bad", "timing"),
+    ("*serving.bounded.p50_ms", "up_is_bad", "timing"),
+    ("*serving.bounded.p99_ms", "up_is_bad", "timing"),
+    ("*serving.bounded.*", "ignore", "counter"),
     ("*serving.device_sum.active", "down_is_bad", "counter"),
     ("*serving.device_sum.d2h_bytes_per_row", "up_is_bad", "counter"),
     ("*serving.device_sum.rows_per_sec", "down_is_bad", "timing"),

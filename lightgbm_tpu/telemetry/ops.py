@@ -134,6 +134,18 @@ def fleet_snapshot(limit: int = 8) -> Dict[str, Any]:
         }
     collectives = {n: t for n, t in snap.get("timings", {}).items()
                    if n.startswith("mesh.collective.")}
+    # bounded precision tier: per-model contract gauges
+    # (serve.bounded.active/bound/measured_error{model=...}) folded into
+    # one block so the fleet view shows each model's published bound
+    # next to what its probe measured
+    bounded: Dict[str, Dict[str, Any]] = {}
+    prefix = "serve.bounded."
+    for key, val in snap.get("gauges", {}).items():
+        if not key.startswith(prefix):
+            continue
+        field, _, label = key[len(prefix):].partition("{")
+        model = label[:-1].split("=", 1)[1] if "=" in label else "default"
+        bounded.setdefault(model, {})[field] = val
     out = {
         "ledger": {"records": len(LEDGER),
                    "tail": recs[max(0, len(recs) - limit):]},
@@ -143,6 +155,8 @@ def fleet_snapshot(limit: int = 8) -> Dict[str, Any]:
         "mesh": {**_replica_block(snap.get("histograms", {})),
                  "collectives": collectives},
     }
+    if bounded:
+        out["bounded"] = bounded
     # cross-process spool roll-up (spool.py): when this process is
     # attached to a spool directory, /debug/fleet serves the merged
     # fleet view — process table, per-collective skew + straggler

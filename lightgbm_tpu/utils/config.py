@@ -195,6 +195,19 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "num_grad_quant_bins": (4, "int", ()),
     "quant_train_renew_leaf": (False, "bool", ()),
     "stochastic_rounding": (True, "bool", ()),
+    # histogram implementation request (booster._resolve_hist_impl):
+    # "auto" picks the fastest eligible path — the int-lattice family
+    # (packed on CPU, pallas_q/pallas_fused_q on TPU) is the default
+    # wherever the model qualifies, with priced fallback events when the
+    # lattice disqualifies.  An explicit value (segment_sum / packed /
+    # pallas / pallas_q / pallas_fused / pallas_fused_q) pins the path;
+    # an ineligible request degrades to auto with a priced fallback
+    # event rather than erroring (degrade-don't-error, like the ladder)
+    "hist_impl": ("auto", "str", ()),
+    # run Pallas histogram kernels in interpret mode off-TPU (CI/tests:
+    # lets an explicit pallas-family hist_impl execute on CPU for
+    # byte-identity checks; never needed on a real TPU backend)
+    "hist_interpret": (False, "bool", ()),
     # ---- TPU-specific (new; no reference counterpart) ----
     "tpu_row_tile": (0, "int", ()),          # 0 = auto
     # default-on: measured HONESTLY on v5e (2026-07-31, dependency-chained
@@ -277,6 +290,18 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     # interpreted CPU execution (still probe-gated); "force" skips the
     # probe; "off" pins the existing ladder
     "serve_compiled": ("auto", "str", ("compiled",)),
+    # serving precision tier: "exact" (default) keeps the byte-identical
+    # ladder; "bounded" adds an opt-in rung above it serving f32 scores
+    # within a per-model PUBLISHED worst-case max-abs-error bound
+    # (per-tile int8/int16 quantized leaf values, int32 accumulation —
+    # compiler/quantize.pack_bounded).  The refresh-time probe measures
+    # the real error against the exact-f64 reference and hard-disables
+    # the rung whenever measurement exceeds the published bound; the
+    # full exact ladder always remains beneath for fallback
+    "serve_precision": ("exact", "str", ("precision",)),
+    # bounded-tier quantization width: 8 (int8 codes, ~4x smaller value
+    # planes, wider bound) or 16 (int16, tighter bound)
+    "serve_quant_bits": (8, "int", ("quant_bits",)),
     # compiler tile budget: the packed planes of one tree tile (node
     # words + threshold palette + categorical bitsets) must fit this
     # many KB, so a tile's working set stays VMEM-resident

@@ -275,6 +275,99 @@ print(f"[run_ci] compiled smoke: HTTP parity off the compiled rung "
       "degradation")
 EOF
 
+# bounded-tier smoke (serve_precision=bounded): a golden model behind
+# the HTTP frontend on the quantized-leaf rung — /predict must come off
+# the bounded rung with max-abs-error vs the f64 reference within the
+# PUBLISHED bound, and /healthz must expose the contract (bound +
+# measured probe error) for the model.  The per-family matrix, the
+# doctored-scale probe gate, and the exact-ladder byte-identity
+# assertions live in tests/test_bounded_serving.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+
+sys.path.insert(0, "tests")
+from golden_common import GOLDEN_CASES, make_case_data
+from lightgbm_tpu import telemetry
+from lightgbm_tpu.booster import Booster
+from lightgbm_tpu.serving import ServingClient
+from lightgbm_tpu.serving.http import make_server
+
+bst = Booster(model_file="tests/data/golden_binary.model.txt")
+X, _ = make_case_data(GOLDEN_CASES["binary"])
+X = np.ascontiguousarray(X[:128])
+client = ServingClient(bst, params={"serve_warmup": False,
+                                    "serve_precision": "bounded",
+                                    "serve_max_wait_ms": 0.0})
+rt = client.registry.get().runtime
+assert rt.bounded_active, "bounded rung did not pass its probe"
+bound = rt.bounded_bound
+assert bound is not None and bound > 0.0, bound
+srv = make_server(client, "127.0.0.1", 0)
+port = srv.server_address[1]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+base = f"http://127.0.0.1:{port}"
+bc = telemetry.REGISTRY.counter("serve.bounded")
+before = bc.value
+body = json.dumps({"rows": X.tolist(), "raw_score": True}).encode()
+req = urllib.request.Request(f"{base}/predict", data=body,
+                             headers={"Content-Type": "application/json"})
+resp = json.loads(urllib.request.urlopen(req, timeout=120).read())
+got = np.asarray(resp["predictions"], np.float64)
+want = bst.predict(X, raw_score=True)
+err = float(np.max(np.abs(got - want)))
+assert err <= bound, f"HTTP bounded error {err} > published bound {bound}"
+assert bc.value > before, "response did not come off the bounded rung"
+hz = json.loads(urllib.request.urlopen(f"{base}/healthz",
+                                       timeout=30).read())
+hb = hz["bounded"]["default"]
+assert hb["active"] is True, hb
+assert hb["bound"] == bound, hb
+assert 0.0 <= hb["measured_max_abs_error"] <= bound, hb
+srv.shutdown()
+srv.server_close()
+client.close()
+print(f"[run_ci] bounded smoke: HTTP error {err:.3e} <= published "
+      f"bound {bound:.3e}, /healthz exposes the contract")
+EOF
+
+# quantized-default training smoke: under quantized gradients the auto
+# hist_impl resolution now lands on the int-lattice path by DEFAULT,
+# and must produce trees BYTE-IDENTICAL to an explicit
+# hist_impl=pallas_fused_q run (interpret-mode, wave policy) — the
+# default is a routing decision, never a numerics change.  The full
+# impl matrix + priced-fallback cases live in tests/test_bounded_serving.py
+JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import lightgbm_tpu as lgb
+
+rng = np.random.RandomState(5)
+X = rng.randn(1500, 8)
+y = (X[:, 0] - X[:, 1] + .3 * rng.randn(1500) > 0).astype(float)
+base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+        "use_quantized_grad": True, "num_grad_quant_bins": 8,
+        "tree_grow_policy": "wave"}
+
+
+def trees(extra):
+    bst = lgb.train({**base, **extra}, lgb.Dataset(X, label=y),
+                    num_boost_round=4)
+    s = bst.model_to_string()
+    return s[s.index("end of parameters"):]   # params echo the knobs
+
+
+auto = trees({})
+fused_q = trees({"hist_impl": "pallas_fused_q", "hist_interpret": True})
+assert auto == fused_q, \
+    "auto quantized-default trees != explicit pallas_fused_q trees"
+print("[run_ci] quantized-default smoke: auto == pallas_fused_q "
+      "(byte-identical trees)")
+EOF
+
 # external-memory smoke: a dataset ~4x the datastore budget trains via
 # the spilled shard store and must be byte-identical to the in-memory
 # model, with the prefetch pipeline's host residency inside the budget

@@ -142,6 +142,20 @@ def build_snapshot(rounds: int, rel_tol: float,
         fclient.close()
     finally:
         shutil.rmtree(fdir, ignore_errors=True)
+    # bounded serving segment (PR 19): one pinned predict through a
+    # serve_precision=bounded runtime so the baseline carries the
+    # serve.bounded counter and the serve.bounded.active/bound/
+    # measured_error{model=} contract gauges the sentinel rules watch
+    # (bounded.active down-is-bad, error_ratio up-is-bad in the bench
+    # block; serve.bounded_disabled{cause=} up-is-bad here).  The bound
+    # and the probe's measured error are pure functions of the pinned
+    # model + probe batch, so both gauges are deterministic
+    bclient = ServingClient(bst, params={"serve_max_wait_ms": 0.0,
+                                         "serve_warmup": False,
+                                         "serve_precision": "bounded"})
+    bclient.predict(np.ascontiguousarray(Xe[:64], dtype=np.float64),
+                    raw_score=True)
+    bclient.close()
     # memory segment (ISSUE 18): reconcile the device-memory ledger
     # against allocator truth so the baseline carries
     # mem.unattributed_bytes (up_is_bad — attribution rot fails the

@@ -13,6 +13,14 @@ rounds/s through the shard-streamed engine vs the assembled device
 matrix at the bench shape, shard passes, prefetch stall ratio and the
 device-staging watermark (byte identity asserted in-process).
 
+`--soak` adds a `soak` block after the main measurement: the ~60 s
+mini-soak acceptance run (lightgbm_tpu/soak/) — closed-loop
+multi-tenant traffic with an append-triggered gated hot-swap, drift,
+one chaos rung-kill, the byte-consistency oracle and the fitted
+step-load capacity model.  diff.py fails hard on byte_inconsistent /
+slo_breach / expect_fail rises and watches the capacity throughput
+fields as timing metrics.
+
 `--spool [dir]` (or BENCH_SPOOL_DIR) attaches both the orchestrator and
 the worker to a cross-process telemetry spool (telemetry/spool.py);
 merge it afterwards with `python -m lightgbm_tpu timeline <dir>`.
@@ -178,7 +186,7 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
           partial: bool, auc=None, pred=None, probe=None,
           telemetry=None, flight=None, pipeline=None,
           serving=None, streaming=None, memledger=None,
-          status=None) -> None:
+          soak=None, status=None) -> None:
     baseline = CUDA_ANCHOR_ROUNDS_PER_SEC * (ANCHOR_ROWS / n_rows)
     line = {
         "metric": f"boosting_rounds_per_sec_higgs{n_rows // 1000}k",
@@ -244,6 +252,13 @@ def _emit(rounds_per_sec: float, n_rows: int, backend: str,
         # on a peak_device_mb rise and watches the throughputs as
         # timing metrics
         line["streaming"] = streaming
+    if soak is not None:
+        # mini-soak acceptance run (@soak line, --soak mode): scenario
+        # expectations, byte-oracle verdict, SLO burn and the fitted
+        # capacity model — diff.py fails HARD on byte_inconsistent /
+        # slo_breach / expect_fail rises and watches the capacity
+        # rows/s + sustainable-QPS fields as timing metrics
+        line["soak"] = soak
     if status is not None:
         # explicit nothing-measured marker ("no-run"): report.py renders
         # it verbatim instead of presenting value=0 as a measurement
@@ -392,6 +407,9 @@ def _run_orchestrator() -> None:
         # shard-streamed vs assembled training comparison (same env
         # travel as --serve)
         env["BENCH_STREAMING"] = "1"
+    if "--soak" in sys.argv:
+        # mini-soak acceptance run (same env travel as --serve)
+        env["BENCH_SOAK"] = "1"
     spool_dir = os.environ.get("BENCH_SPOOL_DIR", "")
     if "--spool" in sys.argv:
         # cross-process telemetry spool: orchestrator + worker write
@@ -426,6 +444,7 @@ def _run_orchestrator() -> None:
     worker_serving = None
     worker_streaming = None
     worker_memledger = None
+    worker_soak = None
     platform = backend_tag
     deadline = time.time() + worker_timeout
     try:
@@ -509,6 +528,13 @@ def _run_orchestrator() -> None:
                             line.split(None, 1)[1])
                     except (ValueError, IndexError):
                         pass
+                elif line.startswith("@soak "):
+                    # mini-soak acceptance block (oracle/SLO/capacity)
+                    try:
+                        worker_soak = json.loads(
+                            line.split(None, 1)[1])
+                    except (ValueError, IndexError):
+                        pass
                 elif line.startswith("@memledger "):
                     # device-memory ledger roll-up (attributed owners,
                     # unattributed watermark, leak slope) — last wins,
@@ -531,7 +557,7 @@ def _run_orchestrator() -> None:
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
               serving=worker_serving, streaming=worker_streaming,
-              memledger=worker_memledger)
+              memledger=worker_memledger, soak=worker_soak)
     elif chunks:
         tot_r = sum(c[0] for c in chunks)
         tot_s = sum(c[1] for c in chunks)
@@ -539,7 +565,7 @@ def _run_orchestrator() -> None:
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
               serving=worker_serving, streaming=worker_streaming,
-              memledger=worker_memledger)
+              memledger=worker_memledger, soak=worker_soak)
     else:
         # nothing measured — still emit a parseable line (value 0, an
         # explicit machine-readable status) so the round records an
@@ -550,7 +576,8 @@ def _run_orchestrator() -> None:
               probe=probe_info, telemetry=worker_telemetry,
               flight=worker_flight, pipeline=worker_pipeline,
               serving=worker_serving, streaming=worker_streaming,
-              memledger=worker_memledger, status="no-run")
+              memledger=worker_memledger, soak=worker_soak,
+              status="no-run")
 
 
 # --------------------------------------------------------------------------
@@ -1123,6 +1150,31 @@ def _run_worker() -> None:
                  f"{blk['peak_device_mb']} MB")
         except Exception as e:  # pragma: no cover
             _log(f"streaming bench failed: {e}")
+    # mini-soak acceptance run (--soak): the composed production plane
+    # (datastore → daemon → gate → registry → tenancy → HTTP) under
+    # closed-loop multi-tenant traffic with an append-triggered hot-swap,
+    # drift injection and one chaos rung-kill, then the step-load
+    # capacity ladder — the byte-oracle / SLO / expectation verdicts and
+    # the fitted capacity model land in one BENCH `soak` block
+    if os.environ.get("BENCH_SOAK"):
+        try:
+            from lightgbm_tpu.soak import run_mini_soak
+            soak_params = {}
+            if os.environ.get("BENCH_SPOOL_DIR"):
+                soak_params["telemetry_spool_dir"] = \
+                    os.environ["BENCH_SPOOL_DIR"]
+            blk = run_mini_soak(params=soak_params)
+            print("@soak " + json.dumps(blk, separators=(",", ":")),
+                  flush=True)
+            _log(f"soak bench: {blk['requests']} requests, "
+                 f"{blk['byte_inconsistent']} byte-inconsistent, "
+                 f"{blk['expect_pass']}/{blk['expect_pass'] + blk['expect_fail']}"
+                 f" expectations, {blk['slo_breach']} SLO breaches, "
+                 f"capacity "
+                 f"{blk.get('capacity', {}).get('rows_per_sec_peak')}"
+                 f" rows/s peak")
+        except Exception as e:  # pragma: no cover
+            _log(f"soak bench failed: {e}")
     _stream_telemetry()
     _stream_flight(bst)
     _stream_memledger()

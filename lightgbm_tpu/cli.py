@@ -287,13 +287,20 @@ def run(argv: List[str]) -> int:
               "       python -m lightgbm_tpu memory"
               " [url | spool_dir] [--json]\n"
               "       python -m lightgbm_tpu compile-plan <model_file>"
-              " [serve_tile_vmem_kb=...] [--json]",
+              " [serve_tile_vmem_kb=...] [--json]\n"
+              "       python -m lightgbm_tpu soak <scenario>"
+              " [--minutes N] [--capacity] [--json]",
               file=sys.stderr)
         return 0
     if argv[0] == "compile-plan":
         # offline serving-compiler plan inspection (compiler/plan.py is
         # numpy-only, so this never touches a device)
         return _compile_plan_main(argv[1:])
+    if argv[0] == "soak":
+        # production soak harness (soak/): closed-loop multi-tenant
+        # traffic + chaos scenario + byte-oracle/SLO invariants
+        from .soak import main as soak_main
+        return soak_main(argv[1:])
     if argv[0] == "serve":
         # prediction-serving HTTP frontend (serving/http.py): stdlib
         # server over the micro-batched device runtime

@@ -9,7 +9,9 @@ time, and requests whose deadline passed while queued are dropped at
 flush time (both raise `ServingOverloadError`, both counted under
 `serve.shed` plus a per-cause counter — `serve.shed.queue_full` vs
 `serve.shed.deadline` — so overload causes are distinguishable at the
-metrics level).  Device failures inside the runtime degrade to the host
+metrics level; sheds landing while a registry hot-swap is building are
+additionally counted under `serve.shed.swap_window`, separating
+swap-cost sheds from pure load sheds).  Device failures inside the runtime degrade to the host
 walk there (`serve.host_walk{cause=}`), so a wedged accelerator slows
 serving
 rather than erroring it — the probe-wedge lesson from bench.py.
@@ -133,6 +135,12 @@ class MicroBatcher:
         except queue.Full:
             telemetry.REGISTRY.counter("serve.shed").inc()
             telemetry.REGISTRY.counter("serve.shed.queue_full").inc()
+            if telemetry.REGISTRY.gauge("serve.swap_windows").value > 0:
+                # a registry build-then-swap is in flight: the warmup /
+                # export work competes for the device, so this shed is
+                # swap-cost, not steady-state load — split it out so the
+                # soak harness can prove swap windows never shed silently
+                telemetry.REGISTRY.counter("serve.shed.swap_window").inc()
             trace.finish("shed_queue_full", "queue full at submit")
             telemetry.SERVE_RECORDER.record(trace)
             raise ServingOverloadError(
@@ -224,6 +232,9 @@ class MicroBatcher:
                 # (or will) — don't burn device time on a dead request
                 telemetry.REGISTRY.counter("serve.shed").inc()
                 telemetry.REGISTRY.counter("serve.shed.deadline").inc()
+                if telemetry.REGISTRY.gauge("serve.swap_windows").value > 0:
+                    telemetry.REGISTRY.counter(
+                        "serve.shed.swap_window").inc()
                 req.error = ServingOverloadError(
                     "request deadline exceeded while queued")
                 self._finalize(req, "shed_deadline",

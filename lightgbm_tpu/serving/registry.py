@@ -28,6 +28,7 @@ refresh (tests/test_fleet.py pins this).
 """
 from __future__ import annotations
 
+import contextlib
 import threading
 import time
 from typing import Dict, List, Optional, Union
@@ -47,6 +48,35 @@ from .sharded import ShardedServingRuntime
 #: registry never comes close; the bound turns a pathological
 #: swap-storm into a clean error instead of an unbounded loop
 _SWAP_RETRIES = 8
+
+# process-wide count of build-then-swap loads currently in flight,
+# published as the `serve.swap_windows` gauge.  The batcher reads the
+# gauge on every shed to attribute it (`serve.shed.swap_window`), and
+# the soak harness uses it to prove hot-swap windows never shed
+# silently — a plain gauge, so the split is observable cross-module
+# without an import cycle.
+_swap_window_lock = threading.Lock()
+_swap_window_count = 0
+
+
+def _note_swap_window(delta: int) -> None:
+    global _swap_window_count
+    with _swap_window_lock:
+        _swap_window_count = max(0, _swap_window_count + delta)
+        count = _swap_window_count
+    telemetry.REGISTRY.gauge("serve.swap_windows").set(count)
+
+
+@contextlib.contextmanager
+def _swap_window():
+    """Marks one build-then-swap window (runtime build, warmup, swap):
+    the phase whose device/CPU contention makes concurrent sheds
+    swap-cost rather than steady-state load."""
+    _note_swap_window(1)
+    try:
+        yield
+    finally:
+        _note_swap_window(-1)
 
 
 class ServingModel:
@@ -139,6 +169,11 @@ class ModelRegistry:
         # with every request's row block, outside the serving data path
         # — sampling never touches the bytes served
         self._samplers: Dict[str, List[object]] = {}  # guarded-by: _lock
+        # load observers (soak byte-oracle, lineage tooling): each is
+        # called with (name, booster, entry) after a load goes live —
+        # the only way an external checker can hold a reference to
+        # every booster VERSION a name has served, not just the latest
+        self._load_listeners: List[object] = []  # guarded-by: _lock
         if self._config.debug_locks:
             # runtime half of graft-race R006 — see booster.py for the
             # matching training-side switch; sticky process-global
@@ -178,7 +213,7 @@ class ModelRegistry:
         cfg = self._config
         if shard_devices is None:
             shard_devices = int(cfg.serve_shard_devices)
-        with telemetry.span("serve.load", model=name):
+        with _swap_window(), telemetry.span("serve.load", model=name):
             if shard_devices != 1:
                 # replicated sharded plane: one pinned runtime per mesh
                 # device, striped by least-outstanding-work (sharded.py)
@@ -237,6 +272,18 @@ class ModelRegistry:
         except Exception:
             pass
         self._update_vram_gauge()
+        # notify load observers BEFORE the predecessor drains: a
+        # byte-consistency oracle must learn the successor is live while
+        # in-flight requests on the old version can still complete, so
+        # both versions' windows overlap the swap instant.  Observer
+        # exceptions never fail a completed load.
+        with self._lock:
+            listeners = list(self._load_listeners)
+        for hook in listeners:
+            try:
+                hook(name, booster, entry)
+            except Exception:
+                telemetry.REGISTRY.counter("serve.load_listener_errors").inc()
         if old is not None:
             old.close()
         return entry
@@ -363,6 +410,23 @@ class ModelRegistry:
         with self._lock:
             self._samplers.setdefault(name, []).append(sampler)
 
+    def add_load_listener(self, hook) -> None:
+        """Register a load observer: `hook(name, booster, entry)` runs
+        after every successful `load` goes live (and before the
+        replaced entry drains).  The soak harness's byte-consistency
+        oracle attaches here to track every live model VERSION."""
+        with self._lock:
+            self._load_listeners.append(hook)
+
+    def remove_load_listener(self, hook=None) -> None:
+        """Detach one observer (by identity) or, with `hook=None`, all."""
+        with self._lock:
+            if hook is None:
+                self._load_listeners.clear()
+            else:
+                self._load_listeners = [
+                    h for h in self._load_listeners if h is not hook]
+
     def detach_sampler(self, name: str, sampler=None) -> None:
         """Detach one sampler (by identity) or, with `sampler=None`,
         every sampler registered for the model."""
@@ -405,6 +469,15 @@ class ModelRegistry:
                 if cur is None or cur is entry:
                     raise
         telemetry.REGISTRY.counter("serve.swap_retry_exhausted").inc()
+        # per-cause attribution next to the aggregate: `swap_window`
+        # when a build-then-swap is STILL in flight (the storm is live —
+        # a retry after backoff will land), `swap_storm` when the churn
+        # already settled (the caller raced a burst that is over)
+        cause = "swap_window" \
+            if telemetry.REGISTRY.gauge("serve.swap_windows").value > 0 \
+            else "swap_storm"
+        telemetry.REGISTRY.counter("serve.swap_retry_exhausted",
+                                   cause=cause).inc()
         raise ServingClosedError(
             f"model {model!r} was hot-swapped {_SWAP_RETRIES} times "
             "mid-dispatch; giving up — retry the request")

@@ -1,0 +1,131 @@
+"""Production soak harness: closed-loop multi-tenant traffic, chaos
+scenarios, and a falsifiable capacity model.
+
+The package composes the subsystems the repo already ships — datastore
++ trainer daemon + shadow gate + model registry + tenancy + resilience
+plane + telemetry spool — into ONE closed-loop run and checks the
+invariants that only show up under sustained concurrent load:
+
+ - `traffic`  — deterministic per-tenant load generator + the
+   byte-consistency oracle (every response byte-identical to some
+   registry-lineage model version live during the request window);
+ - `scenario` — declarative `at <T>s: <action>` timelines with online
+   expectations checked against live gauges/ledger records;
+ - `capacity` — step-load prober fitting a falsifiable capacity model
+   (service rate, per-class sustainable QPS, shed onset) whose
+   regression the `telemetry diff` sentinel rules catch;
+ - `harness`  — the composed plane + report assembly +
+   `run_mini_soak()`, the ~60 s acceptance run shared by
+   `bench.py --soak`, the CI smoke and the slow test.
+
+Orchestration is stdlib-only; jax appears only behind worker-side
+probes (device count) and the serving plane itself.
+
+CLI: `python -m lightgbm_tpu soak <scenario> [--minutes N]
+[--capacity] [--json] [key=value ...]` — scenario is a built-in name
+(`smoke`/`steady`/`chaos`), a file path, or inline text.
+"""
+from __future__ import annotations
+
+import json as _json
+import sys
+from typing import List
+
+from .capacity import CapacityProber, capacity_at, fit_queue_model
+from .harness import SoakHarness, TenantGateway, run_mini_soak
+from .scenario import (SCENARIOS, Scenario, ScenarioRunner, load_scenario,
+                       parse_scenario)
+from .traffic import ByteOracle, TenantStream, TrafficGenerator
+
+__all__ = [
+    "SoakHarness", "TenantGateway", "run_mini_soak",
+    "Scenario", "ScenarioRunner", "load_scenario", "parse_scenario",
+    "SCENARIOS",
+    "ByteOracle", "TenantStream", "TrafficGenerator",
+    "CapacityProber", "fit_queue_model", "capacity_at",
+    "main",
+]
+
+
+def main(argv: List[str]) -> int:
+    """`python -m lightgbm_tpu soak <scenario> [--minutes N]
+    [--capacity] [--json] [--spool dir] [key=value ...]`"""
+    flags = {"--json": False, "--capacity": False}
+    minutes = None
+    spool = None
+    rest: List[str] = []
+    it = iter(argv)
+    for tok in it:
+        if tok in flags:
+            flags[tok] = True
+        elif tok == "--minutes":
+            minutes = float(next(it, "1"))
+        elif tok == "--spool":
+            spool = next(it, None)
+        elif tok in ("-h", "--help"):
+            print("usage: python -m lightgbm_tpu soak <scenario> "
+                  "[--minutes N] [--capacity] [--json] [--spool dir] "
+                  "[soak_qps=... soak_tenants=... key=value ...]\n"
+                  "scenarios: " + ", ".join(sorted(SCENARIOS))
+                  + " | a file path | inline text", file=sys.stderr)
+            return 0
+        else:
+            rest.append(tok)
+    scenario = "smoke"
+    params = {}
+    from ..cli import parse_args
+    kv = [t for t in rest if "=" in t]
+    pos = [t for t in rest if "=" not in t]
+    if pos:
+        scenario = pos[0]
+    if kv:
+        params = parse_args(kv)
+    if spool:
+        params.setdefault("telemetry_spool_dir", spool)
+    block = run_mini_soak(minutes=minutes, params=params,
+                          scenario=scenario,
+                          capacity=flags["--capacity"])
+    if flags["--json"]:
+        print(_json.dumps(block, sort_keys=True))
+    else:
+        _print_report(block)
+    bad = (block["byte_inconsistent"] > 0 or block["expect_fail"] > 0
+           or block["slo_breach"] > 0)
+    return 1 if bad else 0
+
+
+def _print_report(block: dict) -> None:
+    print(f"soak {block['scenario']!r}: {block['duration_s']:g}s, "
+          f"{block['requests']} requests "
+          f"({block['ok']} ok, {block['errors']} errors)")
+    print(f"  byte-oracle: {block['oracle_checked']} checked, "
+          f"{block['byte_inconsistent']} inconsistent")
+    print(f"  lifecycle: swaps={block['swaps']} "
+          f"gate_pass={block['gate_pass']} gate_fail={block['gate_fail']} "
+          f"breaker_recovered={block['breaker_recovered']}")
+    sheds = block["sheds"]
+    print(f"  sheds: total={sheds['total']} "
+          f"swap_window={sheds['swap_window']} "
+          f"slo_admission={sheds['slo_admission']} "
+          f"unattributed_swap={sheds['unattributed_swap']}")
+    for name, s in sorted(block["slo"].items()):
+        mark = "ok" if s["within_budget"] else "BREACH"
+        print(f"  slo {name} ({s['class']}): p99 "
+              f"{s['observed_p99_ms']:g}ms / {s['budget_ms']:g}ms "
+              f"burn={s['burn_rate']:g} [{mark}]")
+    print(f"  expectations: {block['expect_pass']} pass, "
+          f"{block['expect_fail']} fail"
+          + (f" — {block['expect_detail']}" if block["expect_detail"]
+             else ""))
+    cap = block.get("capacity")
+    if cap:
+        line = (f"  capacity: peak {cap['rows_per_sec_peak']:g} rows/s "
+                f"({cap['rows_per_sec_per_device']:g}/device)")
+        if cap.get("service_rate_qps") is not None:
+            line += f", service rate {cap['service_rate_qps']:g} qps"
+        if cap.get("breach_class"):
+            line += (f", first breach {cap['breach_class']} "
+                     f"@ {cap['breach_qps']:g} qps")
+        print(line)
+        for cls, q in sorted(cap.get("capacity_qps", {}).items()):
+            print(f"    sustainable {cls}: {q:g} qps")

@@ -1,0 +1,190 @@
+"""Step-load capacity prober + falsifiable capacity model.
+
+Walks the aggregate offered QPS up a geometric ladder
+(`soak_capacity_start_qps` × `soak_capacity_factor`^k, one
+`soak_capacity_step_s` window per rung) until the first SLO-class p99
+breach, then fits the measured (qps, p99) points to a single-server
+queueing latency curve
+
+    p99(q) = base_ms + coef / (service_rate_qps - q)
+
+by grid-searching the service rate and solving the remaining linear
+least squares in closed form.  The fit is the *falsifiable* part: it
+predicts, per SLO class, the maximum sustainable QPS
+`capacity_qps[class] = mu - coef / (budget_ms - base_ms)` — a number a
+future regression moves DOWN, which is exactly what the diff.py
+sentinel rules watch (`soak.capacity.*` down-is-bad, timing class).
+
+Everything here is wall-clock measurement over the live traffic
+generator — no synthetic queueing simulation; the model is only ever
+fitted to what the composed serving plane actually did.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .. import telemetry
+from .traffic import percentile
+
+#: below this many latency samples a step's p99 is noise, not signal —
+#: the step still records, but never declares an SLO breach
+MIN_STEP_SAMPLES = 20
+
+
+def _device_count() -> int:
+    """Visible accelerator (or host) device count — jax stays confined
+    to this worker-side probe, per the package's stdlib-orchestration
+    contract."""
+    try:
+        import jax
+        return max(1, len(jax.devices()))
+    except Exception:
+        return 1
+
+
+def fit_queue_model(points: List[tuple]) -> Optional[dict]:
+    """Least-squares fit of p99_ms = base + coef / (mu - qps) over
+    measured (qps, p99_ms) points; `mu` (the service rate) is grid
+    searched above the highest measured rate.  Returns None with < 2
+    usable points — a model fitted to one point is not falsifiable."""
+    pts = [(float(q), float(p)) for q, p in points if p > 0]
+    if len(pts) < 2:
+        return None
+    qmax = max(q for q, _ in pts)
+    best = None
+    for i in range(1, 121):
+        mu = qmax * (1.0 + 0.05 * i)  # 1.05x .. 7x the observed peak
+        xs = [1.0 / (mu - q) for q, _ in pts]
+        ys = [p for _, p in pts]
+        n = len(xs)
+        sx, sy = sum(xs), sum(ys)
+        sxx = sum(x * x for x in xs)
+        sxy = sum(x * y for x, y in zip(xs, ys))
+        denom = n * sxx - sx * sx
+        if abs(denom) < 1e-12:
+            continue
+        coef = (n * sxy - sx * sy) / denom
+        base = (sy - coef * sx) / n
+        if coef <= 0:
+            continue  # latency must RISE toward saturation
+        sse = sum((base + coef * x - y) ** 2 for x, y in zip(xs, ys))
+        if best is None or sse < best["sse"]:
+            best = {"service_rate_qps": round(mu, 3),
+                    "base_ms": round(base, 3),
+                    "coef": round(coef, 4),
+                    "sse": round(sse, 4),
+                    "points": len(pts)}
+    return best
+
+
+def capacity_at(fit: Optional[dict], budget_ms: float) -> Optional[float]:
+    """Max sustainable QPS at a p99 budget, per the fitted curve."""
+    if fit is None or budget_ms <= fit["base_ms"]:
+        return 0.0 if fit is not None else None
+    q = fit["service_rate_qps"] - fit["coef"] / (budget_ms
+                                                 - fit["base_ms"])
+    return round(max(0.0, min(q, fit["service_rate_qps"])), 3)
+
+
+class CapacityProber:
+    """Drives the harness's traffic generator up the QPS ladder and
+    assembles the BENCH `soak.capacity` block."""
+
+    def __init__(self, harness, step_s: float = 3.0,
+                 start_qps: float = 16.0, factor: float = 1.6,
+                 max_steps: int = 8):
+        self.harness = harness
+        self.step_s = max(0.5, float(step_s))
+        self.start_qps = max(1.0, float(start_qps))
+        self.factor = max(1.1, float(factor))
+        self.max_steps = max(1, int(max_steps))
+
+    def run(self) -> dict:
+        h = self.harness
+        tenants = list(h.traffic.streams.values())
+        n_tenants = max(1, len(tenants))
+        budgets = {s.name: h.slo_budget_ms(s.name) for s in tenants}
+        steps: List[dict] = []
+        breach_class: Optional[str] = None
+        breach_qps: Optional[float] = None
+        shed_onset: Optional[float] = None
+        qps = self.start_qps
+        for _ in range(self.max_steps):
+            h.traffic.set_qps(qps / n_tenants)
+            h.traffic.take_windows()          # drop the ramp transient
+            time.sleep(self.step_s)
+            windows = h.traffic.take_windows()
+            step = self._measure(qps, windows, budgets)
+            steps.append(step)
+            telemetry.REGISTRY.gauge("soak.capacity.step_qps").set(qps)
+            if shed_onset is None and step["shed"] > 0:
+                shed_onset = qps
+            if step["breach"]:
+                breach_class = step["breach"]
+                breach_qps = qps
+                break
+            qps *= self.factor
+        fit = fit_queue_model([(s["qps_achieved"], s["p99_ms"])
+                               for s in steps])
+        classes = {s.slo: budgets[s.name] for s in tenants}
+        capacity = {cls: capacity_at(fit, budget)
+                    for cls, budget in classes.items()}
+        peak_rows = max((s["rows_per_sec"] for s in steps), default=0.0)
+        devices = _device_count()
+        block = {
+            "steps": steps,
+            "devices": devices,
+            "replicas": int(telemetry.REGISTRY.gauge(
+                "serve.replicas").value) or 1,
+            "rows_per_sec_peak": round(peak_rows, 3),
+            "rows_per_sec_per_device": round(peak_rows / devices, 3),
+            "shed_onset_qps": shed_onset,
+            "breach_class": breach_class,
+            "breach_qps": breach_qps,
+        }
+        if fit is not None:
+            block["service_rate_qps"] = fit["service_rate_qps"]
+            block["base_ms"] = fit["base_ms"]
+            block["coef"] = fit["coef"]
+            block["fit_sse"] = fit["sse"]
+            block["capacity_qps"] = {
+                cls: cap for cls, cap in capacity.items()
+                if cap is not None}
+        telemetry.LEDGER.record(
+            "soak.capacity", model=h.daemon_model,
+            steps=len(steps), breach_class=breach_class,
+            rows_per_sec_per_device=block["rows_per_sec_per_device"],
+            service_rate_qps=block.get("service_rate_qps"))
+        return block
+
+    def _measure(self, qps_target: float, windows: Dict[str, dict],
+                 budgets: Dict[str, float]) -> dict:
+        total_req = sum(len(w["latencies"]) + w["shed"] + w["errors"]
+                        for w in windows.values())
+        total_rows = sum(w["rows"] for w in windows.values())
+        all_lat = [v for w in windows.values() for v in w["latencies"]]
+        per_tenant = {}
+        breach = None  # (class rank, class name) — best rank wins
+        for name, w in sorted(windows.items()):
+            lat = w["latencies"]
+            p99 = percentile(lat, 0.99) * 1e3
+            per_tenant[name] = {"p99_ms": round(p99, 3),
+                                "requests": len(lat),
+                                "shed": w["shed"]}
+            stream = self.harness.traffic.streams[name]
+            if len(lat) >= MIN_STEP_SAMPLES and p99 > budgets[name]:
+                rank = self.harness.slo_rank(name)
+                if breach is None or rank < breach[0]:
+                    breach = (rank, stream.slo)
+        return {
+            "qps_target": round(qps_target, 3),
+            "qps_achieved": round(total_req / self.step_s, 3),
+            "rows_per_sec": round(total_rows / self.step_s, 3),
+            "p50_ms": round(percentile(all_lat, 0.50) * 1e3, 3),
+            "p99_ms": round(percentile(all_lat, 0.99) * 1e3, 3),
+            "shed": sum(w["shed"] for w in windows.values()),
+            "errors": sum(w["errors"] for w in windows.values()),
+            "tenants": per_tenant,
+            "breach": breach[1] if breach else None,
+        }

@@ -1,0 +1,444 @@
+"""Declarative soak scenarios: a timeline of stimuli + online checks.
+
+Grammar (one event per line, `#` comments, times relative to run start):
+
+    at 2s:  append rows=1536         # grow the datastore (daemon retrains)
+    at 4s:  expect swap min=1 within=25s
+    at 4s:  expect gate_pass min=1 within=25s
+    at 10s: drift feature=3 shift=4.0
+    at 14s: kill rung=device_sum for=2s
+    at 20s: expect breaker_recovered rung=device_sum within=30s
+    at 28s: expect burn_rate tenant=t0 max=1.0
+    at 28s: expect byte_consistent
+    at 30s: end
+
+Tokens are `key=value` pairs; a few positional shorthands keep the
+prose-ish forms readable: `append 50k rows` (a bare magnitude is the
+row count, `rows` is filler), `drift into f3` (fN names the feature,
+`into` is filler), `kill device_sum` (a bare word is the rung), and
+`expect swap` (the first bare word is the condition).  Magnitudes
+accept `k`/`m` suffixes.
+
+Actions: `append` (rows), `drift` (feature, shift), `kill` (rung,
+mode=error, for=S seconds until auto-heal, p=, n=), `heal` (disarm all
+faults), `qps` (value, tenant=all), `expect`, `end` (sets the horizon).
+
+Expectations are checked ONLINE against the gauges/counters/ledger the
+subsystems already publish — the checker polls until the condition
+holds or `within=` expires, then counts `soak.expect.pass`/`.fail` and
+writes a `soak.expect` ledger record with the observed value.  Counter
+conditions are DELTAS from the scenario start (captured per expectation
+when the runner launches), so a long-lived process's history never
+satisfies a fresh run:
+
+    swap               min=1          `swap` ledger records (daemon model)
+    gate_pass          min=1          fleet.gate.pass counter delta
+    breaker_recovered  rung=R min=1   serve.breaker.recovered{rung=} delta
+    burn_rate          tenant=T max=X fleet.slo.burn_rate{tenant=} gauge
+    byte_consistent                   oracle inconsistent == 0
+    mem_ok                            mem.budget_violation family delta == 0
+    counter            name=N [label=k:v] min=/max=   any counter delta
+    gauge              name=N [label=k:v] min=/max=   any gauge, absolute
+"""
+from __future__ import annotations
+
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry
+from ..resilience import FAULTS
+from ..utils.log import LightGBMError
+
+#: expectation poll cadence — coarse enough to stay off the hot path,
+#: fine enough that `within=` deadlines resolve promptly
+POLL_S = 0.2
+
+_LINE_RE = re.compile(r"^at\s+([0-9.]+)\s*s?\s*:\s*([a-z_]+)\s*(.*)$",
+                      re.IGNORECASE)
+_MAG_RE = re.compile(r"^([0-9.]+)([kKmM]?)$")
+
+ACTIONS = ("append", "drift", "kill", "heal", "qps", "expect", "end")
+
+#: built-in scenarios, runnable by name from the CLI / bench / CI.
+#: `smoke` is the ~60 s mini-soak acceptance path: one append-triggered
+#: gated hot-swap, drift injection, one rung kill with breaker
+#: recovery, SLO burn + byte-oracle checks — all expectations online.
+SCENARIOS: Dict[str, str] = {
+    "smoke": """\
+# mini-soak: append-triggered gated hot-swap + drift + chaos rung-kill
+at 2s:  append rows=1536
+at 4s:  expect gate_pass min=1 within=25s
+at 4s:  expect swap min=1 within=25s
+at 10s: drift into f3 shift=4.0
+at 14s: kill rung=device_sum for=2s
+at 17s: expect breaker_recovered rung=device_sum within=30s
+at 28s: expect burn_rate tenant=t0 max=1.0
+at 28s: expect byte_consistent
+at 28s: expect mem_ok
+at 32s: end
+""",
+    "steady": """\
+# steady-state: traffic only, SLO + oracle checks at the end
+at 10s: expect byte_consistent
+at 10s: expect burn_rate tenant=t0 max=1.0
+at 12s: end
+""",
+    "chaos": """\
+# chaos: overlapping swap + rung kills under sustained traffic
+at 2s:  append rows=1536
+at 6s:  kill rung=device_sum for=3s
+at 8s:  append rows=1536
+at 16s: kill rung=compiled for=2s
+at 24s: expect swap min=1 within=30s
+at 30s: expect breaker_recovered rung=device_sum within=40s
+at 40s: expect byte_consistent
+at 42s: end
+""",
+}
+
+
+def _magnitude(text: str) -> Optional[float]:
+    m = _MAG_RE.match(text)
+    if not m:
+        return None
+    val = float(m.group(1))
+    suffix = m.group(2).lower()
+    return val * {"": 1, "k": 1e3, "m": 1e6}[suffix]
+
+
+def _value(text: str) -> Any:
+    mag = _magnitude(text)
+    if mag is not None:
+        return int(mag) if mag == int(mag) else mag
+    if text.lower().endswith("s") and _magnitude(text[:-1]) is not None:
+        return _magnitude(text[:-1])  # "25s" → 25.0 (within=25s)
+    return text
+
+
+class ScenarioEvent:
+    __slots__ = ("t", "action", "kwargs")
+
+    def __init__(self, t: float, action: str, kwargs: Dict[str, Any]):
+        self.t = float(t)
+        self.action = action
+        self.kwargs = kwargs
+
+    def __repr__(self):
+        return f"at {self.t:g}s: {self.action} {self.kwargs}"
+
+
+class Scenario:
+    """Parsed timeline; `horizon` is the `end` event (or the last
+    event + a small tail when the author omitted one)."""
+
+    def __init__(self, events: List[ScenarioEvent],
+                 name: str = "inline"):
+        self.name = name
+        self.events = sorted(events, key=lambda e: e.t)
+        ends = [e.t for e in self.events if e.action == "end"]
+        last = max((e.t for e in self.events), default=0.0)
+        self.horizon = ends[0] if ends else last + 2.0
+
+
+def _positional(action: str, tok: str, kwargs: Dict[str, Any]) -> None:
+    """Fold a bare (non key=value) token into the action's kwargs."""
+    low = tok.lower()
+    if low in ("rows", "into", "rung", "the"):
+        return  # prose filler: "append 50k rows", "drift into f3"
+    mag = _magnitude(tok)
+    if action == "append" and mag is not None:
+        kwargs.setdefault("rows", int(mag))
+        return
+    if action == "drift":
+        m = re.match(r"^f(\d+)$", low)
+        if m:
+            kwargs.setdefault("feature", int(m.group(1)))
+            return
+    if action in ("kill", "heal"):
+        kwargs.setdefault("rung", tok)
+        return
+    if action == "expect":
+        kwargs.setdefault("cond", low)
+        return
+    if action == "qps" and mag is not None:
+        kwargs.setdefault("value", mag)
+        return
+    raise LightGBMError(
+        f"scenario: stray token {tok!r} for action {action!r}")
+
+
+def parse_scenario(text: str, name: str = "inline") -> Scenario:
+    events: List[ScenarioEvent] = []
+    for lineno, raw_line in enumerate(text.splitlines(), 1):
+        line = raw_line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        m = _LINE_RE.match(line)
+        if not m:
+            raise LightGBMError(
+                f"scenario line {lineno}: {raw_line!r} is not "
+                f"'at <T>s: <action> [args]'")
+        t, action, rest = float(m.group(1)), m.group(2).lower(), m.group(3)
+        if action not in ACTIONS:
+            raise LightGBMError(
+                f"scenario line {lineno}: unknown action {action!r} "
+                f"(expected one of {ACTIONS})")
+        kwargs: Dict[str, Any] = {}
+        for tok in rest.split():
+            if "=" in tok:
+                k, v = tok.split("=", 1)
+                kwargs[k.strip().lower()] = _value(v.strip())
+            else:
+                _positional(action, tok, kwargs)
+        events.append(ScenarioEvent(t, action, kwargs))
+        # a bounded kill auto-heals: expand `for=S` into a heal event
+        if action == "kill" and "for" in kwargs:
+            events.append(ScenarioEvent(t + float(kwargs["for"]),
+                                        "heal", {}))
+    return Scenario(events, name=name)
+
+
+def load_scenario(spec: str) -> Scenario:
+    """A built-in name (`smoke`/`steady`/`chaos`), a file path, or
+    inline scenario text (anything containing a newline)."""
+    if spec in SCENARIOS:
+        return parse_scenario(SCENARIOS[spec], name=spec)
+    if "\n" in spec:
+        return parse_scenario(spec, name="inline")
+    with open(spec) as f:
+        return parse_scenario(f.read(), name=spec)
+
+
+# ---------------------------------------------------------------------------
+# expectations
+# ---------------------------------------------------------------------------
+
+class Expectation:
+    """One online check: poll `probe()` until truthy or deadline."""
+
+    def __init__(self, cond: str, kwargs: Dict[str, Any],
+                 deadline_t: float, probe):
+        self.cond = cond
+        self.kwargs = kwargs
+        self.deadline_t = deadline_t     # absolute monotonic deadline
+        self.probe = probe               # () -> (ok, observed)
+        self.passed: Optional[bool] = None
+        self.observed: Any = None
+
+    def describe(self) -> str:
+        args = " ".join(f"{k}={v}" for k, v in sorted(self.kwargs.items())
+                        if k not in ("cond", "within"))
+        return f"{self.cond} {args}".strip()
+
+
+def _labels(kwargs: Dict[str, Any]) -> Dict[str, str]:
+    """`label=k:v` → {k: v} for generic counter/gauge conditions."""
+    lab = kwargs.get("label")
+    if not lab:
+        return {}
+    k, _, v = str(lab).partition(":")
+    return {k: v}
+
+
+class ScenarioRunner(threading.Thread):
+    """Executes a scenario against a `SoakHarness` (duck-typed: needs
+    `append_rows`, `traffic`, `oracle`, `daemon_model`).  Expectation
+    checkers run inside the runner's poll loop — one thread total, no
+    checker-thread explosion."""
+
+    def __init__(self, scenario: Scenario, harness):
+        super().__init__(name=f"soak-scenario-{scenario.name}",
+                         daemon=True)
+        self.scenario = scenario
+        self.harness = harness
+        self.results: List[Expectation] = []
+        self._halt = threading.Event()
+        self._t0: Optional[float] = None
+
+    # --------------------------------------------------------- baselines
+    def _counter_delta(self, name: str, **labels):
+        base_key = (name, tuple(sorted(labels.items())))
+        base = self._baselines.get(base_key, 0.0)
+        return telemetry.REGISTRY.counter(name, **labels).value - base
+
+    def _snap_counter(self, name: str, **labels) -> None:
+        key = (name, tuple(sorted(labels.items())))
+        self._baselines[key] = telemetry.REGISTRY.counter(
+            name, **labels).value
+
+    def _swap_count(self) -> int:
+        model = self.harness.daemon_model
+        return sum(1 for r in telemetry.LEDGER.records(model=model)
+                   if r.get("name") == "swap")
+
+    def _mem_violations(self) -> float:
+        return sum(c.value for c in telemetry.REGISTRY.counter_family(
+            "mem.budget_violation"))
+
+    # ------------------------------------------------------- cond probes
+    def _make_probe(self, cond: str, kw: Dict[str, Any]):
+        reg = telemetry.REGISTRY
+        if cond == "swap":
+            base = self._swap_count()
+            need = int(kw.get("min", 1))
+            return lambda: ((self._swap_count() - base) >= need,
+                            self._swap_count() - base)
+        if cond == "gate_pass":
+            self._snap_counter("fleet.gate.pass")
+            need = int(kw.get("min", 1))
+            return lambda: (self._counter_delta("fleet.gate.pass") >= need,
+                            self._counter_delta("fleet.gate.pass"))
+        if cond == "breaker_recovered":
+            rung = str(kw.get("rung", "device_sum"))
+            self._snap_counter("serve.breaker.recovered", rung=rung)
+            need = int(kw.get("min", 1))
+            return lambda: (
+                self._counter_delta("serve.breaker.recovered",
+                                    rung=rung) >= need,
+                self._counter_delta("serve.breaker.recovered", rung=rung))
+        if cond == "burn_rate":
+            tenant = str(kw.get("tenant", self.harness.daemon_model))
+            cap = float(kw.get("max", 1.0))
+            gauge = reg.gauge("fleet.slo.burn_rate", tenant=tenant)
+            return lambda: (gauge.value <= cap, round(gauge.value, 4))
+        if cond == "byte_consistent":
+            oracle = self.harness.oracle
+            return lambda: (oracle.inconsistent == 0, oracle.inconsistent)
+        if cond == "mem_ok":
+            base = self._mem_violations()
+            return lambda: (self._mem_violations() - base <= 0,
+                            self._mem_violations() - base)
+        if cond == "counter":
+            name = str(kw["name"])
+            labels = _labels(kw)
+            self._snap_counter(name, **labels)
+
+            def probe():
+                delta = self._counter_delta(name, **labels)
+                ok = delta >= kw["min"] if "min" in kw else True
+                if "max" in kw:
+                    ok = ok and delta <= kw["max"]
+                return ok, delta
+            return probe
+        if cond == "gauge":
+            name = str(kw["name"])
+            gauge = reg.gauge(name, **_labels(kw))
+
+            def probe():
+                v = gauge.value
+                ok = v >= kw["min"] if "min" in kw else True
+                if "max" in kw:
+                    ok = ok and v <= kw["max"]
+                return ok, v
+            return probe
+        raise LightGBMError(f"scenario: unknown expect condition {cond!r}")
+
+    # --------------------------------------------------------- execution
+    def _dispatch(self, ev: ScenarioEvent) -> None:
+        h, kw = self.harness, ev.kwargs
+        if ev.action == "append":
+            h.append_rows(int(kw.get("rows", 1024)))
+        elif ev.action == "drift":
+            h.traffic.inject_drift(int(kw.get("feature", 0)),
+                                   float(kw.get("shift", 2.0)),
+                                   tenant=kw.get("tenant"))
+        elif ev.action == "kill":
+            rung = str(kw.get("rung", "device_sum"))
+            mode = str(kw.get("mode", "error"))
+            spec = f"serve.dispatch.{rung}:{mode}"
+            if "p" in kw:
+                spec += f"@p={kw['p']}"
+            if "n" in kw:
+                spec += f"@n={int(kw['n'])}"
+            FAULTS.arm(spec)
+            telemetry.LEDGER.record("soak.chaos", model=h.daemon_model,
+                                    spec=spec)
+        elif ev.action == "heal":
+            FAULTS.disarm()
+        elif ev.action == "qps":
+            value = float(kw.get("value", 10.0))
+            tenant = kw.get("tenant")
+            if tenant:
+                h.traffic.streams[str(tenant)].set_qps(value)
+            else:
+                h.traffic.set_qps(value)
+        elif ev.action == "expect":
+            cond = str(kw.get("cond", "byte_consistent"))
+            within = float(kw.get("within", 10.0))
+            # probes (and their counter baselines) were built at run
+            # start — an effect landing between its stimulus and the
+            # expect's timeline slot still counts
+            probe = self._probes.pop(id(ev), None) \
+                or self._make_probe(cond, kw)
+            exp = Expectation(cond, kw, time.monotonic() + within, probe)
+            self._pending.append(exp)
+            self.results.append(exp)
+        # "end" is a marker: the harness reads scenario.horizon
+
+    def _poll_pending(self, now: float) -> None:
+        still = []
+        for exp in self._pending:
+            try:
+                ok, observed = exp.probe()
+            except Exception as e:  # a probe bug must not kill the run
+                ok, observed = False, f"probe error: {e}"
+            exp.observed = observed
+            if ok:
+                exp.passed = True
+            elif now >= exp.deadline_t:
+                exp.passed = False
+            else:
+                still.append(exp)
+                continue
+            self._settle(exp)
+        self._pending = still
+
+    def _settle(self, exp: Expectation) -> None:
+        name = "soak.expect.pass" if exp.passed else "soak.expect.fail"
+        telemetry.REGISTRY.counter(name).inc()
+        telemetry.LEDGER.record(
+            "soak.expect", model=self.harness.daemon_model,
+            expect=exp.describe(), passed=bool(exp.passed),
+            observed=str(exp.observed))
+
+    def run(self) -> None:
+        self._baselines: Dict[tuple, float] = {}
+        self._pending: List[Expectation] = []
+        # snap every expectation's counter baseline NOW, before any
+        # stimulus fires: deltas measure "since the scenario started",
+        # not "since the expect line's slot on the timeline"
+        self._probes: Dict[int, Any] = {}
+        for ev in self.scenario.events:
+            if ev.action == "expect":
+                cond = str(ev.kwargs.get("cond", "byte_consistent"))
+                self._probes[id(ev)] = self._make_probe(cond, ev.kwargs)
+        self._t0 = time.monotonic()
+        telemetry.LEDGER.record("soak.scenario",
+                                model=self.harness.daemon_model,
+                                scenario=self.scenario.name,
+                                events=len(self.scenario.events),
+                                horizon_s=self.scenario.horizon)
+        queue = list(self.scenario.events)
+        while (queue or self._pending) and not self._halt.is_set():
+            now = time.monotonic()
+            while queue and now >= self._t0 + queue[0].t:
+                self._dispatch(queue.pop(0))
+                now = time.monotonic()
+            self._poll_pending(now)
+            self._halt.wait(POLL_S)
+        # a stop() mid-run fails whatever could not be decided in time
+        for exp in self._pending:
+            exp.passed = False
+            self._settle(exp)
+        self._pending = []
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._halt.set()
+        self.join(timeout=timeout)
+
+    def expectations(self) -> List[dict]:
+        return [{"expect": e.describe(),
+                 "passed": bool(e.passed),
+                 "observed": str(e.observed)} for e in self.results]

@@ -1,0 +1,431 @@
+"""Closed-loop multi-tenant load generator + byte-consistency oracle.
+
+The generator drives the fleet surface (HTTP frontend or in-process
+`TenantRegistry`) at a target per-tenant QPS with DETERMINISTIC request
+streams: every request is a pure function of (soak_seed, tenant, slot
+index, drift epoch), so thread interleaving changes only *when* a
+request lands, never *what* it contains.  Each tenant draws from a
+small pool of pre-built row blocks (mixed widths from the
+`soak_block_rows` palette, mixed raw/probability flavors), which is
+what makes the oracle affordable: reference predictions are memoized
+per (model version, block, flavor) instead of per request.
+
+The byte-consistency oracle is the harness's central invariant: every
+successful response must be byte-identical (float64 `tobytes`) to the
+prediction of SOME model version whose registry-load window overlapped
+the request's [submit, complete] window.  Versions are tracked through
+`ModelRegistry.add_load_listener`, which fires while the replaced
+version can still complete in-flight work — so the two windows overlap
+the swap instant and a response served by either side of a hot-swap is
+accepted, while torn or mixed-version bytes match neither and fail.
+Over HTTP the same check holds end to end because /predict emits
+predictions via Python float repr (shortest round-trip: the f64 parses
+back bit-exact — serving/http.py's documented contract).
+
+Closed-loop pacing: each tenant has a shared slot counter and a rate
+anchor; a worker claims the next slot, sleeps until its scheduled
+time, then issues the request synchronously.  With `soak_concurrency`
+workers the offered load never exceeds the schedule and back-pressure
+makes the generator fall behind (measured as achieved < target QPS)
+instead of queueing unboundedly — the production-shaped load the
+capacity prober needs.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import telemetry
+from ..serving.batcher import ServingOverloadError
+
+#: cap on retained per-request latency samples per tenant (a 10-minute
+#: soak at 100 QPS stays ~60k floats; beyond that, reservoir-decimate)
+MAX_SAMPLES = 200_000
+
+#: Knuth multiplicative hash — maps a slot index to its block/flavor
+#: choice statelessly (no shared RNG ⇒ no interleaving sensitivity)
+_HASH_MULT = 2654435761
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """Nearest-rank percentile of an UNSORTED sample list (stdlib-only;
+    returns 0.0 on empty input)."""
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(q * len(s) + 0.5) - 1))
+    return s[idx]
+
+
+class RequestBlock:
+    """One immutable request payload: a row block plus its identity key
+    (tenant, block index, drift epoch) for oracle memoization."""
+
+    __slots__ = ("key", "X")
+
+    def __init__(self, key: tuple, X: np.ndarray):
+        self.key = key
+        self.X = np.ascontiguousarray(X, dtype=np.float64)
+
+
+class ModelVersion:
+    """One live span of a model name: fingerprint + the booster that
+    produced it + its [live_from, closed_at) registry window."""
+
+    __slots__ = ("fingerprint", "booster", "live_from", "closed_at")
+
+    def __init__(self, fingerprint: str, booster, live_from: float):
+        self.fingerprint = fingerprint
+        self.booster = booster
+        self.live_from = live_from
+        self.closed_at: Optional[float] = None
+
+
+class ByteOracle:
+    """Byte-consistency oracle over lineage-ledger model versions.
+
+    Attach `note_load` via `ModelRegistry.add_load_listener` BEFORE the
+    first model is registered; every subsequent load appends a version
+    and closes its predecessor's window.  `check` accepts a response iff
+    its float64 bytes equal the memoized reference prediction of some
+    version whose window overlapped the request window — the "no torn
+    or mixed-version bytes, ever" invariant, checked online."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._versions: Dict[str, List[ModelVersion]] = {}
+        self._memo: Dict[tuple, bytes] = {}
+        self.checked = 0
+        self.inconsistent = 0
+        self.failures: List[dict] = []  # first few, for the report
+
+    # ------------------------------------------------------- version log
+    def note_load(self, name: str, booster, entry=None) -> None:
+        """Registry load listener: `name` now serves `booster`."""
+        now = time.monotonic()
+        try:
+            fp = booster.model_fingerprint()
+        except Exception:
+            fp = f"unfingerprinted-{id(booster):x}"
+        with self._lock:
+            chain = self._versions.setdefault(name, [])
+            if chain:
+                chain[-1].closed_at = now
+            chain.append(ModelVersion(fp, booster, now))
+            total = sum(len(c) for c in self._versions.values())
+        telemetry.REGISTRY.gauge("soak.oracle.versions").set(total)
+
+    def versions(self, name: str) -> List[ModelVersion]:
+        with self._lock:
+            return list(self._versions.get(name, ()))
+
+    def live_versions(self, name: str, t0: float,
+                      t1: float) -> List[ModelVersion]:
+        """Versions whose live window overlapped [t0, t1] — newest
+        first, since steady state matches the current version."""
+        out = [v for v in self.versions(name)
+               if v.live_from <= t1
+               and (v.closed_at is None or v.closed_at >= t0)]
+        out.reverse()
+        return out
+
+    # ----------------------------------------------------------- checking
+    def _reference(self, version: ModelVersion, block: RequestBlock,
+                   raw: bool) -> bytes:
+        key = (version.fingerprint, block.key, raw)
+        with self._lock:
+            ref = self._memo.get(key)
+        if ref is None:
+            preds = version.booster.predict(block.X, raw_score=raw)
+            ref = np.ascontiguousarray(
+                np.asarray(preds, dtype=np.float64)).tobytes()
+            with self._lock:
+                self._memo.setdefault(key, ref)
+        return ref
+
+    def check(self, name: str, block: RequestBlock, preds, raw: bool,
+              t0: float, t1: float) -> bool:
+        """True iff `preds` is byte-identical to some version live
+        during [t0, t1].  Counts `soak.oracle.checked` /
+        `soak.oracle.byte_inconsistent` and ledgers each failure."""
+        got = np.ascontiguousarray(
+            np.asarray(preds, dtype=np.float64)).tobytes()
+        candidates = self.live_versions(name, t0, t1)
+        ok = False
+        for version in candidates:
+            try:
+                if self._reference(version, block, raw) == got:
+                    ok = True
+                    break
+            except Exception:
+                continue  # a closed/garbage-collected version cannot vouch
+        with self._lock:
+            self.checked += 1
+            if not ok:
+                self.inconsistent += 1
+                if len(self.failures) < 8:
+                    self.failures.append({
+                        "tenant": name, "block": list(map(str, block.key)),
+                        "raw": raw, "window_s": round(t1 - t0, 6),
+                        "candidates": [v.fingerprint[:12]
+                                       for v in candidates]})
+        telemetry.REGISTRY.counter("soak.oracle.checked").inc()
+        if not ok:
+            telemetry.REGISTRY.counter("soak.oracle.byte_inconsistent").inc()
+            telemetry.LEDGER.record(
+                "soak.byte_inconsistent", model=name, raw=bool(raw),
+                block="/".join(map(str, block.key)),
+                candidates=[v.fingerprint for v in candidates])
+        return ok
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"checked": self.checked,
+                    "byte_inconsistent": self.inconsistent,
+                    "versions": {n: len(c)
+                                 for n, c in self._versions.items()},
+                    "failures": list(self.failures)}
+
+
+class TenantStream:
+    """One tenant's deterministic request stream + live stats."""
+
+    def __init__(self, name: str, slo: str, qps: float, seed: int,
+                 n_features: int, pool_blocks: int,
+                 row_palette: List[int]):
+        self.name = name
+        self.slo = slo
+        self.seed = int(seed)
+        self.n_features = int(n_features)
+        self.pool_blocks = max(1, int(pool_blocks))
+        self.row_palette = [max(1, int(r)) for r in row_palette] or [8]
+        self.lock = threading.Lock()
+        # pacing state (guarded-by: lock): slot counter + rate anchor;
+        # set_qps re-anchors so a rate change never creates a burst of
+        # "overdue" slots
+        self.qps = float(qps)
+        self.slot = 0
+        self.anchor_t = time.monotonic()
+        self.anchor_slot = 0
+        # drift state: per-feature additive shift, bumping the epoch
+        # (and thereby every block key) on each injection
+        self.drift = np.zeros(self.n_features, dtype=np.float64)
+        self.epoch = 0
+        self.pool: List[RequestBlock] = []
+        self._build_pool()
+        # stats (guarded-by: lock)
+        self.requests = 0
+        self.ok = 0
+        self.shed = 0
+        self.shed_during_swap = 0
+        self.errors = 0
+        self.inconsistent = 0
+        self.latencies: List[float] = []
+        self.window_lat: List[float] = []
+        self.window_rows = 0
+        self.window_shed = 0
+        self.window_err = 0
+
+    # ------------------------------------------------------------ content
+    def _build_pool(self) -> None:
+        pool = []
+        for i in range(self.pool_blocks):
+            rows = self.row_palette[i % len(self.row_palette)]
+            rng = np.random.RandomState(
+                (self.seed * 1_000_003 + i * 7919) % (2 ** 31))
+            X = rng.randn(rows, self.n_features) + self.drift[None, :]
+            pool.append(RequestBlock((self.name, i, self.epoch), X))
+        self.pool = pool
+
+    def inject_drift(self, feature: int, shift: float) -> None:
+        """Shift one feature of every future request block — the
+        stimulus the serving-side DriftMonitor must notice.  Epoch bump
+        keeps block keys (and oracle memo entries) distinct."""
+        with self.lock:
+            self.drift[int(feature) % self.n_features] += float(shift)
+            self.epoch += 1
+            self._build_pool()
+
+    def request_for_slot(self, i: int):
+        """(block, raw_flavor) for slot `i` — stateless, deterministic."""
+        h = (i * _HASH_MULT + self.seed * 97) & 0xFFFFFFFF
+        with self.lock:
+            block = self.pool[h % len(self.pool)]
+        return block, bool((h >> 9) & 1)
+
+    # ------------------------------------------------------------- pacing
+    def claim_slot(self):
+        """Next slot index + its scheduled absolute time."""
+        with self.lock:
+            i = self.slot
+            self.slot += 1
+            due = self.anchor_t + (i - self.anchor_slot) / max(self.qps,
+                                                              1e-9)
+        return i, due
+
+    def set_qps(self, qps: float) -> None:
+        with self.lock:
+            self.qps = max(float(qps), 0.001)
+            self.anchor_t = time.monotonic()
+            self.anchor_slot = self.slot
+        telemetry.REGISTRY.gauge("soak.qps_target",
+                                 tenant=self.name).set(self.qps)
+
+    # -------------------------------------------------------------- stats
+    def record(self, outcome: str, latency_s: float, rows: int,
+               during_swap: bool, consistent: Optional[bool]) -> None:
+        with self.lock:
+            self.requests += 1
+            if outcome == "ok":
+                self.ok += 1
+                if len(self.latencies) < MAX_SAMPLES:
+                    self.latencies.append(latency_s)
+                self.window_lat.append(latency_s)
+                self.window_rows += rows
+                if consistent is False:
+                    self.inconsistent += 1
+            elif outcome == "shed":
+                self.shed += 1
+                self.window_shed += 1
+                if during_swap:
+                    self.shed_during_swap += 1
+            else:
+                self.errors += 1
+                self.window_err += 1
+
+    def take_window(self) -> dict:
+        """Return-and-reset the per-step stats window (capacity probe)."""
+        with self.lock:
+            out = {"latencies": self.window_lat,
+                   "rows": self.window_rows,
+                   "shed": self.window_shed,
+                   "errors": self.window_err}
+            self.window_lat = []
+            self.window_rows = 0
+            self.window_shed = 0
+            self.window_err = 0
+        return out
+
+    def summary(self, elapsed_s: float) -> dict:
+        with self.lock:
+            lat = list(self.latencies)
+            out = {"slo": self.slo,
+                   "requests": self.requests,
+                   "ok": self.ok,
+                   "shed": self.shed,
+                   "shed_during_swap": self.shed_during_swap,
+                   "errors": self.errors,
+                   "byte_inconsistent": self.inconsistent,
+                   "qps_target": self.qps}
+        out["qps_achieved"] = round(out["requests"] / elapsed_s, 3) \
+            if elapsed_s > 0 else 0.0
+        out["p50_ms"] = round(percentile(lat, 0.50) * 1e3, 3)
+        out["p99_ms"] = round(percentile(lat, 0.99) * 1e3, 3)
+        return out
+
+
+class TrafficGenerator:
+    """Worker pool driving every tenant stream through one predict
+    callable: `predict_fn(tenant, X, raw) -> ndarray` (raises
+    `ServingOverloadError` on shed).  The oracle check runs inline on
+    the worker — an inconsistent byte is known the moment it happens,
+    not at teardown."""
+
+    def __init__(self, predict_fn: Callable, streams: List[TenantStream],
+                 oracle: ByteOracle, concurrency: int = 2):
+        self.predict_fn = predict_fn
+        self.streams = {s.name: s for s in streams}
+        self.oracle = oracle
+        self.concurrency = max(1, int(concurrency))
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self.started_at: Optional[float] = None
+        self.stopped_at: Optional[float] = None
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        self.started_at = time.monotonic()
+        for stream in self.streams.values():
+            telemetry.REGISTRY.gauge("soak.qps_target",
+                                     tenant=stream.name).set(stream.qps)
+            for w in range(self.concurrency):
+                th = threading.Thread(
+                    target=self._worker, args=(stream,),
+                    name=f"soak-{stream.name}-{w}", daemon=True)
+                th.start()
+                self._threads.append(th)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for th in self._threads:
+            th.join(timeout=10.0)
+        self._threads = []
+        self.stopped_at = time.monotonic()
+
+    def elapsed(self) -> float:
+        if self.started_at is None:
+            return 0.0
+        end = self.stopped_at if self.stopped_at is not None \
+            else time.monotonic()
+        return end - self.started_at
+
+    # ------------------------------------------------------------- worker
+    def _worker(self, stream: TenantStream) -> None:
+        stop = self._stop
+        while not stop.is_set():
+            i, due = stream.claim_slot()
+            while True:
+                delay = due - time.monotonic()
+                if delay <= 0 or stop.is_set():
+                    break
+                stop.wait(min(delay, 0.25))
+            if stop.is_set():
+                return
+            block, raw = stream.request_for_slot(i)
+            self._issue(stream, block, raw)
+
+    def _issue(self, stream: TenantStream, block: RequestBlock,
+               raw: bool) -> None:
+        telemetry.REGISTRY.counter("soak.requests",
+                                   tenant=stream.name).inc()
+        t0 = time.monotonic()
+        try:
+            preds = self.predict_fn(stream.name, block.X, raw)
+        except ServingOverloadError:
+            swap = telemetry.REGISTRY.gauge("serve.swap_windows").value > 0
+            telemetry.REGISTRY.counter("soak.shed",
+                                       tenant=stream.name).inc()
+            stream.record("shed", time.monotonic() - t0, 0, swap, None)
+            return
+        except Exception:
+            telemetry.REGISTRY.counter("soak.errors",
+                                       tenant=stream.name).inc()
+            stream.record("error", time.monotonic() - t0, 0, False, None)
+            return
+        t1 = time.monotonic()
+        consistent = self.oracle.check(stream.name, block, preds, raw,
+                                       t0, t1)
+        stream.record("ok", t1 - t0, int(block.X.shape[0]), False,
+                      consistent)
+
+    # ------------------------------------------------------------ control
+    def set_qps(self, qps_per_tenant: float) -> None:
+        for stream in self.streams.values():
+            stream.set_qps(qps_per_tenant)
+
+    def inject_drift(self, feature: int, shift: float,
+                     tenant: Optional[str] = None) -> None:
+        for stream in self.streams.values():
+            if tenant is None or stream.name == tenant:
+                stream.inject_drift(feature, shift)
+
+    def take_windows(self) -> Dict[str, dict]:
+        return {name: s.take_window() for name, s in self.streams.items()}
+
+    def summary(self) -> Dict[str, dict]:
+        elapsed = self.elapsed()
+        return {name: s.summary(elapsed)
+                for name, s in self.streams.items()}

@@ -321,6 +321,40 @@ RULES: List[Tuple[str, str, str]] = [
     ("memory.ledger.oom_dumps", "up_is_bad", "counter"),
     ("memory.ledger.leak_slope_mb_per_min", "up_is_bad", "timing"),
     ("memory.ledger.*", "ignore", "counter"),
+    # the bench `soak` block (ISSUE 20, --soak): the invariant verdicts
+    # fail HARD on any rise — a byte-inconsistent response, an SLO-class
+    # budget breach, a failed scenario expectation or an unattributed
+    # swap-window shed each mean a production invariant broke; the
+    # fitted capacity model's throughput fields are wall-clock-derived
+    # (timing class, down-is-bad — a capacity regression is the model
+    # being falsified); scenario bookkeeping (request counts, versions,
+    # per-step detail) is workload identity at a fixed scenario shape
+    ("soak.byte_inconsistent", "up_is_bad", "counter"),
+    ("soak.slo_breach", "up_is_bad", "counter"),
+    ("soak.expect_fail", "up_is_bad", "counter"),
+    ("soak.errors", "up_is_bad", "counter"),
+    ("soak.swap_retry_exhausted", "up_is_bad", "counter"),
+    ("soak.sheds.unattributed_swap", "up_is_bad", "counter"),
+    ("soak.mem_budget_violations", "up_is_bad", "counter"),
+    ("soak.slo.*.burn_rate", "up_is_bad", "timing"),
+    ("soak.slo.*.observed_p99_ms", "up_is_bad", "timing"),
+    ("soak.capacity.rows_per_sec*", "down_is_bad", "timing"),
+    ("soak.capacity.service_rate_qps", "down_is_bad", "timing"),
+    ("soak.capacity.capacity_qps.*", "down_is_bad", "timing"),
+    ("soak.capacity.shed_onset_qps", "down_is_bad", "timing"),
+    ("soak.capacity.base_ms", "up_is_bad", "timing"),
+    ("soak.capacity.*", "ignore", "counter"),
+    ("soak.tenants.*.p99_ms", "up_is_bad", "timing"),
+    ("soak.*", "ignore", "counter"),
+    # the soak run's own live counters (spool/registry snapshots)
+    ("*soak.oracle.byte_inconsistent", "up_is_bad", "counter"),
+    ("*soak.expect.fail", "up_is_bad", "counter"),
+    ("*soak.oracle.checked", "ignore", "counter"),
+    ("*soak.requests", "ignore", "counter"),
+    ("*soak.shed", "ignore", "counter"),
+    ("*soak.errors", "up_is_bad", "counter"),
+    ("*soak.appends", "ignore", "counter"),
+    ("*soak.expect.pass", "ignore", "counter"),
     ("*datastore.prefetch.stall", "up_is_bad", "timing"),
     ("*datastore.prefetch.hit", "ignore", "counter"),
     ("*datastore.spill_bytes", "ignore", "counter"),
@@ -426,13 +460,21 @@ def diff_snapshots(base: Dict[str, Any], cur: Dict[str, Any],
         # keeps a 0 -> x move finite-but-huge, which is the right signal
         scale = max(abs(va), ABS_FLOOR)
         rel = delta / scale
+        # drops are measured against the CURRENT value (fold-symmetric):
+        # baseline-relative change caps a drop's |rel| at 1.0, which
+        # made every tolerance above 1 unreachable downward — a
+        # down_is_bad timing rule (tol 1.5) could never fire.  With the
+        # current-relative measure a fall to 1/(1+tol) of baseline trips
+        # exactly like a rise to (1+tol)x does.
+        rel_down = delta / max(abs(vb), ABS_FLOOR)
         entry = {"metric": path, "base": va, "current": vb,
                  "rel_change": round(rel, 4),
                  "rule": f"{direction}/{klass}"}
         bad = (direction == "up_is_bad" and rel > tol) or \
-              (direction == "down_is_bad" and -rel > tol) or \
-              (direction == "any_is_bad" and abs(rel) > tol)
-        good = (direction == "up_is_bad" and -rel > tol) or \
+              (direction == "down_is_bad" and -rel_down > tol) or \
+              (direction == "any_is_bad"
+               and (rel > tol or -rel_down > tol))
+        good = (direction == "up_is_bad" and -rel_down > tol) or \
                (direction == "down_is_bad" and rel > tol)
         if bad:
             if klass == "timing" and warn_timings:

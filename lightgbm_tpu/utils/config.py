@@ -420,6 +420,42 @@ _PARAMS: Dict[str, Tuple[Any, str, Tuple[str, ...]]] = {
     "serve_drift_ring": (512, "int", ()),
     "serve_drift_min_rows": (64, "int", ()),
     "serve_drift_top_k": (5, "int", ()),
+    # production soak harness (lightgbm_tpu/soak/): closed-loop
+    # multi-tenant traffic + chaos scenarios + capacity probing over the
+    # composed fleet/serving plane.  Orchestration knobs only — the
+    # harness inherits the fleet_*/serve_* params above for everything
+    # else.  Synthetic tenants cycle through the fleet_slo_classes
+    # ranks; tenant t0 is the trainer daemon's (hot-swapped) model
+    "soak_tenants": (2, "int", ()),
+    # per-tenant target request rate.  Closed-loop with pacing: each
+    # tenant's workers never exceed the schedule, and under
+    # back-pressure they fall behind instead of queueing unboundedly
+    "soak_qps": (25.0, "float", ()),
+    # closed-loop workers per tenant (the in-flight concurrency cap)
+    "soak_concurrency": (2, "int", ()),
+    # master seed: request content is a pure function of
+    # (seed, tenant, slot index, drift epoch) — thread interleaving
+    # never changes WHAT is sent, only when
+    "soak_seed": (0, "int", ()),
+    # distinct request blocks per tenant; the byte-consistency oracle
+    # memoizes one reference prediction per live model version x block
+    # x flavor, which is what keeps the oracle O(versions), not O(requests)
+    "soak_pool_blocks": (8, "int", ()),
+    # request batch-row palette, cycled across the block pool (mixed
+    # widths exercise the batcher's width-grouped coalescing)
+    "soak_block_rows": ("1,8,64", "str", ()),
+    # drive the stdlib HTTP frontend (full wire round-trip; JSON floats
+    # parse back bit-exact) instead of the in-process registry surface
+    "soak_http": (True, "bool", ()),
+    # default scenario horizon (seconds) when the scenario file has no
+    # `end` event and the CLI passes no --minutes
+    "soak_seconds": (30.0, "float", ()),
+    # capacity prober (soak/capacity.py): seconds per load step,
+    # aggregate starting QPS, per-step multiplier, and the step cap
+    "soak_capacity_step_s": (3.0, "float", ()),
+    "soak_capacity_start_qps": (16.0, "float", ()),
+    "soak_capacity_factor": (1.6, "float", ()),
+    "soak_capacity_max_steps": (8, "int", ()),
     # multi-slice training: shard rows over a 2-level ("dcn", "ici") mesh
     # with this many slices (1 = flat single-slice mesh)
     "tpu_dcn_slices": (1, "int", ()),

@@ -943,6 +943,50 @@ print(f"[run_ci] chaos smoke: hang bounded in {wedged_s:.1f}s "
       "device_sum after disarm")
 EOF
 
+# mini-soak smoke (ISSUE 20): the composed production plane under
+# closed-loop multi-tenant traffic for ~60 s.  The `smoke` scenario
+# drives one append-triggered gated hot-swap, a drift injection and a
+# rung kill with breaker recovery over live HTTP, then the capacity
+# ladder fits the falsifiable queueing model.  ZERO byte-inconsistent
+# responses, every online expectation met, every SLO class inside its
+# budget, zero unattributed swap-window sheds — and the emitted BENCH
+# `soak` block must be sentinel-grade: doctoring in a byte
+# inconsistency or a capacity collapse makes telemetry diff exit 1.
+JAX_PLATFORMS=cpu python - <<'EOF'
+import copy
+import json
+
+from lightgbm_tpu.soak import run_mini_soak
+from lightgbm_tpu.telemetry.diff import diff_snapshots
+
+block = run_mini_soak(params={"soak_capacity_max_steps": 4})
+assert block["byte_inconsistent"] == 0, block
+assert block["oracle_checked"] > 100, block["oracle_checked"]
+assert block["swaps"] >= 1 and block["gate_pass"] >= 1, block
+assert block["breaker_recovered"] >= 1, block
+assert block["expect_fail"] == 0, block["expect_detail"]
+assert block["slo_breach"] == 0, block["slo"]
+assert block["sheds"]["unattributed_swap"] == 0, block["sheds"]
+cap = block["capacity"]
+assert cap["rows_per_sec_peak"] > 0 and cap["devices"] >= 1, cap
+
+flat = json.loads(json.dumps(block))
+doctors = (lambda s: s.update(byte_inconsistent=1),
+           lambda s: s["capacity"].update(
+               rows_per_sec_per_device=cap["rows_per_sec_per_device"] / 4))
+for doctor in doctors:
+    bad = copy.deepcopy(flat)
+    doctor(bad)
+    v = diff_snapshots({"soak": flat}, {"soak": bad})
+    assert v["verdict"] == "regression", v
+print(f"[run_ci] soak smoke: {block['requests']} requests / "
+      f"{block['oracle_checked']} oracle checks, 0 byte-inconsistent, "
+      f"{block['swaps']} gated hot-swap(s), breaker recovered x"
+      f"{block['breaker_recovered']}, all SLO classes within budget, "
+      f"capacity {cap['rows_per_sec_per_device']:.0f} rows/s/device "
+      "(doctored regressions trip the sentinel)")
+EOF
+
 # perf-regression sentinel: fresh deterministic snapshot diffed against
 # the checked-in baseline.  Counter-class drift (tree shape, recompiles,
 # fallback events, memory watermarks) FAILS; wall-clock drift only warns

@@ -559,6 +559,66 @@ class TestTenancy:
             telemetry.REGISTRY.gauge("serve.queue_depth").set(0)
             tr.close()
 
+    def test_admission_ordering_under_concurrent_burst(self):
+        # the serial ordering test above checks one request at a time;
+        # this one fires a synchronized burst from every tenant at once
+        # (barrier start) and asserts the ordering holds under real
+        # interleaving: at a pressure between the bronze and gold
+        # thresholds every over-SLO bronze request sheds, every gold
+        # and every healthy request admits — no cross-tenant bleed in
+        # `_admit`'s per-tenant state or the shed counter.  Pressure is
+        # pinned via `_queue_pressure` (not the gauge: the registry's
+        # own dispatch rewrites `serve.queue_depth` mid-burst — the
+        # serial test above covers the gauge plumbing)
+        X, y = _data(128)
+        bst = _train(X, y, rounds=2)
+        tr = TenantRegistry(dict(SERVE_PARAMS,
+                                 fleet_admission_pressure=0.5))
+        Xq = np.ascontiguousarray(X[:4])
+        per_tenant = 12
+        try:
+            gold = tr.register("burst-gold", bst, slo="gold")
+            brz = tr.register("burst-brz", bst, slo="bronze")
+            healthy = tr.register("burst-healthy", bst, slo="bronze")
+            for t in (gold, brz):
+                for _ in range(32):
+                    t.hist.observe(1.0)   # way over any p99 budget
+            sheds = telemetry.REGISTRY.counter("fleet.shed.slo")
+            before = sheds.value
+            # hold pressure between the thresholds for the whole burst
+            tr._queue_pressure = lambda: 0.4
+            outcomes = {"burst-gold": [], "burst-brz": [],
+                        "burst-healthy": []}
+            lock = threading.Lock()
+            barrier = threading.Barrier(3 * per_tenant)
+
+            def worker(tenant):
+                barrier.wait(timeout=30)
+                try:
+                    tr.predict(Xq, tenant=tenant)
+                    out = "ok"
+                except ServingOverloadError:
+                    out = "shed"
+                with lock:
+                    outcomes[tenant].append(out)
+
+            threads = [threading.Thread(target=worker, args=(name,))
+                       for name in outcomes for _ in range(per_tenant)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60)
+            assert outcomes["burst-brz"] == ["shed"] * per_tenant, \
+                "over-SLO bronze must shed at moderate pressure"
+            assert outcomes["burst-gold"] == ["ok"] * per_tenant, \
+                "over-SLO gold must still admit below its threshold"
+            assert outcomes["burst-healthy"] == ["ok"] * per_tenant, \
+                "healthy tenants are never admission-shed"
+            assert sheds.value == before + per_tenant
+        finally:
+            telemetry.REGISTRY.gauge("serve.queue_depth").set(0)
+            tr.close()
+
     def test_unknown_tenant_raises(self):
         tr = TenantRegistry(dict(SERVE_PARAMS))
         with pytest.raises(LightGBMError, match="no tenant"):

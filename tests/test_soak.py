@@ -1,0 +1,297 @@
+"""Soak harness suite (ISSUE 20).
+
+Fast tier-1 tests cover the pure pieces — scenario grammar, byte-oracle
+version-window logic, deterministic traffic streams, the capacity-model
+fit, and the diff.py sentinel rules on a doctored `soak` block.  The
+full closed-loop acceptance run (2 tenants, append-triggered gated
+hot-swap, rung kill + breaker recovery over live HTTP) is `slow`-marked
+and also runs as the run_ci.sh mini-soak smoke.
+"""
+import copy
+import json
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.engine import train as engine_train
+from lightgbm_tpu.soak import (SCENARIOS, ByteOracle, TenantStream,
+                               capacity_at, fit_queue_model,
+                               load_scenario, parse_scenario)
+from lightgbm_tpu.telemetry.diff import diff_snapshots
+from lightgbm_tpu.utils.log import LightGBMError
+
+# `quick` is applied per-class (not module-wide) so the slow
+# acceptance run below is NOT swept into the `-m quick` tier --
+# run_ci.sh runs the same mini-soak as its own smoke block.
+
+
+def _tiny_booster(seed=0, rounds=3):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(256, 4)
+    y = (X[:, 0] + 0.1 * rng.randn(256) > 0).astype(np.float64)
+    return engine_train({"objective": "binary", "num_leaves": 7,
+                         "min_data_in_leaf": 8, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+# ------------------------------------------------------------- scenario
+@pytest.mark.quick
+class TestScenarioGrammar:
+    def test_prose_shorthands(self):
+        sc = parse_scenario(
+            "at 30s: append 50k rows\n"
+            "at 60s: drift into f3 shift=2.5\n"
+            "at 90s: kill device_sum\n"
+            "at 120s: expect swap min=1 within=25s\n"
+            "at 130s: end\n")
+        acts = [(e.t, e.action, e.kwargs) for e in sc.events]
+        assert acts[0] == (30.0, "append", {"rows": 50000})
+        assert acts[1] == (60.0, "drift", {"feature": 3, "shift": 2.5})
+        assert acts[2] == (90.0, "kill", {"rung": "device_sum"})
+        assert acts[3] == (120.0, "expect",
+                           {"cond": "swap", "min": 1, "within": 25.0})
+        assert sc.horizon == 130.0
+
+    def test_bounded_kill_expands_heal(self):
+        sc = parse_scenario("at 10s: kill rung=compiled for=3s\n"
+                            "at 20s: end\n")
+        heals = [e for e in sc.events if e.action == "heal"]
+        assert len(heals) == 1 and heals[0].t == 13.0
+
+    def test_horizon_defaults_past_last_event(self):
+        sc = parse_scenario("at 5s: append rows=10\n")
+        assert sc.horizon > 5.0
+
+    def test_rejects_garbage(self):
+        with pytest.raises(LightGBMError, match="not 'at"):
+            parse_scenario("sometime: append\n")
+        with pytest.raises(LightGBMError, match="unknown action"):
+            parse_scenario("at 1s: explode\n")
+        with pytest.raises(LightGBMError, match="stray token"):
+            parse_scenario("at 1s: append weirdness\n")
+
+    def test_builtins_parse(self):
+        for name in SCENARIOS:
+            sc = load_scenario(name)
+            assert sc.name == name and sc.events and sc.horizon > 0
+
+    def test_comments_and_magnitudes(self):
+        sc = parse_scenario("# header\nat 1s: append 2k  # grow\n")
+        assert sc.events[0].kwargs == {"rows": 2000}
+
+
+# ----------------------------------------------------------- byte oracle
+@pytest.mark.quick
+class TestByteOracle:
+    def test_version_windows_overlap_swap(self):
+        oracle = ByteOracle()
+        b1, b2 = _tiny_booster(1), _tiny_booster(2)
+        oracle.note_load("m", b1)
+        oracle.note_load("m", b2)   # closes b1's window at load time
+        chain = oracle.versions("m")
+        assert len(chain) == 2
+        swap_t = chain[1].live_from
+        assert chain[0].closed_at == swap_t
+        # a request spanning the swap instant may match EITHER side
+        live = oracle.live_versions("m", swap_t - 0.01, swap_t + 0.01)
+        assert len(live) == 2
+        # a request strictly after the swap sees only the new version
+        live = oracle.live_versions("m", swap_t + 0.01, swap_t + 0.02)
+        assert [v.fingerprint for v in live] == [chain[1].fingerprint]
+
+    def test_accepts_either_side_of_swap_rejects_torn(self):
+        oracle = ByteOracle()
+        b1, b2 = _tiny_booster(1), _tiny_booster(2)
+        X = np.random.RandomState(0).randn(8, 4)
+        from lightgbm_tpu.soak.traffic import RequestBlock
+        block = RequestBlock(("t", 0, 0), X)
+        oracle.note_load("m", b1)
+        chain_t = oracle.versions("m")[0].live_from
+        oracle.note_load("m", b2)
+        t1 = oracle.versions("m")[1].live_from + 1.0
+        p1 = b1.predict(X)
+        p2 = b2.predict(X)
+        # window spans the swap: both versions' bytes are acceptable
+        assert oracle.check("m", block, p1, False, chain_t, t1)
+        assert oracle.check("m", block, p2, False, chain_t, t1)
+        # torn bytes (half old, half new) match neither version
+        torn = np.concatenate([p1[:4], p2[4:]])
+        if np.array_equal(torn, p1) or np.array_equal(torn, p2):
+            pytest.skip("models agree on this block; torn not testable")
+        base = oracle.inconsistent
+        assert not oracle.check("m", block, torn, False, chain_t, t1)
+        assert oracle.inconsistent == base + 1
+        assert oracle.summary()["byte_inconsistent"] == base + 1
+
+    def test_post_swap_window_rejects_old_version(self):
+        oracle = ByteOracle()
+        b1, b2 = _tiny_booster(1), _tiny_booster(2)
+        X = np.random.RandomState(1).randn(8, 4)
+        from lightgbm_tpu.soak.traffic import RequestBlock
+        block = RequestBlock(("t", 0, 0), X)
+        oracle.note_load("m", b1)
+        oracle.note_load("m", b2)
+        p1, p2 = b1.predict(X), b2.predict(X)
+        if np.array_equal(p1, p2):
+            pytest.skip("models agree on this block")
+        t0 = oracle.versions("m")[1].live_from + 0.5
+        assert oracle.check("m", block, p2, False, t0, t0 + 0.1)
+        assert not oracle.check("m", block, p1, False, t0, t0 + 0.1), \
+            "bytes from a version closed before the request began " \
+            "must not be vouched for"
+
+
+# -------------------------------------------------------------- traffic
+@pytest.mark.quick
+class TestTrafficDeterminism:
+    def test_stream_is_pure_function_of_seed_and_slot(self):
+        mk = lambda: TenantStream("t0", "gold", qps=10.0, seed=42,
+                                  n_features=4, pool_blocks=4,
+                                  row_palette=[1, 8])
+        a, b = mk(), mk()
+        for slot in (0, 1, 7, 1000, 12345):
+            blk_a, raw_a = a.request_for_slot(slot)
+            blk_b, raw_b = b.request_for_slot(slot)
+            assert raw_a == raw_b
+            assert blk_a.key == blk_b.key
+            np.testing.assert_array_equal(blk_a.X, blk_b.X)
+
+    def test_drift_bumps_epoch_and_content(self):
+        s = TenantStream("t0", "gold", qps=10.0, seed=7, n_features=4,
+                         pool_blocks=2, row_palette=[4])
+        before = s.request_for_slot(0)[0]
+        s.inject_drift(2, 3.0)
+        after = s.request_for_slot(0)[0]
+        assert after.key != before.key      # epoch in the oracle key
+        assert after.X[:, 2] == pytest.approx(before.X[:, 2] + 3.0)
+
+    def test_mixed_widths_and_flavors_appear(self):
+        s = TenantStream("t0", "gold", qps=10.0, seed=3, n_features=4,
+                         pool_blocks=8, row_palette=[1, 8, 64])
+        widths = set()
+        flavors = set()
+        for slot in range(64):
+            blk, raw = s.request_for_slot(slot)
+            widths.add(blk.X.shape[0])
+            flavors.add(raw)
+        assert widths == {1, 8, 64} and flavors == {True, False}
+
+
+# ------------------------------------------------------------- capacity
+@pytest.mark.quick
+class TestCapacityModel:
+    def test_fit_recovers_planted_queue_curve(self):
+        mu, base, coef = 100.0, 3.0, 200.0
+        pts = [(q, base + coef / (mu - q)) for q in (20, 40, 60, 80)]
+        fit = fit_queue_model(pts)
+        assert fit is not None
+        # grid resolution is 5% of peak — accept the nearest rung
+        assert fit["service_rate_qps"] == pytest.approx(mu, rel=0.10)
+        assert fit["coef"] > 0
+        cap = capacity_at(fit, budget_ms=base + coef / (mu - 90.0))
+        assert cap == pytest.approx(90.0, rel=0.15)
+
+    def test_fit_needs_two_points_and_rising_latency(self):
+        assert fit_queue_model([(10, 5.0)]) is None
+        assert fit_queue_model([]) is None
+        # falling latency toward saturation fits no queue curve
+        assert fit_queue_model([(10, 50.0), (50, 10.0), (90, 2.0)]) is None
+
+    def test_capacity_at_edge_cases(self):
+        fit = {"service_rate_qps": 100.0, "base_ms": 5.0, "coef": 100.0}
+        assert capacity_at(None, 50.0) is None
+        assert capacity_at(fit, 4.0) == 0.0      # budget under base
+        assert 0.0 < capacity_at(fit, 50.0) < 100.0
+
+
+# ------------------------------------------------------- sentinel rules
+@pytest.mark.quick
+class TestSoakSentinelRules:
+    BASE = {"metric": "m", "value": 1.0, "soak": {
+        "byte_inconsistent": 0, "slo_breach": 0, "expect_fail": 0,
+        "errors": 0, "requests": 1600, "swaps": 1, "gate_pass": 1,
+        "sheds": {"total": 3, "swap_window": 3, "unattributed_swap": 0},
+        "capacity": {"rows_per_sec_peak": 2000.0,
+                     "rows_per_sec_per_device": 2000.0,
+                     "service_rate_qps": 700.0, "base_ms": 3.0,
+                     "capacity_qps": {"gold": 650.0, "silver": 680.0}}}}
+
+    def _diff(self, mutate):
+        cur = copy.deepcopy(self.BASE)
+        mutate(cur["soak"])
+        return diff_snapshots(copy.deepcopy(self.BASE), cur)
+
+    def test_identical_is_ok(self):
+        assert self._diff(lambda s: None)["verdict"] == "ok"
+
+    def test_byte_inconsistency_fails_hard(self):
+        v = self._diff(lambda s: s.update(byte_inconsistent=1))
+        assert v["verdict"] == "regression"
+        assert any(x["metric"] == "soak.byte_inconsistent"
+                   for x in v["violations"])
+
+    def test_slo_breach_and_expect_fail_fail_hard(self):
+        assert self._diff(lambda s: s.update(slo_breach=1))[
+            "verdict"] == "regression"
+        assert self._diff(lambda s: s.update(expect_fail=2))[
+            "verdict"] == "regression"
+
+    def test_unattributed_swap_shed_fails_hard(self):
+        v = self._diff(
+            lambda s: s["sheds"].update(unattributed_swap=2))
+        assert v["verdict"] == "regression"
+
+    def test_capacity_regression_fails(self):
+        v = self._diff(lambda s: s["capacity"].update(
+            rows_per_sec_per_device=600.0))
+        assert v["verdict"] == "regression"
+        assert any(x["rule"] == "down_is_bad/timing"
+                   for x in v["violations"])
+
+    def test_scenario_bookkeeping_ignored(self):
+        v = self._diff(lambda s: s.update(requests=99, swaps=3,
+                                          gate_pass=4))
+        assert v["verdict"] == "ok"
+
+    def test_down_is_bad_timing_is_reachable(self):
+        # regression guard for the fold-symmetric drop measure: the
+        # baseline-relative rel caps drops at -1.0, which silently
+        # disabled every down_is_bad timing rule (tol 1.5)
+        base = {"streaming": {"streamed_rounds_per_sec": 100.0}}
+        cur = {"streaming": {"streamed_rounds_per_sec": 10.0}}
+        assert diff_snapshots(base, cur)["verdict"] == "regression"
+
+
+# --------------------------------------------------- the acceptance run
+@pytest.mark.slow
+def test_mini_soak_acceptance():
+    """The ~60 s closed-loop acceptance run (also the run_ci.sh smoke):
+    2 tenants over live HTTP, one append-triggered gated hot-swap, one
+    injected rung kill — zero byte-inconsistent responses, gate pass,
+    breaker recovery, gold SLO within budget, well-formed BENCH block
+    whose doctored regression trips the sentinel."""
+    from lightgbm_tpu.soak import run_mini_soak
+    block = run_mini_soak(params={"soak_capacity_max_steps": 4})
+
+    assert block["byte_inconsistent"] == 0, \
+        f"byte-oracle failures: {block}"
+    assert block["oracle_checked"] > 100
+    assert block["gate_pass"] >= 1
+    assert block["swaps"] >= 1
+    assert block["breaker_recovered"] >= 1
+    assert block["expect_fail"] == 0, block["expect_detail"]
+    assert block["sheds"]["unattributed_swap"] == 0
+    gold = [s for s in block["slo"].values() if s["class"] == "gold"]
+    assert gold and all(s["within_budget"] for s in gold), block["slo"]
+    # well-formed capacity model
+    cap = block["capacity"]
+    assert cap["rows_per_sec_peak"] > 0
+    assert cap["devices"] >= 1 and cap["steps"]
+    # the block is JSON-serializable and its doctored regression trips
+    # the sentinel rules
+    flat = json.loads(json.dumps(block))
+    doctored = copy.deepcopy(flat)
+    doctored["byte_inconsistent"] = 1
+    verdict = diff_snapshots({"soak": flat}, {"soak": doctored})
+    assert verdict["verdict"] == "regression"
